@@ -1,0 +1,13 @@
+"""kubeflow_tpu — a TPU-native ML platform framework.
+
+A from-scratch rebuild of the Kubeflow control-plane capabilities
+(training-operator + KServe + Katib; reference: Garrybest/kubeflow, see
+SURVEY.md) designed TPU-first: declarative gang-scheduled JaxJobs whose
+rendezvous is ``jax.distributed.initialize`` over slice topology, a JAX/XLA
+serving runtime, an HPO plane driving JaxJob trials, and — unlike the
+reference, which ships no numerics — the in-container runtime itself:
+named-axis meshes over ICI/DCN, pjit parallelism (DP/FSDP/TP/PP/SP/EP, ring
+attention), Orbax checkpointing, and an observability/bench harness.
+"""
+
+__version__ = "0.1.0"

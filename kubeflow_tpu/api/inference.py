@@ -1,0 +1,113 @@
+"""InferenceService / ServingRuntime — the serving plane's API objects.
+
+Capability parity with KServe [upstream: kserve/kserve ->
+pkg/apis/serving/v1beta1/inference_service.go and
+pkg/apis/serving/v1alpha1/servingruntime_types.go]: an InferenceService with
+predictor / transformer / explainer components, model-format -> runtime
+auto-selection against a registry of ServingRuntimes, a storage URI resolved
+by a storage initializer, and autoscaling targets.  The TPU-first divergence:
+runtimes name an in-process JAX predictor class (an XLA AOT-compiled
+callable) rather than a Triton/GPU container image — the north star's ``tpu``
+ServingRuntime [local: BASELINE.json].
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Any, Optional
+
+from pydantic import Field
+
+from .common import Resources, TypedObject, _Model
+
+KIND_INFERENCE_SERVICE = "InferenceService"
+KIND_SERVING_RUNTIME = "ServingRuntime"
+
+
+class ModelFormat(_Model):
+    name: str  # e.g. "jax", "flax-msgpack", "sklearn-json", "bert"
+    version: Optional[str] = None
+
+
+class ComponentSpec(_Model):
+    """One serving component (predictor/transformer/explainer)."""
+
+    model_format: Optional[ModelFormat] = None
+    storage_uri: Optional[str] = None  # file:// | mem:// | gs:// (stubbed)
+    runtime: Optional[str] = None  # explicit ServingRuntime name override
+    # "module:Class" for custom python components (transformer/explainer)
+    handler: Optional[str] = None
+    min_replicas: int = 1
+    max_replicas: int = 1
+    # target concurrent requests per replica before scaling out (knative
+    # KPA concurrency-target analog)
+    scale_target_concurrency: float = 4.0
+    resources: Resources = Field(default_factory=Resources)
+    batch_max_size: int = 8
+    batch_timeout_ms: float = 2.0
+    config: dict[str, Any] = Field(default_factory=dict)
+
+
+class InferenceServiceSpec(_Model):
+    predictor: ComponentSpec = Field(default_factory=ComponentSpec)
+    transformer: Optional[ComponentSpec] = None
+    explainer: Optional[ComponentSpec] = None
+
+
+class InferenceServicePhase(str, enum.Enum):
+    PENDING = "Pending"
+    LOADING = "Loading"
+    READY = "Ready"
+    FAILED = "Failed"
+
+
+class InferenceServiceStatus(_Model):
+    phase: InferenceServicePhase = InferenceServicePhase.PENDING
+    url: Optional[str] = None
+    active_replicas: int = 0
+    message: str = ""
+
+
+class InferenceService(TypedObject):
+    kind: str = KIND_INFERENCE_SERVICE
+    spec: InferenceServiceSpec = Field(default_factory=InferenceServiceSpec)
+    status: InferenceServiceStatus = Field(default_factory=InferenceServiceStatus)
+
+
+class SupportedModelFormat(_Model):
+    name: str
+    version: Optional[str] = None
+    auto_select: bool = True
+    priority: int = 1
+
+
+class ServingRuntimeSpec(_Model):
+    supported_model_formats: list[SupportedModelFormat] = Field(default_factory=list)
+    # python target "module:Class" implementing kubeflow_tpu.serving.model.Model
+    server_class: str = ""
+    # runtime-level defaults merged under component config
+    config: dict[str, Any] = Field(default_factory=dict)
+
+
+class ServingRuntime(TypedObject):
+    kind: str = KIND_SERVING_RUNTIME
+    spec: ServingRuntimeSpec = Field(default_factory=ServingRuntimeSpec)
+
+
+def select_runtime(
+    fmt: ModelFormat, runtimes: list[ServingRuntime]
+) -> Optional[ServingRuntime]:
+    """Model-format -> runtime auto-selection [upstream: kserve ->
+    pkg/apis/serving/v1beta1/predictor_model.go GetSupportingRuntimes]:
+    highest-priority runtime whose supported formats include the requested
+    name (and version when both specify one), auto_select only."""
+    best: tuple[int, Optional[ServingRuntime]] = (-1, None)
+    for rt in runtimes:
+        for sf in rt.spec.supported_model_formats:
+            if not sf.auto_select or sf.name != fmt.name:
+                continue
+            if fmt.version and sf.version and fmt.version != sf.version:
+                continue
+            if sf.priority > best[0]:
+                best = (sf.priority, rt)
+    return best[1]

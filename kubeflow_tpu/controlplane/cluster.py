@@ -1,0 +1,107 @@
+"""Cluster facade: wire the store, admission webhooks, scheduler, controllers.
+
+The ``cmd/training-operator.v1/main.go`` analog [upstream:
+kubeflow/training-operator]: one manager that registers schemes/webhooks and
+starts every reconciler, plus (unlike the reference, which assumes a real
+cluster underneath) the substrate itself — Nodes and a gang scheduler.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..api import (
+    default_experiment,
+    default_inference_service,
+    default_jaxjob,
+    validate_experiment,
+    validate_inference_service,
+    validate_jaxjob,
+)
+from ..api.common import ObjectMeta
+from ..api.experiment import KIND_EXPERIMENT
+from ..api.inference import KIND_INFERENCE_SERVICE
+from ..api.jaxjob import KIND_JAXJOB
+from .controller import Controller
+from .jaxjob_controller import JaxJobController
+from .objects import KIND_NODE, Node, NodeSpec
+from .scheduler import GangScheduler
+from .store import Store
+
+
+class Cluster:
+    def __init__(self) -> None:
+        self.store = Store()
+        self._register_admission()
+        self.scheduler = GangScheduler(self.store)
+        self.controllers: list[Controller] = [JaxJobController(self.store)]
+        self._started = False
+
+    def _register_admission(self) -> None:
+        s = self.store
+        s.register_admission(KIND_JAXJOB, mutate=default_jaxjob, validate=validate_jaxjob)
+        s.register_admission(
+            KIND_EXPERIMENT, mutate=default_experiment, validate=validate_experiment
+        )
+        s.register_admission(
+            KIND_INFERENCE_SERVICE,
+            mutate=default_inference_service,
+            validate=validate_inference_service,
+        )
+
+    def add_controller(self, c: Controller) -> None:
+        self.controllers.append(c)
+        if self._started:
+            c.start()
+
+    def add_node(
+        self,
+        name: str,
+        cpu: float = 64.0,
+        memory_gb: float = 128.0,
+        tpu: int = 0,
+        slice_id: str = "slice-0",
+    ) -> Node:
+        node = Node(
+            metadata=ObjectMeta(name=name),
+            spec=NodeSpec(
+                capacity={"cpu": cpu, "memory_gb": memory_gb, "tpu": float(tpu)},
+                slice_id=slice_id,
+            ),
+        )
+        created = self.store.create(node)
+        assert isinstance(created, Node)
+        return created
+
+    def add_tpu_slice(
+        self, slice_id: str, num_hosts: int, chips_per_host: int = 4
+    ) -> list[Node]:
+        """Model a TPU pod slice: ``num_hosts`` VMs sharing ICI, each exposing
+        ``chips_per_host`` chips (v5e default: 4 chips/VM, so v5e-16 = 4 hosts)."""
+        return [
+            self.add_node(
+                f"{slice_id}-host-{i}",
+                tpu=chips_per_host,
+                slice_id=slice_id,
+            )
+            for i in range(num_hosts)
+        ]
+
+    def start(self) -> None:
+        self.scheduler.start()
+        for c in self.controllers:
+            c.start()
+        self._started = True
+
+    def stop(self) -> None:
+        for c in self.controllers:
+            c.stop()
+        self.scheduler.stop()
+        self._started = False
+
+    def __enter__(self) -> "Cluster":
+        self.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

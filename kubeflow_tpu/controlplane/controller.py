@@ -1,0 +1,210 @@
+"""Controller runtime: watch-driven workqueue + reconcile loop.
+
+The controller-runtime analog [upstream: kubernetes-sigs/controller-runtime,
+as consumed by kubeflow/training-operator]: watch events enqueue object keys
+into a deduplicating workqueue; worker threads pop keys and call
+``reconcile(key)``; a reconcile may request requeue-after; errors requeue
+with exponential backoff.  Controllers also watch *owned* kinds (pods,
+services) and map those events back to the owner's key, exactly the
+``Owns(...)`` wiring in the reference's ``SetupWithManager``.
+"""
+
+from __future__ import annotations
+
+import heapq
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Iterable, Optional
+
+from ..api.common import TypedObject
+from .objects import Event, KIND_EVENT
+from .store import DELETED, Store, WatchEvent
+
+log = logging.getLogger("kubeflow_tpu.controlplane")
+
+
+@dataclass(order=True)
+class _QueueItem:
+    at: float
+    key: str = field(compare=False)
+
+
+class WorkQueue:
+    """Deduplicating delay queue (client-go workqueue analog)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Condition()
+        self._heap: list[_QueueItem] = []
+        #: key -> earliest scheduled fire time among queued entries; an add
+        #: only dedups against an entry that would fire sooner-or-equal, so
+        #: an immediate add always tightens a far-future TTL requeue
+        #: (client-go Add vs AddAfter semantics).
+        self._queued: dict[str, float] = {}
+
+    def add(self, key: str, delay: float = 0.0) -> None:
+        at = time.time() + delay
+        with self._lock:
+            earliest = self._queued.get(key)
+            if earliest is not None and earliest <= at:
+                return
+            heapq.heappush(self._heap, _QueueItem(at, key))
+            self._queued[key] = at
+            self._lock.notify()
+
+    def get(self, timeout: float = 0.2) -> Optional[str]:
+        with self._lock:
+            deadline = time.time() + timeout
+            while True:
+                now = time.time()
+                if self._heap and self._heap[0].at <= now:
+                    item = heapq.heappop(self._heap)
+                    remaining = [it.at for it in self._heap if it.key == item.key]
+                    if remaining:
+                        self._queued[item.key] = min(remaining)
+                    else:
+                        self._queued.pop(item.key, None)
+                    return item.key
+                wait = min(
+                    self._heap[0].at - now if self._heap else timeout,
+                    deadline - now,
+                )
+                if wait <= 0:
+                    return None
+                self._lock.wait(wait)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._heap)
+
+
+@dataclass
+class Result:
+    requeue_after: Optional[float] = None
+
+
+class Controller:
+    """Base reconciler.  Subclasses set ``kind``, ``owned_kinds`` and
+    implement ``reconcile(namespace, name) -> Optional[Result]``."""
+
+    kind: str = ""
+    owned_kinds: tuple[str, ...] = ()
+    workers: int = 1
+
+    def __init__(self, store: Store) -> None:
+        self.store = store
+        self.queue = WorkQueue()
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+        self._watch = None
+        self._backoff: dict[str, float] = {}
+
+    # -- event -> key mapping --------------------------------------------------
+
+    def owner_key_for(self, obj: TypedObject) -> Optional[str]:
+        """Map an owned object's event to its controller's key via
+        owner_references (the ``Owns()`` handler)."""
+        for ref in obj.metadata.owner_references:
+            if ref.kind == self.kind and ref.controller:
+                return f"{obj.metadata.namespace}/{ref.name}"
+        return None
+
+    def observe(self, ev: WatchEvent) -> None:
+        """Hook for expectation accounting; called for every owned event."""
+
+    # -- lifecycle -------------------------------------------------------------
+
+    def start(self) -> None:
+        kinds = (self.kind, *self.owned_kinds)
+        self._watch = self.store.watch(kinds)
+        # prime: enqueue existing objects (informer initial list)
+        for obj in self.store.list(self.kind):
+            self.queue.add(obj.key)
+        t = threading.Thread(target=self._watch_loop, name=f"{self.kind}-watch", daemon=True)
+        t.start()
+        self._threads.append(t)
+        for i in range(self.workers):
+            t = threading.Thread(
+                target=self._worker_loop, name=f"{self.kind}-worker-{i}", daemon=True
+            )
+            t.start()
+            self._threads.append(t)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._watch is not None:
+            self.store.stop_watch(self._watch)
+        for t in self._threads:
+            t.join(timeout=5)
+
+    def _watch_loop(self) -> None:
+        assert self._watch is not None
+        while not self._stop.is_set():
+            try:
+                ev = self._watch.q.get(timeout=0.2)
+            except Exception:  # queue.Empty
+                continue
+            if ev.obj.kind == self.kind:
+                self.queue.add(ev.obj.key)
+            else:
+                self.observe(ev)
+                key = self.owner_key_for(ev.obj)
+                if key:
+                    self.queue.add(key)
+
+    def _worker_loop(self) -> None:
+        while not self._stop.is_set():
+            key = self.queue.get(timeout=0.2)
+            if key is None:
+                continue
+            ns, name = key.split("/", 1)
+            try:
+                res = self.reconcile(ns, name)
+            except Exception:  # noqa: BLE001
+                log.exception("reconcile %s %s failed", self.kind, key)
+                back = min(self._backoff.get(key, 0.05) * 2, 5.0)
+                self._backoff[key] = back
+                self.queue.add(key, delay=back)
+                continue
+            self._backoff.pop(key, None)
+            if res and res.requeue_after is not None:
+                self.queue.add(key, delay=res.requeue_after)
+
+    # -- to implement ----------------------------------------------------------
+
+    def reconcile(self, namespace: str, name: str) -> Optional[Result]:
+        raise NotImplementedError
+
+    # -- events (kubectl describe UX) -----------------------------------------
+
+    def emit_event(
+        self, obj: TypedObject, reason: str, message: str, type_: str = "Normal"
+    ) -> None:
+        from ..api.common import ObjectMeta
+
+        name = f"{obj.metadata.name}-{reason.lower()}-{int(time.time() * 1000) % 1_000_000}"
+        try:
+            self.store.create(
+                Event(
+                    metadata=ObjectMeta(name=name, namespace=obj.metadata.namespace),
+                    involved_kind=obj.kind,
+                    involved_name=obj.metadata.name,
+                    reason=reason,
+                    message=message,
+                    type=type_,
+                )
+            )
+        except Exception:  # noqa: BLE001 — events are best-effort
+            pass
+
+
+def events_for(store: Store, kind: str, name: str) -> list[Event]:
+    return sorted(
+        (
+            e
+            for e in store.list(KIND_EVENT)
+            if isinstance(e, Event) and e.involved_kind == kind and e.involved_name == name
+        ),
+        key=lambda e: e.timestamp,
+    )

@@ -1,0 +1,104 @@
+"""FakeKubelet: drives bound pods through phases without real processes.
+
+The envtest gap-filler (SURVEY.md §4): upstream controller tests create pods
+that never run because envtest has no kubelet; gang-startup latency and
+restart policies then go untested.  This kubelet simulator runs bound pods to
+a scripted outcome (success, exit code, hang) so reconciler + scheduler
+behavior — including failure/restart paths — is testable deterministically.
+The *real* kubelet is ``kubeflow_tpu.runtime.launcher``, which runs actual
+processes.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from .objects import KIND_POD, Pod, PodPhase
+from .store import NotFound, Store
+
+
+@dataclass
+class PodScript:
+    """What happens to a pod once it starts."""
+
+    run_seconds: float = 0.0
+    exit_code: int = 0
+    barrier_after: Optional[float] = 0.0  # None = never reaches the barrier
+    hang: bool = False
+
+
+DEFAULT_SCRIPT = PodScript()
+
+ScriptFn = Callable[[Pod], PodScript]
+
+
+class FakeKubelet:
+    def __init__(self, store: Store, script: Optional[ScriptFn] = None, interval: float = 0.01):
+        self.store = store
+        self.script: ScriptFn = script or (lambda pod: DEFAULT_SCRIPT)
+        self.interval = interval
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._running: dict[str, tuple[float, PodScript]] = {}  # key -> (start, script)
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._loop, name="fake-kubelet", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.step()
+            except Exception:  # noqa: BLE001
+                pass
+            self._stop.wait(self.interval)
+
+    def step(self) -> None:
+        now = time.time()
+        for pod in self.store.list(KIND_POD):
+            assert isinstance(pod, Pod)
+            key = f"{pod.metadata.namespace}/{pod.metadata.name}/{pod.metadata.uid}"
+            if pod.status.phase == PodPhase.PENDING and pod.spec.node_name:
+                script = self.script(pod)
+                self._running[key] = (now, script)
+                self._mutate(pod, lambda o: self._start(o, now, script))
+            elif pod.status.phase == PodPhase.RUNNING and key in self._running:
+                start, script = self._running[key]
+                if script.hang:
+                    continue
+                if now - start >= script.run_seconds:
+                    del self._running[key]
+                    self._mutate(pod, lambda o: self._finish(o, script, now))
+
+    @staticmethod
+    def _start(pod: Pod, now: float, script: PodScript) -> None:
+        pod.status.phase = PodPhase.RUNNING
+        pod.status.start_time = now
+        if script.barrier_after is not None and script.barrier_after <= 0:
+            pod.status.barrier_time = now
+
+    @staticmethod
+    def _finish(pod: Pod, script: PodScript, now: float) -> None:
+        if script.barrier_after is not None and pod.status.barrier_time is None:
+            pod.status.barrier_time = (pod.status.start_time or now) + script.barrier_after
+        pod.status.phase = PodPhase.SUCCEEDED if script.exit_code == 0 else PodPhase.FAILED
+        pod.status.exit_code = script.exit_code
+        pod.status.finish_time = now
+
+    def _mutate(self, pod: Pod, fn) -> None:
+        try:
+            self.store.update_with_retry(
+                KIND_POD, pod.metadata.name, pod.metadata.namespace, fn
+            )
+        except NotFound:
+            self._running.pop(
+                f"{pod.metadata.namespace}/{pod.metadata.name}/{pod.metadata.uid}", None
+            )

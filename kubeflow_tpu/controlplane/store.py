@@ -1,0 +1,220 @@
+"""In-process API server: typed object store with watches and admission.
+
+The envtest analog from SURVEY.md §4: a real state store + watch semantics so
+reconcilers run deterministically without Kubernetes.  Semantics kept from
+the real API server because the reference's controllers depend on them:
+
+- optimistic concurrency (``resource_version`` bump per write; stale updates
+  raise ``Conflict``) — the races the reference's expectations cache exists
+  to tame happen here too, on purpose;
+- admission hooks per kind (mutating defaulting then validating), the webhook
+  layer [upstream: training-operator -> pkg/webhooks/];
+- watch streams with ADDED/MODIFIED/DELETED events fanned out to subscriber
+  queues (the informer analog).
+"""
+
+from __future__ import annotations
+
+import copy
+import itertools
+import queue
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Callable, Iterable, Optional
+
+from ..api.common import TypedObject, object_key
+
+
+class ApiError(Exception):
+    pass
+
+
+class NotFound(ApiError):
+    pass
+
+
+class AlreadyExists(ApiError):
+    pass
+
+
+class Conflict(ApiError):
+    """resource_version mismatch — caller must re-read and retry."""
+
+
+class Rejected(ApiError):
+    """Admission (validating webhook) rejection."""
+
+
+ADDED = "ADDED"
+MODIFIED = "MODIFIED"
+DELETED = "DELETED"
+
+
+@dataclass
+class WatchEvent:
+    type: str  # ADDED | MODIFIED | DELETED
+    obj: TypedObject
+
+
+@dataclass
+class _Watch:
+    kinds: frozenset[str]
+    q: "queue.Queue[WatchEvent]" = field(default_factory=queue.Queue)
+    closed: bool = False
+
+
+MutatingHook = Callable[[TypedObject], TypedObject]
+ValidatingHook = Callable[[TypedObject], None]
+
+
+class Store:
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._objs: dict[tuple[str, str], TypedObject] = {}  # (kind, ns/name)
+        self._rv = itertools.count(1)
+        self._watches: list[_Watch] = []
+        self._mutators: dict[str, list[MutatingHook]] = {}
+        self._validators: dict[str, list[ValidatingHook]] = {}
+
+    # -- admission registration ------------------------------------------------
+
+    def register_admission(
+        self,
+        kind: str,
+        mutate: Optional[MutatingHook] = None,
+        validate: Optional[ValidatingHook] = None,
+    ) -> None:
+        if mutate:
+            self._mutators.setdefault(kind, []).append(mutate)
+        if validate:
+            self._validators.setdefault(kind, []).append(validate)
+
+    def _admit(self, obj: TypedObject) -> TypedObject:
+        for m in self._mutators.get(obj.kind, []):
+            obj = m(obj) or obj
+        for v in self._validators.get(obj.kind, []):
+            try:
+                v(obj)
+            except Exception as e:  # noqa: BLE001 — admission wraps any failure
+                raise Rejected(str(e)) from e
+        return obj
+
+    # -- CRUD ------------------------------------------------------------------
+
+    def create(self, obj: TypedObject) -> TypedObject:
+        obj = copy.deepcopy(obj)
+        obj = self._admit(obj)
+        with self._lock:
+            k = (obj.kind, obj.key)
+            if k in self._objs:
+                raise AlreadyExists(f"{obj.kind} {obj.key} exists")
+            obj.metadata.uid = obj.metadata.uid or uuid.uuid4().hex[:12]
+            obj.metadata.resource_version = next(self._rv)
+            obj.metadata.creation_timestamp = (
+                obj.metadata.creation_timestamp or time.time()
+            )
+            self._objs[k] = obj
+            self._notify(WatchEvent(ADDED, copy.deepcopy(obj)))
+        return copy.deepcopy(obj)
+
+    def get(self, kind: str, name: str, namespace: str = "default") -> TypedObject:
+        with self._lock:
+            k = (kind, object_key(namespace, name))
+            if k not in self._objs:
+                raise NotFound(f"{kind} {namespace}/{name}")
+            return copy.deepcopy(self._objs[k])
+
+    def try_get(self, kind: str, name: str, namespace: str = "default"):
+        try:
+            return self.get(kind, name, namespace)
+        except NotFound:
+            return None
+
+    def update(self, obj: TypedObject) -> TypedObject:
+        obj = copy.deepcopy(obj)
+        obj = self._admit(obj)  # webhooks run on UPDATE too, like the real apiserver
+        with self._lock:
+            k = (obj.kind, obj.key)
+            cur = self._objs.get(k)
+            if cur is None:
+                raise NotFound(f"{obj.kind} {obj.key}")
+            if obj.metadata.resource_version != cur.metadata.resource_version:
+                raise Conflict(
+                    f"{obj.kind} {obj.key}: rv {obj.metadata.resource_version} "
+                    f"!= {cur.metadata.resource_version}"
+                )
+            obj.metadata.resource_version = next(self._rv)
+            self._objs[k] = obj
+            self._notify(WatchEvent(MODIFIED, copy.deepcopy(obj)))
+        return copy.deepcopy(obj)
+
+    def update_with_retry(
+        self, kind: str, name: str, namespace: str, fn: Callable[[TypedObject], None],
+        attempts: int = 8,
+    ) -> TypedObject:
+        """Read-modify-write with conflict retry (client-go UpdateStatus idiom)."""
+        last: Optional[Exception] = None
+        for _ in range(attempts):
+            obj = self.get(kind, name, namespace)
+            fn(obj)
+            try:
+                return self.update(obj)
+            except Conflict as e:
+                last = e
+        raise last  # type: ignore[misc]
+
+    def delete(self, kind: str, name: str, namespace: str = "default") -> None:
+        with self._lock:
+            k = (kind, object_key(namespace, name))
+            obj = self._objs.pop(k, None)
+            if obj is None:
+                raise NotFound(f"{kind} {namespace}/{name}")
+            self._notify(WatchEvent(DELETED, copy.deepcopy(obj)))
+
+    def try_delete(self, kind: str, name: str, namespace: str = "default") -> bool:
+        try:
+            self.delete(kind, name, namespace)
+            return True
+        except NotFound:
+            return False
+
+    def list(
+        self,
+        kind: str,
+        namespace: Optional[str] = None,
+        labels: Optional[dict[str, str]] = None,
+    ) -> list[TypedObject]:
+        with self._lock:
+            out = []
+            for (k, _), obj in self._objs.items():
+                if k != kind:
+                    continue
+                if namespace and obj.metadata.namespace != namespace:
+                    continue
+                if labels and any(
+                    obj.metadata.labels.get(lk) != lv for lk, lv in labels.items()
+                ):
+                    continue
+                out.append(copy.deepcopy(obj))
+            return sorted(out, key=lambda o: o.metadata.name)
+
+    # -- watches ---------------------------------------------------------------
+
+    def watch(self, kinds: Iterable[str]) -> "_Watch":
+        w = _Watch(kinds=frozenset(kinds))
+        with self._lock:
+            self._watches.append(w)
+        return w
+
+    def stop_watch(self, w: "_Watch") -> None:
+        with self._lock:
+            w.closed = True
+            if w in self._watches:
+                self._watches.remove(w)
+
+    def _notify(self, ev: WatchEvent) -> None:
+        for w in self._watches:
+            if not w.closed and ev.obj.kind in w.kinds:
+                w.q.put(ev)

@@ -1,0 +1,86 @@
+"""MNIST-class smoke model: tiny MLP, data-parallel pjit training.
+
+The baseline config-1 equivalent (BASELINE.md row 1: "TFJob MNIST
+single-worker" — here a JaxJob on any world size).  Synthetic data from a
+fixed linear teacher keeps the e2e hermetic (no dataset downloads; the
+reference's MNIST examples fetch from the network, which this environment
+forbids).  The ``train_main`` entrypoint is what JaxJob manifests reference
+as ``kubeflow_tpu.models.mnist:train_main``.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import linen as nn
+
+from ..parallel import mesh as meshlib
+from ..runtime import bootstrap
+
+IMAGE_DIM = 64
+NUM_CLASSES = 10
+
+
+class MLP(nn.Module):
+    hidden: int = 128
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.Dense(self.hidden)(x)
+        x = nn.relu(x)
+        x = nn.Dense(NUM_CLASSES)(x)
+        return x
+
+
+def synthetic_batch(key: jax.Array, batch: int):
+    """Deterministic teacher: labels from a fixed random projection."""
+    kx, _ = jax.random.split(key)
+    x = jax.random.normal(kx, (batch, IMAGE_DIM))
+    teacher = jax.random.normal(jax.random.PRNGKey(7), (IMAGE_DIM, NUM_CLASSES))
+    y = jnp.argmax(x @ teacher, axis=-1)
+    return x, y
+
+
+def train_main(ctx: "bootstrap.PodContext") -> None:
+    """Entrypoint for JaxJob pods: DP training over the job's global mesh."""
+    steps = int(os.environ.get("KFT_STEPS", "30"))
+    global_batch = int(os.environ.get("KFT_BATCH", "64"))
+    lr = float(os.environ.get("KFT_LR", "0.05"))
+
+    mesh = meshlib.build_mesh(ctx.mesh_axes or {"data": jax.device_count()})
+    x_shard = meshlib.batch_sharding(mesh)
+    model = MLP()
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, IMAGE_DIM)))
+    tx = optax.sgd(lr, momentum=0.9)
+    opt_state = tx.init(params)
+
+    # replicate params/opt-state across the mesh
+    rep = meshlib.replicated(mesh)
+    params = jax.device_put(params, rep)
+    opt_state = jax.device_put(opt_state, rep)
+
+    @jax.jit
+    def step(params, opt_state, x, y):
+        def loss_fn(p):
+            logits = model.apply(p, x)
+            loss = optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+            acc = (jnp.argmax(logits, -1) == y).mean()
+            return loss, acc
+
+        (loss, acc), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss, acc
+
+    local_bs = meshlib.local_batch_size(mesh, global_batch)
+    loss = acc = None
+    for i in range(steps):
+        key = jax.random.fold_in(jax.random.PRNGKey(1), i * ctx.num_processes + ctx.process_id)
+        x_local, y_local = synthetic_batch(key, local_bs)
+        x = jax.make_array_from_process_local_data(x_shard, jax.device_get(x_local))
+        y = jax.make_array_from_process_local_data(x_shard, jax.device_get(y_local))
+        params, opt_state, loss, acc = step(params, opt_state, x, y)
+    bootstrap.emit_metric(ctx, "loss", float(loss))
+    bootstrap.emit_metric(ctx, "accuracy", float(acc))

@@ -1,0 +1,15 @@
+"""Mesh construction and parallelism strategies."""
+
+from .mesh import (
+    AXIS_ORDER,
+    BATCH_AXES,
+    MeshPlan,
+    MeshPlanError,
+    batch_sharding,
+    build_mesh,
+    local_batch_size,
+    plan_mesh,
+    replicated,
+)
+
+__all__ = [k for k in dir() if not k.startswith("_")]

@@ -1,0 +1,176 @@
+"""Named-axis device mesh construction with ICI-vs-DCN placement.
+
+The communication-backend equivalent (SURVEY.md §2.6): the reference
+configures NCCL/MPI/gRPC rendezvous but never performs collectives; here,
+after ``jax.distributed.initialize``, the mesh *is* the communication
+backend — XLA lowers collectives onto ICI (intra-slice torus) or DCN
+(inter-slice) purely from how axes are laid over devices.
+
+Axis vocabulary (canonical order, outermost first):
+
+- ``replica``  — pure data parallelism across slices (DCN-friendly: one
+  gradient all-reduce per step amortized over the whole step)
+- ``data``     — data parallelism (batch sharding)
+- ``fsdp``     — data parallelism + ZeRO-3 weight sharding
+- ``pipeline`` — pipeline stages (DCN-friendly: activations cross stages
+  once per microbatch, collective-permute)
+- ``expert``   — MoE expert parallelism (all-to-all dispatch)
+- ``seq``      — sequence/context parallelism (ring attention KV permutes)
+- ``model``    — tensor parallelism (per-layer all-reduce/all-gather —
+  bandwidth-hungry, must ride ICI)
+
+The placement rule the builder enforces: DCN-tolerant axes (``replica``,
+``pipeline``) go over slice boundaries; bandwidth-hungry axes (``model``,
+``seq``, ``expert``) must fit inside a slice.  This is the "pick a mesh,
+annotate shardings, let XLA insert collectives" recipe of the scaling
+playbook, made a typed policy.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+#: Canonical mesh-axis order, outermost (most DCN-tolerant) first.
+AXIS_ORDER = ("replica", "data", "fsdp", "pipeline", "expert", "seq", "model")
+
+#: Axes whose collectives amortize well over slow links (DCN).
+DCN_TOLERANT_AXES = ("replica", "pipeline", "data")
+
+#: Axes that shard the batch dimension (their product is the data-parallel
+#: degree for input pipelines and loss scaling).
+BATCH_AXES = ("replica", "data", "fsdp")
+
+
+class MeshPlanError(ValueError):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshPlan:
+    """A validated axis->size assignment plus its ICI/DCN split."""
+
+    axes: dict[str, int]
+    ici_axes: dict[str, int]
+    dcn_axes: dict[str, int]
+
+    @property
+    def axis_names(self) -> tuple[str, ...]:
+        return tuple(self.axes)
+
+    @property
+    def shape(self) -> tuple[int, ...]:
+        return tuple(self.axes.values())
+
+    @property
+    def num_devices(self) -> int:
+        return math.prod(self.shape)
+
+    @property
+    def batch_degree(self) -> int:
+        return math.prod(s for a, s in self.axes.items() if a in BATCH_AXES)
+
+
+def _ordered(axes: dict[str, int]) -> dict[str, int]:
+    unknown = [a for a in axes if a not in AXIS_ORDER]
+    if unknown:
+        raise MeshPlanError(f"unknown mesh axes {unknown}; known: {AXIS_ORDER}")
+    return {a: axes[a] for a in AXIS_ORDER if a in axes}
+
+
+def plan_mesh(
+    axes: dict[str, int],
+    num_devices: Optional[int] = None,
+    num_slices: int = 1,
+) -> MeshPlan:
+    """Validate axis sizes against the device count and split ICI vs DCN.
+
+    With ``num_slices > 1`` the outermost axes (in canonical order) are
+    assigned to DCN until the per-slice product fits one slice; a
+    bandwidth-hungry axis landing on DCN is an error, not a warning —
+    mis-placement silently destroys step time, so it must not compile.
+    """
+    axes = _ordered({a: s for a, s in axes.items() if s != 1} or {"data": 1})
+    total = math.prod(axes.values())
+    if num_devices is not None and total != num_devices:
+        raise MeshPlanError(f"mesh {axes} needs {total} devices, have {num_devices}")
+    ici: dict[str, int] = dict(axes)
+    dcn: dict[str, int] = {}
+    if num_slices > 1:
+        remaining = num_slices
+        for a in list(axes):
+            if remaining == 1:
+                break
+            s = axes[a]
+            take = math.gcd(s, remaining)
+            if take > 1:
+                if a not in DCN_TOLERANT_AXES:
+                    raise MeshPlanError(
+                        f"axis {a!r} (size {s}) would span {take} slices over DCN; "
+                        f"only {DCN_TOLERANT_AXES} may cross slice boundaries"
+                    )
+                dcn[a] = take
+                ici[a] = s // take
+                remaining //= take
+        if remaining != 1:
+            raise MeshPlanError(
+                f"cannot factor {num_slices} slices into DCN-tolerant axes of {axes}"
+            )
+        ici = {a: s for a, s in ici.items() if s != 1}
+    return MeshPlan(axes=axes, ici_axes=ici, dcn_axes=dcn)
+
+
+def build_mesh(
+    axes: dict[str, int],
+    devices: Optional[Sequence[jax.Device]] = None,
+    num_slices: int = 1,
+) -> Mesh:
+    """Build a ``jax.sharding.Mesh`` for the plan.
+
+    Single-slice: ``mesh_utils.create_device_mesh`` (ICI-topology-aware
+    ordering on TPU; plain reshape on CPU).  Multi-slice:
+    ``create_hybrid_device_mesh`` with the plan's DCN factors outermost.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    plan = plan_mesh(axes, num_devices=len(devices), num_slices=num_slices)
+    if plan.dcn_axes:
+        per_slice = tuple(
+            plan.ici_axes.get(a, 1) for a in plan.axis_names
+        )
+        dcn = tuple(plan.dcn_axes.get(a, 1) for a in plan.axis_names)
+        dev_array = mesh_utils.create_hybrid_device_mesh(
+            per_slice, dcn, devices=devices, allow_split_physical_axes=True
+        )
+    else:
+        try:
+            dev_array = mesh_utils.create_device_mesh(
+                plan.shape, devices=np.array(devices), allow_split_physical_axes=True
+            )
+        except (ValueError, AssertionError):
+            dev_array = np.array(devices).reshape(plan.shape)
+    return Mesh(dev_array, plan.axis_names)
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Input-batch sharding: batch dim over every batch-like axis present."""
+    batch_axes = tuple(a for a in mesh.axis_names if a in BATCH_AXES)
+    return NamedSharding(mesh, PartitionSpec(batch_axes if batch_axes else None))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def local_batch_size(mesh: Mesh, global_batch: int) -> int:
+    """Per-process batch share for input pipelines (SURVEY.md §2.5 DP row:
+    per-host loading keyed by process index)."""
+    n = jax.process_count()
+    if global_batch % n:
+        raise MeshPlanError(f"global batch {global_batch} not divisible by {n} processes")
+    return global_batch // n

@@ -1,0 +1,7 @@
+"""In-pod runtime: bootstrap, local kubelet, platform facade."""
+
+from .bootstrap import PodContext, barrier, emit_metric, initialize
+from .launcher import LocalKubelet
+from .platform import LocalPlatform
+
+__all__ = [k for k in dir() if not k.startswith("_")]

@@ -1,0 +1,136 @@
+"""In-pod runtime bootstrap: env contract -> jax.distributed -> mesh.
+
+The L3 runtime-glue layer (SURVEY.md §1) done TPU-natively: where the
+reference injects ``MASTER_ADDR``/``RANK``/``WORLD_SIZE`` for
+``torch.distributed.init_process_group("nccl")`` or ``TF_CONFIG`` for TF
+[upstream: kubeflow/training-operator -> pkg/controller.v1/pytorch/envvar.go,
+tensorflow/], this module consumes the ``jax.distributed.initialize`` triple
+the JaxJob controller injects and stands up the global device mesh.  After
+``initialize`` returns, XLA owns every collective over ICI/DCN — there is no
+NCCL, hostfile, or ssh equivalent to manage (SURVEY.md §2.6).
+
+Also home of the gang-startup probe: ``barrier()`` runs the first global
+collective and stamps a status file the kubelet folds into
+``Pod.status.barrier_time`` -> ``JaxJob.status.gang_startup_seconds``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+#: Env contract — must match kubeflow_tpu.controlplane.jaxjob_controller.
+ENV_COORDINATOR_ADDRESS = "JAX_COORDINATOR_ADDRESS"
+ENV_NUM_PROCESSES = "JAX_NUM_PROCESSES"
+ENV_PROCESS_ID = "JAX_PROCESS_ID"
+ENV_JOB_NAME = "KFT_JOB_NAME"
+ENV_JOB_NAMESPACE = "KFT_JOB_NAMESPACE"
+ENV_REPLICA_TYPE = "KFT_REPLICA_TYPE"
+ENV_REPLICA_INDEX = "KFT_REPLICA_INDEX"
+ENV_MESH = "KFT_MESH"
+ENV_STATUS_DIR = "KFT_STATUS_DIR"
+ENV_ENTRYPOINT = "KFT_ENTRYPOINT"
+
+BARRIER_FILE = "barrier"
+METRICS_FILE = "metrics.jsonl"
+
+
+@dataclass
+class PodContext:
+    """Everything a training process knows about itself, parsed from env."""
+
+    job_name: str = "local"
+    namespace: str = "default"
+    replica_type: str = "worker"
+    replica_index: int = 0
+    process_id: int = 0
+    num_processes: int = 1
+    coordinator_address: Optional[str] = None
+    mesh_axes: dict[str, int] = field(default_factory=dict)
+    status_dir: Optional[str] = None
+
+    @classmethod
+    def from_env(cls, env: Optional[dict[str, str]] = None) -> "PodContext":
+        e = dict(os.environ if env is None else env)
+        mesh = {}
+        if e.get(ENV_MESH):
+            mesh = {k: int(v) for k, v in json.loads(e[ENV_MESH]).items()}
+        return cls(
+            job_name=e.get(ENV_JOB_NAME, "local"),
+            namespace=e.get(ENV_JOB_NAMESPACE, "default"),
+            replica_type=e.get(ENV_REPLICA_TYPE, "worker"),
+            replica_index=int(e.get(ENV_REPLICA_INDEX, "0")),
+            process_id=int(e.get(ENV_PROCESS_ID, "0")),
+            num_processes=int(e.get(ENV_NUM_PROCESSES, "1")),
+            coordinator_address=e.get(ENV_COORDINATOR_ADDRESS),
+            mesh_axes=mesh,
+            status_dir=e.get(ENV_STATUS_DIR),
+        )
+
+    @property
+    def is_coordinator(self) -> bool:
+        return self.process_id == 0
+
+
+def resolve_coordinator(address: str) -> str:
+    """Map cluster DNS to something dialable.  In-cluster, the headless
+    Service name resolves naturally; under the local process runtime,
+    ``<pod>.<ns>.svc`` hosts all live on this machine -> 127.0.0.1."""
+    host, _, port = address.rpartition(":")
+    if host.endswith(".svc") or host.endswith(".svc.cluster.local"):
+        host = "127.0.0.1"
+    return f"{host}:{port}"
+
+
+def initialize(ctx: Optional[PodContext] = None) -> PodContext:
+    """Join the job's collective: the TPU-native rendezvous.
+
+    Single-process jobs skip the coordination service entirely (the TFJob
+    MNIST smoke-config path).  Multi-process jobs dial the coordinator;
+    process 0 *is* the coordinator (rank-0-as-coordinator, the JAXJob
+    controller convention).
+    """
+    ctx = ctx or PodContext.from_env()
+    if ctx.num_processes > 1:
+        import jax
+
+        assert ctx.coordinator_address, "multi-process job missing coordinator address"
+        jax.distributed.initialize(
+            coordinator_address=resolve_coordinator(ctx.coordinator_address),
+            num_processes=ctx.num_processes,
+            process_id=ctx.process_id,
+        )
+    return ctx
+
+
+def barrier(ctx: PodContext) -> float:
+    """First global collective; stamps the gang-startup probe file."""
+    # a real global collective across every process, not just a
+    # coordination-service ping: proving device-level collectives work is
+    # what "the gang is up" means
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices(f"{ctx.job_name}-gang-barrier")
+    t = time.time()
+    if ctx.status_dir:
+        os.makedirs(ctx.status_dir, exist_ok=True)
+        with open(os.path.join(ctx.status_dir, BARRIER_FILE), "w") as f:
+            f.write(repr(t))
+    return t
+
+
+def emit_metric(ctx: PodContext, name: str, value: float, **extra) -> None:
+    """Append a metric line to the pod's status stream AND stdout.
+
+    Stdout is the Katib-style collector contract (``name=value``); the
+    status-dir jsonl is the structured channel the metrics collector scrapes
+    without parsing logs (SURVEY.md §5 observability).
+    """
+    print(f"{name}={value}", flush=True)
+    if ctx.status_dir:
+        os.makedirs(ctx.status_dir, exist_ok=True)
+        with open(os.path.join(ctx.status_dir, METRICS_FILE), "a") as f:
+            f.write(json.dumps({"name": name, "value": value, "ts": time.time(), **extra}) + "\n")

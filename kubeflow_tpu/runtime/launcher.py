@@ -1,0 +1,248 @@
+"""LocalKubelet: runs bound Pods as real OS processes.
+
+The kubelet tier the reference outsources to Kubernetes (SURVEY.md §4:
+"multi-process JAX e2e on CPU ... the honest stand-in for multi-host TPU").
+Responsibilities, mirroring a real kubelet + the operator's pod watching:
+
+- spawn a process per bound pod (env from the pod template + the status-dir
+  contract), capture stdout/stderr to per-pod log files (the ``kubectl
+  logs`` surface the SDK and the HPO metrics collector read);
+- poll liveness; fold exit codes into ``Pod.status`` (phase, exit_code);
+- surface the gang-barrier stamp from the status dir into
+  ``Pod.status.barrier_time`` (gang-startup metric source);
+- kill processes whose pods are deleted (suspend, gang restart, cleanup) —
+  the SIGTERM-then-SIGKILL grace path.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import threading
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+from ..controlplane.objects import KIND_POD, Pod, PodPhase
+from ..controlplane.store import DELETED, NotFound, Store, WatchEvent
+from . import bootstrap
+
+log = logging.getLogger("kubeflow_tpu.kubelet")
+
+GRACE_SECONDS = 3.0
+
+
+@dataclass
+class _Proc:
+    popen: subprocess.Popen
+    pod_uid: str
+    status_dir: str
+    log_path: str
+    barrier_reported: bool = False
+
+
+class LocalKubelet:
+    def __init__(
+        self,
+        store: Store,
+        root_dir: str,
+        node_names: Optional[set[str]] = None,
+        interval: float = 0.03,
+        env_overrides: Optional[dict[str, str]] = None,
+    ) -> None:
+        self.store = store
+        self.root_dir = root_dir
+        self.node_names = node_names  # None = adopt every bound pod
+        self.interval = interval
+        self.env_overrides = env_overrides or {}
+        self._procs: dict[str, _Proc] = {}  # ns/name -> proc
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._watch = None
+        os.makedirs(self.logs_dir, exist_ok=True)
+
+    @property
+    def logs_dir(self) -> str:
+        return os.path.join(self.root_dir, "logs")
+
+    def pod_log_path(self, namespace: str, name: str) -> str:
+        return os.path.join(self.logs_dir, namespace, f"{name}.log")
+
+    # -- lifecycle -------------------------------------------------------------
+
+    def start(self) -> None:
+        self._watch = self.store.watch([KIND_POD])
+        self._thread = threading.Thread(target=self._loop, name="local-kubelet", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=10)
+        if self._watch is not None:
+            self.store.stop_watch(self._watch)
+        for key in list(self._procs):
+            self._kill(key)
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self._drain_deletions()
+                self.step()
+            except Exception:  # noqa: BLE001
+                log.exception("kubelet step failed")
+            self._stop.wait(self.interval)
+
+    def _drain_deletions(self) -> None:
+        assert self._watch is not None
+        while True:
+            try:
+                ev: WatchEvent = self._watch.q.get_nowait()
+            except Exception:  # queue.Empty
+                return
+            if ev.type == DELETED and ev.obj.kind == KIND_POD:
+                self._kill(ev.obj.key)
+
+    # -- core ------------------------------------------------------------------
+
+    def step(self) -> None:
+        for pod in self.store.list(KIND_POD):
+            assert isinstance(pod, Pod)
+            if self.node_names is not None and pod.spec.node_name not in self.node_names:
+                continue
+            key = pod.key
+            if pod.status.phase == PodPhase.PENDING and pod.spec.node_name:
+                if key not in self._procs:
+                    self._spawn(pod)
+            elif pod.status.phase == PodPhase.RUNNING:
+                self._check(pod)
+
+    def _build_env(self, pod: Pod, status_dir: str) -> dict[str, str]:
+        base_keys = ("PATH", "HOME", "PYTHONPATH", "TMPDIR", "LD_LIBRARY_PATH")
+        env = {k: os.environ[k] for k in base_keys if k in os.environ}
+        env["PYTHONPATH"] = os.pathsep.join(
+            p for p in (os.getcwd(), env.get("PYTHONPATH")) if p
+        )
+        # default the pod to the CPU backend unless its template says
+        # otherwise — N pod processes sharing one TPU chip would all try to
+        # grab it; TPU execution is the flagship trainer's direct path
+        env.setdefault("JAX_PLATFORMS", os.environ.get("KFT_POD_JAX_PLATFORMS", "cpu"))
+        env.update(pod.spec.container.env)
+        env.update(self.env_overrides)
+        env[bootstrap.ENV_STATUS_DIR] = status_dir
+        if pod.spec.container.entrypoint:
+            env[bootstrap.ENV_ENTRYPOINT] = pod.spec.container.entrypoint
+        return env
+
+    def _spawn(self, pod: Pod) -> None:
+        status_dir = os.path.join(
+            self.root_dir, "status", pod.metadata.namespace, pod.metadata.name
+        )
+        shutil.rmtree(status_dir, ignore_errors=True)
+        os.makedirs(status_dir, exist_ok=True)
+        log_path = self.pod_log_path(pod.metadata.namespace, pod.metadata.name)
+        os.makedirs(os.path.dirname(log_path), exist_ok=True)
+
+        c = pod.spec.container
+        if c.entrypoint:
+            argv = [sys.executable, "-m", "kubeflow_tpu.runtime.pod_main"]
+        elif c.command:
+            argv = list(c.command) + list(c.args)
+        else:
+            self._set_status(pod, PodPhase.FAILED, exit_code=2, message="no command/entrypoint")
+            return
+
+        env = self._build_env(pod, status_dir)
+        logf = open(log_path, "ab", buffering=0)
+        try:
+            popen = subprocess.Popen(
+                argv,
+                env=env,
+                stdout=logf,
+                stderr=subprocess.STDOUT,
+                cwd=c.working_dir or os.getcwd(),
+                start_new_session=True,  # own process group -> clean gang kill
+            )
+        except OSError as e:
+            self._set_status(pod, PodPhase.FAILED, exit_code=2, message=str(e))
+            return
+        finally:
+            logf.close()  # child holds its own dup of the fd
+        self._procs[pod.key] = _Proc(
+            popen=popen,
+            pod_uid=pod.metadata.uid or "",
+            status_dir=status_dir,
+            log_path=log_path,
+        )
+        self._set_status(
+            pod, PodPhase.RUNNING, pid=popen.pid, start_time=time.time()
+        )
+        log.info("spawned %s pid=%s", pod.key, popen.pid)
+
+    def _check(self, pod: Pod) -> None:
+        proc = self._procs.get(pod.key)
+        if proc is None or proc.pod_uid != (pod.metadata.uid or ""):
+            return
+        # surface the gang-barrier stamp as soon as it exists
+        if not proc.barrier_reported:
+            bfile = os.path.join(proc.status_dir, bootstrap.BARRIER_FILE)
+            if os.path.exists(bfile):
+                try:
+                    with open(bfile) as f:
+                        t = float(f.read().strip())
+                    self._set_status(pod, None, barrier_time=t)
+                    proc.barrier_reported = True
+                except (ValueError, OSError):
+                    pass
+        code = proc.popen.poll()
+        if code is None:
+            return
+        del self._procs[pod.key]
+        phase = PodPhase.SUCCEEDED if code == 0 else PodPhase.FAILED
+        self._set_status(
+            pod, phase, exit_code=code, finish_time=time.time()
+        )
+        log.info("pod %s exited code=%s", pod.key, code)
+
+    def _kill(self, key: str) -> None:
+        proc = self._procs.pop(key, None)
+        if proc is None:
+            return
+        popen = proc.popen
+        if popen.poll() is None:
+            try:
+                os.killpg(os.getpgid(popen.pid), signal.SIGTERM)
+            except (ProcessLookupError, PermissionError):
+                pass
+            try:
+                popen.wait(timeout=GRACE_SECONDS)
+            except subprocess.TimeoutExpired:
+                try:
+                    os.killpg(os.getpgid(popen.pid), signal.SIGKILL)
+                except (ProcessLookupError, PermissionError):
+                    pass
+                popen.wait(timeout=GRACE_SECONDS)
+
+    # -- status writes ---------------------------------------------------------
+
+    def _set_status(self, pod: Pod, phase: Optional[PodPhase], **fields) -> None:
+        def mut(o):
+            assert isinstance(o, Pod)
+            if phase is not None:
+                o.status.phase = phase
+            for k, v in fields.items():
+                if k == "message":
+                    o.status.message = str(v)
+                else:
+                    setattr(o.status, k, v)
+
+        try:
+            self.store.update_with_retry(
+                KIND_POD, pod.metadata.name, pod.metadata.namespace, mut
+            )
+        except NotFound:
+            self._kill(pod.key)

@@ -1,0 +1,59 @@
+"""Pod process entrypoint: ``python -m kubeflow_tpu.runtime.pod_main``.
+
+What the kubelet execs for containers that declare a python ``entrypoint``
+(``module:function``).  Sequence: parse the env contract -> join the
+collective (``jax.distributed``) -> pass the gang barrier (stamping the
+startup probe) -> run the user function.  The user function receives the
+``PodContext`` and its return value is ignored; failures map to exit codes
+the controller's RestartPolicy understands (SURVEY.md §5 failure detection).
+"""
+
+from __future__ import annotations
+
+import importlib
+import os
+import sys
+import traceback
+
+from . import bootstrap
+
+
+def resolve_target(spec: str):
+    mod_name, _, fn_name = spec.partition(":")
+    if not fn_name:
+        raise ValueError(f"entrypoint {spec!r} must be 'module:function'")
+    mod = importlib.import_module(mod_name)
+    return getattr(mod, fn_name)
+
+
+def main() -> int:
+    ctx = bootstrap.PodContext.from_env()
+    target_spec = os.environ.get(bootstrap.ENV_ENTRYPOINT)
+    if not target_spec:
+        print("pod_main: no KFT_ENTRYPOINT set", file=sys.stderr)
+        return 2
+    try:
+        fn = resolve_target(target_spec)
+    except Exception:
+        traceback.print_exc()
+        return 2
+    try:
+        bootstrap.initialize(ctx)
+        bootstrap.barrier(ctx)
+    except Exception:
+        traceback.print_exc()
+        # rendezvous failures are retryable by convention (another rank may
+        # have died first; a gang restart can heal it)
+        return 42
+    try:
+        fn(ctx)
+        return 0
+    except SystemExit as e:
+        return int(e.code or 0)
+    except Exception:
+        traceback.print_exc()
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,5 @@
+"""Python SDK."""
+
+from .client import JobTimeoutError, TrainingClient
+
+__all__ = ["JobTimeoutError", "TrainingClient"]

@@ -1,0 +1,13 @@
+"""Small networking helpers for the local runtime."""
+
+from __future__ import annotations
+
+import socket
+
+
+def free_port() -> int:
+    """Ask the kernel for an unused TCP port (coordinator rendezvous)."""
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.bind(("127.0.0.1", 0))
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        return s.getsockname()[1]

@@ -1,0 +1,26 @@
+"""Test harness config.
+
+Force the CPU backend with 8 virtual devices so every sharding/mesh test runs
+the same SPMD code path XLA uses on a real v5e slice (SURVEY.md §4: the
+honest multi-host stand-in).  Must be set before jax imports anywhere in the
+test process.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture()
+def tmp_ckpt_dir(tmp_path):
+    d = tmp_path / "ckpt"
+    d.mkdir()
+    return str(d)

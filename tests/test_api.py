@@ -1,0 +1,232 @@
+"""Spec-layer tests: construction, defaulting, validation, YAML round-trip.
+
+Mirrors the reference's table-driven API tests [upstream:
+kubeflow/training-operator -> pkg/apis/kubeflow.org/v1/*_test.go] done as
+pytest parametrization over pure functions (SURVEY.md §4a).
+"""
+
+import pytest
+
+from kubeflow_tpu.api import (
+    AdmissionError,
+    Container,
+    Experiment,
+    InferenceService,
+    JaxJob,
+    JobCondition,
+    JobConditionType,
+    ModelFormat,
+    ObjectMeta,
+    ReplicaSpec,
+    Resources,
+    ServingRuntime,
+    TpuTopology,
+    default_jaxjob,
+    dump_yaml,
+    from_dict,
+    get_condition,
+    has_condition,
+    is_retryable_exit,
+    load_yaml,
+    replica_pod_name,
+    select_runtime,
+    set_condition,
+    substitute_parameters,
+    validate_experiment,
+    validate_jaxjob,
+)
+from kubeflow_tpu.api.inference import ServingRuntimeSpec, SupportedModelFormat
+
+
+def make_job(replicas=2, tpu=0, mesh=None):
+    job = JaxJob(
+        metadata=ObjectMeta(name="llama-ft"),
+        spec={
+            "replica_specs": {
+                "worker": ReplicaSpec(
+                    replicas=replicas,
+                    template=Container(resources=Resources(tpu=tpu)),
+                )
+            },
+            **({"mesh": mesh} if mesh else {}),
+        },
+    )
+    return default_jaxjob(job)
+
+
+class TestJaxJob:
+    def test_defaulting_sets_gang_min_available(self):
+        job = make_job(replicas=4)
+        assert job.spec.run_policy.scheduling_policy.min_available == 4
+        assert job.spec.mesh == {"data": 4}
+
+    def test_defaulting_counts_chips(self):
+        job = make_job(replicas=4, tpu=4)
+        assert job.spec.mesh == {"data": 16}
+
+    def test_validate_ok(self):
+        validate_jaxjob(make_job(replicas=2))
+
+    def test_validate_rejects_zero_workers(self):
+        job = make_job(replicas=2)
+        job.spec.replica_specs["worker"].replicas = 0
+        with pytest.raises(AdmissionError):
+            validate_jaxjob(job)
+
+    def test_validate_rejects_mesh_mismatch(self):
+        job = make_job(replicas=2, tpu=4, mesh={"data": 2, "model": 2})
+        with pytest.raises(AdmissionError, match="mesh"):
+            validate_jaxjob(job)
+
+    def test_validate_accepts_factored_mesh(self):
+        validate_jaxjob(make_job(replicas=2, tpu=4, mesh={"data": 2, "model": 4}))
+
+    def test_dns_names(self):
+        assert replica_pod_name("j", "Worker", 3) == "j-worker-3"
+
+    def test_bad_name_rejected(self):
+        with pytest.raises(ValueError):
+            ObjectMeta(name="Bad_Name")
+
+    def test_topology(self):
+        t = TpuTopology(shape="4x4")
+        assert t.num_chips == 16
+        with pytest.raises(ValueError):
+            TpuTopology(shape="4by4")
+
+
+class TestConditions:
+    def test_terminal_flips_running_off(self):
+        conds = []
+        conds = set_condition(conds, JobCondition(type=JobConditionType.CREATED))
+        conds = set_condition(conds, JobCondition(type=JobConditionType.RUNNING))
+        conds = set_condition(
+            conds, JobCondition(type=JobConditionType.SUCCEEDED, reason="done")
+        )
+        assert has_condition(conds, JobConditionType.SUCCEEDED)
+        running = get_condition(conds, JobConditionType.RUNNING)
+        assert running is not None and running.status is False
+
+    def test_no_transition_keeps_timestamp(self):
+        c1 = JobCondition(type=JobConditionType.RUNNING, reason="r")
+        conds = set_condition([], c1)
+        conds = set_condition(conds, JobCondition(type=JobConditionType.RUNNING, reason="r"))
+        assert conds[0].last_transition_time == c1.last_transition_time
+
+    def test_retryable_exit_codes(self):
+        assert is_retryable_exit(137)  # SIGKILL
+        assert is_retryable_exit(42)
+        assert not is_retryable_exit(1)
+
+
+class TestYaml:
+    MANIFEST = """
+apiVersion: kubeflow-tpu.dev/v1
+kind: JaxJob
+metadata:
+  name: mnist-smoke
+spec:
+  runPolicy:
+    backoffLimit: 2
+  replicaSpecs:
+    worker:
+      replicas: 2
+      template:
+        entrypoint: kubeflow_tpu.models.mnist:train_main
+        resources:
+          tpu: 0
+"""
+
+    def test_load_camelcase_manifest(self):
+        (job,) = load_yaml(self.MANIFEST)
+        assert isinstance(job, JaxJob)
+        assert job.spec.run_policy.backoff_limit == 2
+        assert job.spec.replica_specs["worker"].replicas == 2
+
+    def test_round_trip(self):
+        (job,) = load_yaml(self.MANIFEST)
+        default_jaxjob(job)
+        (job2,) = load_yaml(dump_yaml(job))
+        assert job2.spec == job.spec
+
+    def test_unknown_kind(self):
+        with pytest.raises(ValueError, match="unknown kind"):
+            from_dict({"kind": "PyTorchJob", "metadata": {"name": "x"}})
+
+    def test_user_data_maps_not_mangled(self):
+        """env var names / labels / mesh axes must survive camelCase->snake
+        conversion untouched (they are data, not schema keys)."""
+        manifest = """
+kind: JaxJob
+metadata:
+  name: envy
+  labels:
+    myTeam: alpha
+spec:
+  replicaSpecs:
+    worker:
+      replicas: 2
+      template:
+        env:
+          MY_FLAG: "1"
+          someCamelVar: "x"
+  mesh:
+    data: 2
+"""
+        (job,) = load_yaml(manifest)
+        env = job.spec.replica_specs["worker"].template.env
+        assert env == {"MY_FLAG": "1", "someCamelVar": "x"}
+        assert job.metadata.labels == {"myTeam": "alpha"}
+        assert job.spec.mesh == {"data": 2}
+
+
+class TestExperiment:
+    def test_substitution_typed_and_embedded(self):
+        tree = {
+            "lr": "${trialParameters.lr}",
+            "args": ["--lr=${trialParameters.lr}", "plain"],
+        }
+        out = substitute_parameters(tree, {"lr": 0.01})
+        assert out["lr"] == 0.01
+        assert out["args"][0] == "--lr=0.01"
+
+    def test_unresolved_raises(self):
+        with pytest.raises(KeyError):
+            substitute_parameters("${trialParameters.missing}", {})
+
+    def test_validate_requires_template(self):
+        exp = Experiment(
+            metadata=ObjectMeta(name="sweep"),
+            spec={
+                "parameters": [
+                    {
+                        "name": "lr",
+                        "parameter_type": "double",
+                        "feasible_space": {"min": 1e-4, "max": 1e-1},
+                    }
+                ]
+            },
+        )
+        with pytest.raises(AdmissionError, match="trial_template"):
+            validate_experiment(exp)
+
+
+class TestServingSelection:
+    def _rt(self, name, fmt, priority=1, auto=True):
+        return ServingRuntime(
+            metadata=ObjectMeta(name=name),
+            spec=ServingRuntimeSpec(
+                supported_model_formats=[
+                    SupportedModelFormat(name=fmt, priority=priority, auto_select=auto)
+                ],
+                server_class="x:Y",
+            ),
+        )
+
+    def test_priority_selection(self):
+        rts = [self._rt("a", "jax", 1), self._rt("b", "jax", 9)]
+        assert select_runtime(ModelFormat(name="jax"), rts).metadata.name == "b"
+
+    def test_no_autoselect(self):
+        rts = [self._rt("a", "jax", auto=False)]
+        assert select_runtime(ModelFormat(name="jax"), rts) is None

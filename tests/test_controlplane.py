@@ -1,0 +1,371 @@
+"""Control-plane tests: store semantics, gang scheduling, the JaxJob
+reconcile lifecycle (create -> gang admit -> run -> succeed/fail/restart).
+
+The envtest-tier analog (SURVEY.md §4b): real store + real reconcilers +
+scripted kubelet, no real processes.
+"""
+
+import time
+
+import pytest
+
+from kubeflow_tpu.api import JaxJob, ObjectMeta, ReplicaSpec, Container, Resources
+from kubeflow_tpu.api.common import JobConditionType, RestartPolicy, has_condition
+from kubeflow_tpu.api.jaxjob import KIND_JAXJOB
+from kubeflow_tpu.controlplane import (
+    Cluster,
+    Conflict,
+    FakeKubelet,
+    KIND_POD,
+    KIND_PODGROUP,
+    PodGroupPhase,
+    PodScript,
+    Rejected,
+    Store,
+    events_for,
+)
+from kubeflow_tpu.controlplane.objects import LABEL_JOB_NAME, Pod, PodPhase
+
+
+def wait_for(fn, timeout=10.0, interval=0.02, desc="condition"):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        v = fn()
+        if v:
+            return v
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {desc}")
+
+
+def make_job(name="job", replicas=2, tpu=0, **run_policy):
+    return JaxJob(
+        metadata=ObjectMeta(name=name),
+        spec={
+            "replica_specs": {
+                "worker": ReplicaSpec(
+                    replicas=replicas,
+                    template=Container(resources=Resources(cpu=1, memory_gb=1, tpu=tpu)),
+                )
+            },
+            "run_policy": run_policy,
+        },
+    )
+
+
+class TestStore:
+    def test_optimistic_concurrency(self):
+        s = Store()
+        job = s.create(make_job())
+        stale = s.get(KIND_JAXJOB, "job")
+        s.update(job)  # bump rv
+        with pytest.raises(Conflict):
+            s.update(stale)
+
+    def test_watch_sees_lifecycle(self):
+        s = Store()
+        w = s.watch([KIND_JAXJOB])
+        s.create(make_job())
+        ev = w.q.get(timeout=1)
+        assert ev.type == "ADDED" and ev.obj.metadata.name == "job"
+
+    def test_admission_rejection(self):
+        c = Cluster()
+        bad = make_job(replicas=0)
+        with pytest.raises(Rejected):
+            c.store.create(bad)
+
+    def test_admission_defaults_applied(self):
+        c = Cluster()
+        job = c.store.create(make_job(replicas=3))
+        assert job.spec.run_policy.scheduling_policy.min_available == 3
+
+
+class TestGangScheduler:
+    def test_all_or_nothing(self):
+        c = Cluster()
+        c.add_tpu_slice("s0", num_hosts=1, chips_per_host=4)  # capacity: 4 chips
+        kubelet = FakeKubelet(c.store, lambda pod: PodScript(hang=True))
+        with c:
+            kubelet.start()
+            try:
+                # needs 2 pods x 4 chips = 8 chips > 4 available: nothing binds
+                c.store.create(make_job(name="big", replicas=2, tpu=4))
+                time.sleep(0.4)
+                pods = c.store.list(KIND_POD, labels={LABEL_JOB_NAME: "big"})
+                assert len(pods) == 2
+                assert all(p.spec.node_name is None for p in pods)
+                pg = c.store.get(KIND_PODGROUP, "big")
+                assert pg.status.phase == PodGroupPhase.PENDING
+                # grow the cluster; the whole gang should now bind
+                c.add_tpu_slice("s1", num_hosts=1, chips_per_host=4)
+                wait_for(
+                    lambda: all(
+                        p.spec.node_name
+                        for p in c.store.list(KIND_POD, labels={LABEL_JOB_NAME: "big"})
+                    ),
+                    desc="gang bound",
+                )
+                pg = c.store.get(KIND_PODGROUP, "big")
+                assert pg.status.phase == PodGroupPhase.RUNNING
+                assert pg.status.admitted_time is not None
+            finally:
+                kubelet.stop()
+
+    def test_slice_first_packing(self):
+        c = Cluster()
+        c.add_tpu_slice("sa", num_hosts=2, chips_per_host=4)
+        c.add_tpu_slice("sb", num_hosts=2, chips_per_host=4)
+        with c:
+            c.store.create(make_job(name="packed", replicas=2, tpu=4))
+            pods = wait_for(
+                lambda: (
+                    ps := c.store.list(KIND_POD, labels={LABEL_JOB_NAME: "packed"})
+                )
+                and all(p.spec.node_name for p in ps)
+                and ps,
+                desc="pods bound",
+            )
+            # both pods should land on the SAME slice (ICI before DCN)
+            slices = {p.spec.node_name.rsplit("-host-", 1)[0] for p in pods}
+            assert len(slices) == 1
+
+
+class TestJaxJobLifecycle:
+    def run_cluster(self, script=None):
+        c = Cluster()
+        c.add_tpu_slice("s0", num_hosts=4, chips_per_host=4)
+        kubelet = FakeKubelet(c.store, script)
+        return c, kubelet
+
+    def _await_terminal(self, c, name, timeout=10.0):
+        def check():
+            job = c.store.try_get(KIND_JAXJOB, name)
+            if job and (
+                has_condition(job.status.conditions, JobConditionType.SUCCEEDED)
+                or has_condition(job.status.conditions, JobConditionType.FAILED)
+            ):
+                return job
+            return None
+
+        return wait_for(check, timeout=timeout, desc=f"{name} terminal")
+
+    def test_happy_path_succeeds_with_gang_metric(self):
+        c, kubelet = self.run_cluster(lambda pod: PodScript(run_seconds=0.05))
+        with c:
+            kubelet.start()
+            try:
+                c.store.create(make_job(name="ok", replicas=4, tpu=4))
+                job = self._await_terminal(c, "ok")
+                assert has_condition(job.status.conditions, JobConditionType.SUCCEEDED)
+                assert job.status.gang_startup_seconds is not None
+                assert 0 <= job.status.gang_startup_seconds < 10
+                assert job.status.replica_statuses["worker"].succeeded == 4
+                reasons = [e.reason for e in events_for(c.store, KIND_JAXJOB, "ok")]
+                assert "PodGroupCreated" in reasons and "JobSucceeded" in reasons
+            finally:
+                kubelet.stop()
+
+    def test_env_injection(self):
+        c, kubelet = self.run_cluster(lambda pod: PodScript(hang=True))
+        with c:
+            kubelet.start()
+            try:
+                c.store.create(make_job(name="envs", replicas=2))
+                pods = wait_for(
+                    lambda: (
+                        ps := c.store.list(KIND_POD, labels={LABEL_JOB_NAME: "envs"})
+                    )
+                    and len(ps) == 2
+                    and ps,
+                    desc="pods created",
+                )
+                envs = {p.metadata.name: p.spec.container.env for p in pods}
+                e0 = envs["envs-worker-0"]
+                assert e0["JAX_COORDINATOR_ADDRESS"] == "envs-worker-0.default.svc:1234"
+                assert e0["JAX_NUM_PROCESSES"] == "2"
+                assert e0["JAX_PROCESS_ID"] == "0"
+                assert envs["envs-worker-1"]["JAX_PROCESS_ID"] == "1"
+            finally:
+                kubelet.stop()
+
+    def test_nonworker_role_stays_out_of_collective(self):
+        c, kubelet = self.run_cluster(lambda pod: PodScript(hang=True))
+        with c:
+            kubelet.start()
+            try:
+                job = make_job(name="hetero", replicas=2)
+                job.spec.replica_specs["dataset"] = ReplicaSpec(replicas=1)
+                c.store.create(job)
+                pods = wait_for(
+                    lambda: (
+                        ps := c.store.list(KIND_POD, labels={LABEL_JOB_NAME: "hetero"})
+                    )
+                    and len(ps) == 3
+                    and ps,
+                    desc="pods created",
+                )
+                aux = next(p for p in pods if "dataset" in p.metadata.name)
+                assert "JAX_NUM_PROCESSES" not in aux.spec.container.env
+                assert "JAX_PROCESS_ID" not in aux.spec.container.env
+            finally:
+                kubelet.stop()
+
+    def test_recreated_gang_member_schedules(self):
+        """A single replacement pod of an already-admitted gang must bind
+        even though it alone is smaller than min_member."""
+        c, kubelet = self.run_cluster(lambda pod: PodScript(hang=True))
+        with c:
+            kubelet.start()
+            try:
+                c.store.create(make_job(name="heal", replicas=3))
+                wait_for(
+                    lambda: all(
+                        p.spec.node_name
+                        for p in c.store.list(KIND_POD, labels={LABEL_JOB_NAME: "heal"})
+                    )
+                    and len(c.store.list(KIND_POD, labels={LABEL_JOB_NAME: "heal"})) == 3,
+                    desc="gang bound",
+                )
+                c.store.delete(KIND_POD, "heal-worker-1")
+                wait_for(
+                    lambda: (
+                        p := c.store.try_get(KIND_POD, "heal-worker-1")
+                    )
+                    and p.spec.node_name,
+                    desc="replacement pod bound",
+                )
+            finally:
+                kubelet.stop()
+
+    def test_nonretryable_failure_fails_job(self):
+        c, kubelet = self.run_cluster(
+            lambda pod: PodScript(run_seconds=0.05, exit_code=1)
+        )
+        with c:
+            kubelet.start()
+            try:
+                c.store.create(make_job(name="boom", replicas=2))
+                job = self._await_terminal(c, "boom")
+                assert has_condition(job.status.conditions, JobConditionType.FAILED)
+            finally:
+                kubelet.stop()
+
+    def test_retryable_failure_restarts_then_succeeds(self):
+        fails = {"n": 0}
+
+        def script(pod: Pod) -> PodScript:
+            # first generation of worker-0 dies with a retryable code
+            if pod.metadata.labels["replica-index"] == "0" and fails["n"] == 0:
+                fails["n"] += 1
+                return PodScript(run_seconds=0.05, exit_code=137)
+            return PodScript(run_seconds=0.05)
+
+        c, kubelet = self.run_cluster(script)
+        with c:
+            kubelet.start()
+            try:
+                job = make_job(name="retry", replicas=2, backoff_limit=2)
+                job.spec.replica_specs["worker"].restart_policy = RestartPolicy.EXIT_CODE
+                c.store.create(job)
+                job = self._await_terminal(c, "retry")
+                assert has_condition(job.status.conditions, JobConditionType.SUCCEEDED)
+                assert job.status.restart_count == 1
+            finally:
+                kubelet.stop()
+
+    def test_backoff_limit_exhaustion(self):
+        c, kubelet = self.run_cluster(
+            lambda pod: PodScript(run_seconds=0.03, exit_code=137)
+        )
+        with c:
+            kubelet.start()
+            try:
+                job = make_job(name="flappy", replicas=1, backoff_limit=1)
+                job.spec.replica_specs["worker"].restart_policy = RestartPolicy.EXIT_CODE
+                c.store.create(job)
+                job = self._await_terminal(c, "flappy")
+                assert has_condition(job.status.conditions, JobConditionType.FAILED)
+                assert job.status.restart_count == 1
+            finally:
+                kubelet.stop()
+
+    def test_gang_schedule_timeout(self):
+        c = Cluster()  # no nodes at all
+        kubelet = FakeKubelet(c.store)
+        with c:
+            kubelet.start()
+            try:
+                job = make_job(name="stuck", replicas=2, tpu=4)
+                job.spec.run_policy.scheduling_policy = None  # let defaulting fill it
+                c.store.create(job)
+
+                def set_timeout():
+                    j = c.store.get(KIND_JAXJOB, "stuck")
+                    j.spec.run_policy.scheduling_policy.schedule_timeout_seconds = 0.2
+                    c.store.update(j)
+
+                set_timeout()
+                job = self._await_terminal(c, "stuck", timeout=10)
+                failed = has_condition(job.status.conditions, JobConditionType.FAILED)
+                assert failed
+            finally:
+                kubelet.stop()
+
+    def test_suspend_deletes_pods(self):
+        c, kubelet = self.run_cluster(lambda pod: PodScript(hang=True))
+        with c:
+            kubelet.start()
+            try:
+                c.store.create(make_job(name="pause", replicas=2))
+                wait_for(
+                    lambda: len(c.store.list(KIND_POD, labels={LABEL_JOB_NAME: "pause"})) == 2,
+                    desc="pods up",
+                )
+
+                def suspend():
+                    j = c.store.get(KIND_JAXJOB, "pause")
+                    j.spec.run_policy.suspend = True
+                    c.store.update(j)
+
+                suspend()
+                wait_for(
+                    lambda: len(c.store.list(KIND_POD, labels={LABEL_JOB_NAME: "pause"})) == 0,
+                    desc="pods gone",
+                )
+                job = c.store.get(KIND_JAXJOB, "pause")
+                assert has_condition(job.status.conditions, JobConditionType.SUSPENDED)
+            finally:
+                kubelet.stop()
+
+    def test_ttl_deletes_job(self):
+        c, kubelet = self.run_cluster(lambda pod: PodScript(run_seconds=0.02))
+        with c:
+            kubelet.start()
+            try:
+                job = make_job(name="ephemeral", replicas=1, ttl_seconds_after_finished=0.2)
+                c.store.create(job)
+                wait_for(
+                    lambda: c.store.try_get(KIND_JAXJOB, "ephemeral") is None,
+                    desc="job gc'd",
+                )
+            finally:
+                kubelet.stop()
+
+    def test_job_deletion_cleans_owned_objects(self):
+        c, kubelet = self.run_cluster(lambda pod: PodScript(hang=True))
+        with c:
+            kubelet.start()
+            try:
+                c.store.create(make_job(name="gone", replicas=2))
+                wait_for(
+                    lambda: len(c.store.list(KIND_POD, labels={LABEL_JOB_NAME: "gone"})) == 2,
+                    desc="pods up",
+                )
+                c.store.delete(KIND_JAXJOB, "gone")
+                wait_for(
+                    lambda: not c.store.list(KIND_POD, labels={LABEL_JOB_NAME: "gone"})
+                    and c.store.try_get(KIND_PODGROUP, "gone") is None,
+                    desc="owned objects gc'd",
+                )
+            finally:
+                kubelet.stop()

@@ -1,0 +1,92 @@
+"""E2E: JaxJob YAML -> gang admission -> real processes -> jax.distributed
+rendezvous -> DP training -> Succeeded (SURVEY.md §7 phase 3's minimum
+end-to-end slice; the kind-cluster tier of the reference test pyramid).
+
+These spawn real subprocesses doing real multi-process JAX on the CPU
+backend — the same XLA code path as a multi-host TPU slice.
+"""
+
+import time
+
+import pytest
+
+from kubeflow_tpu.api.common import JobConditionType, has_condition
+from kubeflow_tpu.runtime.platform import LocalPlatform
+from kubeflow_tpu.sdk import TrainingClient
+from kubeflow_tpu.utils.net import free_port
+
+
+@pytest.fixture()
+def platform(tmp_path):
+    p = LocalPlatform(num_hosts=4, chips_per_host=4, root_dir=str(tmp_path))
+    with p:
+        yield p
+
+
+@pytest.mark.e2e
+class TestLocalE2E:
+    def test_single_worker_smoke(self, platform):
+        """Baseline config 1: single-worker MNIST-class smoke run."""
+        client = TrainingClient(platform)
+        job = client.train(
+            name="mnist-smoke",
+            entrypoint="kubeflow_tpu.models.mnist:train_main",
+            num_workers=1,
+            env={"KFT_STEPS": "5", "KFT_BATCH": "16"},
+            timeout=120,
+        )
+        assert has_condition(job.status.conditions, JobConditionType.SUCCEEDED)
+        logs = client.get_job_logs("mnist-smoke")
+        assert "loss=" in logs["mnist-smoke-worker-0"]
+
+    def test_two_worker_distributed(self, platform):
+        """Baseline config 2 analog: 2-process DDP-style data parallelism
+        with a genuine jax.distributed rendezvous."""
+        client = TrainingClient(platform)
+        job = client.train(
+            name="ddp",
+            entrypoint="kubeflow_tpu.models.mnist:train_main",
+            num_workers=2,
+            env={"KFT_STEPS": "4", "KFT_BATCH": "16"},
+            timeout=180,
+        )
+        assert has_condition(job.status.conditions, JobConditionType.SUCCEEDED)
+        # gang-startup metric measured once every rank crossed the barrier
+        assert job.status.gang_startup_seconds is not None
+        assert job.status.gang_startup_seconds > 0
+        logs = client.get_job_logs("ddp")
+        assert len(logs) == 2
+
+    def test_yaml_submission(self, platform):
+        client = TrainingClient(platform)
+        port = free_port()
+        job = client.create_job(
+            f"""
+apiVersion: kubeflow-tpu.dev/v1
+kind: JaxJob
+metadata:
+  name: from-yaml
+spec:
+  coordinatorPort: {port}
+  replicaSpecs:
+    worker:
+      replicas: 1
+      template:
+        entrypoint: kubeflow_tpu.models.mnist:train_main
+        env:
+          KFT_STEPS: "3"
+          KFT_BATCH: "8"
+"""
+        )
+        job = client.wait_for_job_conditions("from-yaml", timeout=120)
+        assert has_condition(job.status.conditions, JobConditionType.SUCCEEDED)
+
+    def test_failing_entrypoint_fails_job(self, platform):
+        client = TrainingClient(platform)
+        with pytest.raises(RuntimeError, match="failed"):
+            client.train(
+                name="will-fail",
+                entrypoint="kubeflow_tpu.models.mnist:not_a_function",
+                num_workers=1,
+                timeout=120,
+            )
