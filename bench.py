@@ -1,0 +1,99 @@
+"""Benchmark: Llama training throughput, tokens/sec/chip (BASELINE metric).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+BASELINE.json ships no published numbers ("published": {}), so the
+comparison point is the roofline: value / (tokens/sec/chip at 40% MFU on
+this chip's peak) — i.e. vs_baseline >= 1.0 means we meet a 40%-MFU bar,
+the regime well-tuned TPU LLM stacks land in.  On CPU (no TPU available)
+the roofline is undefined and vs_baseline is reported against a fixed
+CPU reference constant so the number is still comparable run-to-run.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+from __graft_entry__ import _bench_model
+from kubeflow_tpu.models import llama as llamalib
+from kubeflow_tpu.train import data as datalib
+from kubeflow_tpu.train import trainer as trainlib
+
+WARMUP_STEPS = 3
+MEASURED_STEPS = 10
+TARGET_MFU = 0.40
+CPU_REFERENCE_TPS = 2000.0  # fixed constant for CPU-only comparability
+
+
+def main() -> None:
+    devices = jax.devices()
+    on_tpu = devices[0].platform == "tpu"
+    if on_tpu:
+        model = _bench_model()
+        batch, seq = 16, 1024
+    else:
+        model = llamalib.tiny()
+        batch, seq = 8, 128
+
+    cfg = trainlib.TrainConfig(
+        model=model,
+        mesh_axes={"data": len(devices)} if len(devices) > 1 else {},
+        global_batch=batch,
+        seq_len=seq,
+        steps=WARMUP_STEPS + MEASURED_STEPS,
+        warmup_steps=2,
+        log_every=10_000,  # quiet
+    )
+    t = trainlib.Trainer(cfg, devices=devices)
+    source = datalib.SyntheticLm(
+        batch, seq, model.vocab_size, process_index=0, process_count=1)
+    state = t.init_state()
+    step_fn = t.compiled_step()
+
+    from kubeflow_tpu.parallel import sharding as shardlib
+
+    times = []
+    with shardlib.shard_context(t.mesh):
+        for step in range(WARMUP_STEPS + MEASURED_STEPS):
+            batch_arrays = {
+                k: jax.device_put(v, t.batch_sharding)
+                for k, v in source.local_batch(step).items()
+            }
+            t0 = time.perf_counter()
+            state, out = step_fn(state, batch_arrays)
+            # device_get, not block_until_ready: some PJRT backends (axon
+            # tunnel) report ready before remote execution completes
+            float(jax.device_get(out["loss"]))
+            dt = time.perf_counter() - t0
+            if step >= WARMUP_STEPS:
+                times.append(dt)
+
+    times.sort()
+    median = times[len(times) // 2]
+    n_chips = len(devices)
+    tps_chip = batch * seq / median / n_chips
+
+    flops_tok = llamalib.flops_per_token(model, seq)
+    kind = getattr(devices[0], "device_kind", "cpu").lower()
+    peak = trainlib.PEAK_TFLOPS.get(kind, 0.0)
+    if peak:
+        target_tps = TARGET_MFU * peak * 1e12 / flops_tok
+        vs_baseline = tps_chip / target_tps
+    else:
+        vs_baseline = tps_chip / CPU_REFERENCE_TPS
+
+    print(json.dumps({
+        "metric": "llama_train_tokens_per_sec_per_chip",
+        "value": round(tps_chip, 2),
+        "unit": f"tokens/s/chip (model={llamalib.num_params(model)/1e6:.0f}M, "
+                f"seq={seq}, {kind})",
+        "vs_baseline": round(vs_baseline, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

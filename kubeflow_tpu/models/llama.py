@@ -1,0 +1,403 @@
+"""Llama-family decoder transformer, TPU-first.
+
+Capability target: the models the reference's baseline configs train/serve —
+"MPIJob Llama-7B multi-host pretrain -> JAXJob on v5e-16 pod slice" and the
+SDK ``train()`` LLM fine-tune path [local: BASELINE.json configs 5, SURVEY.md
+§3.5].  The reference ships no model code (its Llama runs live in user
+containers, Megatron/transformers over NCCL); this is the in-container
+runtime layer the TPU rebuild must own (SURVEY.md §1, closing paragraph).
+
+TPU-first choices:
+
+- bfloat16 activations, float32 params/accumulators; RMSNorm + softmax in
+  float32 (MXU-friendly matmuls, stable reductions).
+- ``nn.scan`` over the layer stack: one traced block, O(1) compile time in
+  depth; ``nn.remat`` with the ``dots_with_no_batch_dims_saveable`` policy
+  trades HBM for recompute exactly where the scaling playbook says to.
+- every parameter and residual activation carries *logical* axis names
+  (kubeflow_tpu.parallel.sharding) so the same code runs DP / FSDP / TP / SP
+  by mesh choice alone; attention heads are grouped (GQA) and head/mlp dims
+  shard on the ``model`` axis, embed dim on ``fsdp``, sequence on ``seq``.
+- static shapes everywhere; causal masking via lax primitives, no Python
+  control flow under jit.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from ..parallel import ring_attention as ringlib
+
+Dtype = Any
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 32
+    head_dim: int = 128
+    max_seq_len: int = 4096
+    rope_theta: float = 10000.0
+    rms_norm_eps: float = 1e-5
+    dtype: Dtype = jnp.bfloat16
+    param_dtype: Dtype = jnp.float32
+    remat: bool = True
+    scan_layers: bool = True
+    #: "dense" = full causal attention (XLA-fused); "ring" = blockwise ring
+    #: attention over the mesh's ``seq`` axis for long contexts (SURVEY §5).
+    attention_impl: str = "dense"
+    tie_embeddings: bool = False
+
+    @property
+    def q_per_kv(self) -> int:
+        return self.num_heads // self.num_kv_heads
+
+    def __post_init__(self):
+        if self.num_heads % self.num_kv_heads:
+            raise ValueError("num_heads must be a multiple of num_kv_heads")
+        if self.attention_impl not in ("dense", "ring"):
+            raise ValueError(f"unknown attention_impl {self.attention_impl!r}")
+
+
+# -- presets ----------------------------------------------------------------
+
+def _preset(defaults: dict, overrides: dict) -> LlamaConfig:
+    return LlamaConfig(**{**defaults, **overrides})
+
+
+def tiny(**kw) -> LlamaConfig:
+    """Test/smoke config: runs on one CPU device in <1s."""
+    return _preset(
+        dict(
+            vocab_size=256, hidden_size=64, intermediate_size=128, num_layers=2,
+            num_heads=4, num_kv_heads=2, head_dim=16, max_seq_len=128,
+            dtype=jnp.float32, remat=False,
+        ),
+        kw,
+    )
+
+
+def llama2_7b(**kw) -> LlamaConfig:
+    return LlamaConfig(**kw)
+
+
+def llama2_13b(**kw) -> LlamaConfig:
+    return _preset(
+        dict(hidden_size=5120, intermediate_size=13824, num_layers=40,
+             num_heads=40, num_kv_heads=40),
+        kw,
+    )
+
+
+def llama2_70b(**kw) -> LlamaConfig:
+    return _preset(
+        dict(hidden_size=8192, intermediate_size=28672, num_layers=80,
+             num_heads=64, num_kv_heads=8),
+        kw,
+    )
+
+
+def llama3_8b(**kw) -> LlamaConfig:
+    return _preset(
+        dict(vocab_size=128256, hidden_size=4096, intermediate_size=14336,
+             num_layers=32, num_heads=32, num_kv_heads=8, max_seq_len=8192,
+             rope_theta=500000.0),
+        kw,
+    )
+
+
+PRESETS = {
+    "tiny": tiny,
+    "llama2-7b": llama2_7b,
+    "llama2-13b": llama2_13b,
+    "llama2-70b": llama2_70b,
+    "llama3-8b": llama3_8b,
+}
+
+
+# -- building blocks --------------------------------------------------------
+
+
+class RMSNorm(nn.Module):
+    eps: float
+    dtype: Dtype
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        scale = self.param(
+            "scale", nn.with_logical_partitioning(nn.initializers.ones, ("norm",)),
+            (x.shape[-1],), jnp.float32,
+        )
+        x32 = x.astype(jnp.float32)
+        var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+        y = x32 * jax.lax.rsqrt(var + self.eps)
+        return (y * scale).astype(self.dtype)
+
+
+def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """Rotary position embedding; x: [..., seq, heads, head_dim]."""
+    head_dim = x.shape[-1]
+    freqs = theta ** (-jnp.arange(0, head_dim // 2, dtype=jnp.float32) / (head_dim // 2))
+    angles = positions[..., :, None].astype(jnp.float32) * freqs  # [..., seq, hd/2]
+    cos = jnp.cos(angles)[..., :, None, :]  # broadcast over heads
+    sin = jnp.sin(angles)[..., :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
+class Einsum(nn.Module):
+    """Einsum layer with an explicitly-shaped, logically-named kernel.
+
+    flax's DenseGeneral flattens its kernel to 2D at creation, which breaks
+    >2-axis logical metadata the moment a mesh context makes boxing apply
+    real constraints — so parameter shapes are owned here, not by flax.
+    """
+
+    subscript: str
+    shape: tuple[int, ...]
+    logical_axes: tuple[str, ...]
+    dtype: Dtype
+    param_dtype: Dtype
+    in_axes: tuple[int, ...] = (0,)   # kernel dims contracted with the input
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        out_axes = tuple(i for i in range(len(self.shape)) if i not in self.in_axes)
+        init = nn.initializers.variance_scaling(
+            1.0, "fan_in", "truncated_normal",
+            in_axis=self.in_axes, out_axis=out_axes)
+        kernel = self.param(
+            "kernel",
+            nn.with_logical_partitioning(init, self.logical_axes),
+            self.shape, self.param_dtype,
+        )
+        return jnp.einsum(self.subscript, x, kernel.astype(self.dtype))
+
+
+class Attention(nn.Module):
+    cfg: LlamaConfig
+    decode: bool = False
+
+    @nn.compact
+    def __call__(self, x: jax.Array, positions: jax.Array) -> jax.Array:
+        cfg = self.cfg
+        proj = partial(Einsum, dtype=cfg.dtype, param_dtype=cfg.param_dtype)
+        h_dim = x.shape[-1]
+        q = proj(
+            "bse,ehd->bshd", (h_dim, cfg.num_heads, cfg.head_dim),
+            ("embed", "heads", "head_dim"), name="wq")(x)
+        k = proj(
+            "bse,ekd->bskd", (h_dim, cfg.num_kv_heads, cfg.head_dim),
+            ("embed", "kv_heads", "head_dim"), name="wk")(x)
+        v = proj(
+            "bse,ekd->bskd", (h_dim, cfg.num_kv_heads, cfg.head_dim),
+            ("embed", "kv_heads", "head_dim"), name="wv")(x)
+        q = rope(q, positions, cfg.rope_theta)
+        k = rope(k, positions, cfg.rope_theta)
+        q = nn.with_logical_constraint(q, ("batch", "act_seq", "act_heads", "head_dim"))
+        k = nn.with_logical_constraint(k, ("batch", "act_seq", "act_kv_heads", "head_dim"))
+        v = nn.with_logical_constraint(v, ("batch", "act_seq", "act_kv_heads", "head_dim"))
+
+        if self.decode:
+            out = self._decode_attend(q, k, v)
+        elif cfg.attention_impl == "ring":
+            out = ringlib.ring_attention(
+                q, k, v, axis_name="seq", q_per_kv=cfg.q_per_kv
+            )
+        else:
+            out = _causal_attention(q, k, v, cfg.q_per_kv)
+        out = nn.with_logical_constraint(out, ("batch", "act_seq", "act_heads", "head_dim"))
+        return proj(
+            "bshd,hde->bse", (cfg.num_heads, cfg.head_dim, h_dim),
+            ("heads", "head_dim", "embed"), in_axes=(0, 1), name="wo")(out)
+
+    def _decode_attend(self, q, k, v):
+        """Single-token decode against a mutable KV cache (serving path).
+
+        Flax 'cache' collection: cached_key/value are [batch, max_seq, kv, hd];
+        cache_index is the write cursor.  q is [batch, 1, heads, hd].
+        """
+        cfg = self.cfg
+        batch = q.shape[0]
+        cached_k = self.variable(
+            "cache", "cached_key",
+            jnp.zeros, (batch, cfg.max_seq_len, cfg.num_kv_heads, cfg.head_dim), cfg.dtype)
+        cached_v = self.variable(
+            "cache", "cached_value",
+            jnp.zeros, (batch, cfg.max_seq_len, cfg.num_kv_heads, cfg.head_dim), cfg.dtype)
+        idx = self.variable("cache", "cache_index", lambda: jnp.zeros((), jnp.int32))
+        cur = idx.value
+        cached_k.value = jax.lax.dynamic_update_slice(
+            cached_k.value, k.astype(cfg.dtype), (0, cur, 0, 0))
+        cached_v.value = jax.lax.dynamic_update_slice(
+            cached_v.value, v.astype(cfg.dtype), (0, cur, 0, 0))
+        idx.value = cur + q.shape[1]
+        kf, vf = cached_k.value, cached_v.value
+        qh = q.reshape(batch, q.shape[1], cfg.num_kv_heads, cfg.q_per_kv, cfg.head_dim)
+        logits = jnp.einsum("bqkgh,bskh->bkgqs", qh.astype(jnp.float32), kf.astype(jnp.float32))
+        logits = logits / jnp.sqrt(cfg.head_dim).astype(jnp.float32)
+        # query i of this chunk sits at global position cur+i and may attend
+        # to cache slots <= cur+i (per-query mask, so chunked prefill works)
+        q_pos = cur + jnp.arange(q.shape[1])
+        valid = jnp.arange(cfg.max_seq_len)[None, :] <= q_pos[:, None]  # [q, s]
+        logits = jnp.where(valid[None, None, None, :, :], logits, -1e30)
+        probs = jax.nn.softmax(logits, axis=-1)
+        out = jnp.einsum("bkgqs,bskh->bqkgh", probs, vf.astype(jnp.float32))
+        return out.reshape(batch, q.shape[1], cfg.num_heads, cfg.head_dim).astype(cfg.dtype)
+
+
+def _causal_attention(q, k, v, q_per_kv: int) -> jax.Array:
+    """Dense causal GQA attention; XLA fuses mask+softmax into the matmuls.
+
+    q: [b, s, h, d]; k,v: [b, s, kv, d] with h = kv * q_per_kv.
+    """
+    b, s, h, d = q.shape
+    kv = k.shape[2]
+    qh = q.reshape(b, s, kv, q_per_kv, d).astype(jnp.float32)
+    logits = jnp.einsum("bqkgd,bskd->bkgqs", qh, k.astype(jnp.float32))
+    logits = logits / jnp.sqrt(d).astype(jnp.float32)
+    causal = jnp.tril(jnp.ones((s, s), dtype=bool))
+    logits = jnp.where(causal[None, None, None, :, :], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bkgqs,bskd->bqkgd", probs, v.astype(jnp.float32))
+    return out.reshape(b, s, h, d).astype(q.dtype)
+
+
+class Mlp(nn.Module):
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        cfg = self.cfg
+        proj = partial(Einsum, dtype=cfg.dtype, param_dtype=cfg.param_dtype)
+        h_dim = x.shape[-1]
+        gate = proj(
+            "bse,em->bsm", (h_dim, cfg.intermediate_size),
+            ("embed", "mlp"), name="w_gate")(x)
+        up = proj(
+            "bse,em->bsm", (h_dim, cfg.intermediate_size),
+            ("embed", "mlp"), name="w_up")(x)
+        h = nn.silu(gate) * up
+        h = nn.with_logical_constraint(h, ("batch", "act_seq", "act_mlp"))
+        return proj(
+            "bsm,me->bse", (cfg.intermediate_size, h_dim),
+            ("mlp", "embed"), name="w_down")(h)
+
+
+class Block(nn.Module):
+    cfg: LlamaConfig
+    decode: bool = False
+
+    @nn.compact
+    def __call__(self, x: jax.Array, positions: jax.Array):
+        cfg = self.cfg
+        x = nn.with_logical_constraint(x, ("batch", "act_seq", "act_embed"))
+        h = RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="attn_norm")(x)
+        x = x + Attention(cfg, self.decode, name="attn")(h, positions)
+        h = RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="mlp_norm")(x)
+        x = x + Mlp(cfg, name="mlp")(h)
+        x = nn.with_logical_constraint(x, ("batch", "act_seq", "act_embed"))
+        return x
+
+
+class _ScanBlock(nn.Module):
+    """Block wrapped for nn.scan: carry = activations, no per-layer output."""
+
+    cfg: LlamaConfig
+    decode: bool = False
+
+    @nn.compact
+    def __call__(self, x, positions):
+        return Block(self.cfg, self.decode, name="block")(x, positions), None
+
+
+class Llama(nn.Module):
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(
+        self,
+        tokens: jax.Array,
+        positions: Optional[jax.Array] = None,
+        *,
+        decode: bool = False,
+    ) -> jax.Array:
+        cfg = self.cfg
+        if positions is None:
+            positions = jnp.arange(tokens.shape[-1])[None, :]
+        embed = self.param(
+            "embedding",
+            nn.with_logical_partitioning(
+                nn.initializers.normal(stddev=0.02), ("vocab", "embed")),
+            (cfg.vocab_size, cfg.hidden_size), cfg.param_dtype,
+        )
+        x = embed.astype(cfg.dtype)[tokens]
+        x = nn.with_logical_constraint(x, ("batch", "act_seq", "act_embed"))
+
+        block_cls = Block
+        if cfg.remat:
+            block_cls = nn.remat(
+                Block,
+                policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+                prevent_cse=False,
+            )
+        if cfg.scan_layers:
+            scan_cls = _ScanBlock
+            if cfg.remat:
+                scan_cls = nn.remat(
+                    _ScanBlock,
+                    policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+                    prevent_cse=False,
+                )
+            x, _ = nn.scan(
+                scan_cls,
+                variable_axes={"params": 0, "cache": 0},
+                split_rngs={"params": True},
+                in_axes=nn.broadcast,
+                length=cfg.num_layers,
+                metadata_params={nn.PARTITION_NAME: "layers"},
+            )(cfg, decode, name="layers")(x, positions)
+        else:
+            for i in range(cfg.num_layers):
+                x = block_cls(cfg, decode, name=f"layer_{i}")(x, positions)
+
+        x = RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="final_norm")(x)
+        if cfg.tie_embeddings:
+            logits = jnp.einsum("bse,ve->bsv", x.astype(jnp.float32), embed.astype(jnp.float32))
+        else:
+            unembed = self.param(
+                "unembedding",
+                nn.with_logical_partitioning(
+                    nn.initializers.normal(stddev=0.02), ("embed", "vocab")),
+                (cfg.hidden_size, cfg.vocab_size), cfg.param_dtype,
+            )
+            logits = jnp.einsum("bse,ev->bsv", x.astype(jnp.float32), unembed.astype(jnp.float32))
+        return nn.with_logical_constraint(logits, ("batch", "act_seq", "act_vocab"))
+
+
+def num_params(cfg: LlamaConfig) -> int:
+    """Closed-form parameter count (for tokens/sec -> MFU conversion)."""
+    h, v, m = cfg.hidden_size, cfg.vocab_size, cfg.intermediate_size
+    attn = h * cfg.num_heads * cfg.head_dim * 2 + h * cfg.num_kv_heads * cfg.head_dim * 2
+    mlp = 3 * h * m
+    per_layer = attn + mlp + 2 * h
+    out = v * h if cfg.tie_embeddings else 2 * v * h
+    return per_layer * cfg.num_layers + out + h
+
+
+def flops_per_token(cfg: LlamaConfig, seq_len: int) -> float:
+    """Approx train FLOPs/token: 6*N + attention quadratic term."""
+    n = num_params(cfg)
+    attn_flops = 12 * cfg.num_layers * cfg.num_heads * cfg.head_dim * seq_len
+    return 6.0 * n + attn_flops

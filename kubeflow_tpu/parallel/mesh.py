@@ -157,6 +157,29 @@ def build_mesh(
     return Mesh(dev_array, plan.axis_names)
 
 
+_ACTIVE_MESH: list[Mesh] = []
+
+
+class active_mesh:
+    """Context manager making ``mesh`` discoverable by model internals
+    (e.g. ring attention's shard_map needs the physical mesh, which flax
+    module call signatures don't carry)."""
+
+    def __init__(self, mesh: Mesh):
+        self.mesh = mesh
+
+    def __enter__(self) -> Mesh:
+        _ACTIVE_MESH.append(self.mesh)
+        return self.mesh
+
+    def __exit__(self, *exc) -> None:
+        _ACTIVE_MESH.pop()
+
+
+def current_mesh() -> Optional[Mesh]:
+    return _ACTIVE_MESH[-1] if _ACTIVE_MESH else None
+
+
 def batch_sharding(mesh: Mesh) -> NamedSharding:
     """Input-batch sharding: batch dim over every batch-like axis present."""
     batch_axes = tuple(a for a in mesh.axis_names if a in BATCH_AXES)

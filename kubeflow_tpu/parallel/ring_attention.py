@@ -1,0 +1,193 @@
+"""Ring attention: blockwise causal attention over a sequence-sharded mesh.
+
+Long-context capability the reference lacks entirely (SURVEY.md §5 "long
+context / sequence parallelism: absent in the reference") but the north
+star's Llama target demands.  Design is the ring-attention recipe on the TPU
+ICI torus: each device owns one sequence block of Q/K/V; K/V blocks rotate
+around the ``seq`` mesh axis with ``lax.ppermute`` while each device folds
+every visiting block into a flash-style online-softmax accumulator.  Peak
+memory is O(seq/ring) per device and the permute overlaps with the block
+matmuls (XLA schedules the collective-permute async on TPU).
+
+Also here: ``ulysses_attention`` — the all-to-all alternative (swap
+sequence-sharding for head-sharding around the attention core), cheaper when
+heads >= ring size and the full-sequence attention fits memory.
+
+All functions are differentiable (ppermute/all_to_all have transpose rules;
+the accumulator is a ``lax.scan``), so the same code path serves training.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .mesh import BATCH_AXES, current_mesh
+
+_NEG_INF = -1e30
+
+
+def _specs(mesh: Mesh, seq_axis: str):
+    batch = tuple(a for a in mesh.axis_names if a in BATCH_AXES) or None
+    model = "model" if "model" in mesh.axis_names else None
+    q_spec = P(batch, seq_axis, model, None)
+    kv_spec = P(batch, seq_axis, model, None)
+    return q_spec, kv_spec
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str = "seq",
+    q_per_kv: int = 1,
+    mesh: Optional[Mesh] = None,
+) -> jax.Array:
+    """Causal GQA attention with sequence sharded on ``axis_name``.
+
+    q: [b, s, h, d]; k, v: [b, s, kv, d] (global shapes; sharding constraints
+    put the s dim on the ``seq`` mesh axis).  Falls back to dense attention
+    when no seq axis is active, so models can enable it unconditionally.
+    """
+    mesh = mesh or current_mesh()
+    if (
+        mesh is None
+        or axis_name not in mesh.axis_names
+        or mesh.shape[axis_name] == 1
+    ):
+        from ..models.llama import _causal_attention
+
+        return _causal_attention(q, k, v, q_per_kv)
+
+    q_spec, kv_spec = _specs(mesh, axis_name)
+    fn = jax.shard_map(
+        partial(
+            _ring_forward,
+            axis_name=axis_name,
+            ring_size=mesh.shape[axis_name],
+            q_per_kv=q_per_kv,
+        ),
+        mesh=mesh,
+        in_specs=(q_spec, kv_spec, kv_spec),
+        out_specs=q_spec,
+        check_vma=False,
+    )
+    return fn(q, k, v)
+
+
+def _block_fold(acc, qh, k_blk, v_blk, q_pos, k_pos, scale):
+    """Fold one visiting K/V block into the online-softmax accumulator.
+
+    qh: [b, sq, kv, g, d]; k_blk/v_blk: [b, sk, kv, d].
+    acc = (m, l, o): running max [b,sq,kv,g], denom [b,sq,kv,g],
+    numerator [b,sq,kv,g,d] — all float32.
+    """
+    m, l, o = acc
+    logits = jnp.einsum("bqkgd,bskd->bqkgs", qh, k_blk.astype(jnp.float32)) * scale
+    causal = q_pos[:, None] >= k_pos[None, :]  # [sq, sk]
+    logits = jnp.where(causal[None, :, None, None, :], logits, _NEG_INF)
+    m_new = jnp.maximum(m, logits.max(axis=-1))
+    corr = jnp.exp(m - m_new)
+    p = jnp.exp(logits - m_new[..., None])
+    l_new = l * corr + p.sum(axis=-1)
+    o_new = o * corr[..., None] + jnp.einsum(
+        "bqkgs,bskd->bqkgd", p, v_blk.astype(jnp.float32))
+    return m_new, l_new, o_new
+
+
+def _ring_forward(q, k, v, *, axis_name: str, ring_size: int, q_per_kv: int):
+    """Per-shard body: local q stays put; k/v ride the ring."""
+    b, sq, h, d = q.shape
+    kvh = k.shape[2]
+    my = lax.axis_index(axis_name)
+    scale = 1.0 / jnp.sqrt(d).astype(jnp.float32)
+    qh = q.reshape(b, sq, kvh, q_per_kv, d).astype(jnp.float32)
+    q_pos = my * sq + jnp.arange(sq)
+
+    m0 = jnp.full((b, sq, kvh, q_per_kv), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, sq, kvh, q_per_kv), jnp.float32)
+    o0 = jnp.zeros((b, sq, kvh, q_per_kv, d), jnp.float32)
+    perm = [(i, (i + 1) % ring_size) for i in range(ring_size)]
+
+    def step(carry, t):
+        k_blk, v_blk, acc = carry
+        src = (my - t) % ring_size  # whose block we hold at step t
+        k_pos = src * sq + jnp.arange(sq)
+        acc = _block_fold(acc, qh, k_blk, v_blk, q_pos, k_pos, scale)
+        # rotate for the next step (the final rotate is dead code XLA drops)
+        k_nxt = lax.ppermute(k_blk, axis_name, perm)
+        v_nxt = lax.ppermute(v_blk, axis_name, perm)
+        return (k_nxt, v_nxt, acc), None
+
+    (_, _, (m, l, o)), _ = lax.scan(
+        step, (k, v, (m0, l0, o0)), jnp.arange(ring_size))
+    out = o / l[..., None]
+    return out.reshape(b, sq, h, d).astype(q.dtype)
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str = "seq",
+    q_per_kv: int = 1,
+    mesh: Optional[Mesh] = None,
+) -> jax.Array:
+    """Ulysses-style SP: all-to-all heads<->sequence swap around dense attention.
+
+    Each device trades its sequence shard of all heads for the full sequence
+    of heads/ring_size heads, runs ordinary causal attention, and swaps back.
+    Two all-to-alls per call; requires num_kv_heads % ring_size == 0.
+    """
+    mesh = mesh or current_mesh()
+    if (
+        mesh is None
+        or axis_name not in mesh.axis_names
+        or mesh.shape[axis_name] == 1
+    ):
+        from ..models.llama import _causal_attention
+
+        return _causal_attention(q, k, v, q_per_kv)
+
+    ring = mesh.shape[axis_name]
+    # head counts as seen inside shard_map: already divided by any TP axis
+    tp = mesh.shape.get("model", 1) if "model" in mesh.axis_names else 1
+    local_q, local_kv = q.shape[2] // tp, k.shape[2] // tp
+    if (
+        q.shape[2] % tp
+        or k.shape[2] % tp
+        or local_kv % ring
+        or local_q % ring
+    ):
+        raise ValueError(
+            f"ulysses needs per-shard head counts (q={q.shape[2]}/{tp}, "
+            f"kv={k.shape[2]}/{tp}) divisible by seq axis size {ring}")
+    q_spec, kv_spec = _specs(mesh, axis_name)
+
+    def body(q, k, v):
+        # [b, s/r, h, d] -> all_to_all -> [b, s, h/r, d]
+        def gather_seq(x):
+            return lax.all_to_all(
+                x, axis_name, split_axis=2, concat_axis=1, tiled=True)
+
+        def scatter_seq(x):
+            return lax.all_to_all(
+                x, axis_name, split_axis=1, concat_axis=2, tiled=True)
+
+        from ..models.llama import _causal_attention
+
+        out = _causal_attention(
+            gather_seq(q), gather_seq(k), gather_seq(v), q_per_kv)
+        return scatter_seq(out)
+
+    return jax.shard_map(
+        body, mesh=mesh, in_specs=(q_spec, kv_spec, kv_spec),
+        out_specs=q_spec, check_vma=False,
+    )(q, k, v)

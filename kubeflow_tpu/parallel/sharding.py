@@ -1,0 +1,129 @@
+"""Logical-axis sharding rules: the single table that maps model-space axis
+names to mesh axes.
+
+The reference has no equivalent — parallelism layout lives inside user
+containers (Megatron/DeepSpeed config); the operator only guarantees gang +
+env (SURVEY.md §2.5).  Here layout is a first-class, typed policy: modules
+annotate parameters/activations with *logical* names ("embed", "heads",
+"batch", ...) and this table decides which mesh axis each rides, so the same
+model code runs DP, FSDP, TP, SP or any mix purely by changing the mesh.
+
+This is the scaling-book recipe ("pick a mesh, annotate shardings, let XLA
+insert collectives") factored into one auditable table.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+import jax
+from flax import linen as nn
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from .mesh import BATCH_AXES, active_mesh
+
+#: logical axis -> mesh axis (or tuple of mesh axes) the data rides.
+#: Entries referencing mesh axes absent from the actual mesh are dropped at
+#: lookup time, which is what makes one table serve every parallelism mix.
+LOGICAL_RULES: tuple[tuple[str, Any], ...] = (
+    # -- activations ----------------------------------------------------
+    ("batch", ("replica", "data", "fsdp")),  # batch dim of activations
+    ("act_seq", "seq"),                      # sequence dim under SP/CP
+    ("act_embed", None),                     # residual stream feature dim
+    ("act_heads", "model"),                  # per-head activations under TP
+    ("act_kv_heads", "model"),
+    ("act_mlp", "model"),                    # mlp hidden activations under TP
+    ("act_vocab", "model"),                  # logits vocab dim under TP
+    # -- parameters -----------------------------------------------------
+    ("embed", "fsdp"),                       # ZeRO-3 shard of the feature dim
+    ("vocab", "model"),                      # embedding/unembedding vocab dim
+    ("heads", "model"),                      # attention heads under TP
+    ("kv_heads", "model"),
+    ("head_dim", None),
+    ("mlp", "model"),                        # ffn hidden dim under TP
+    ("layers", "pipeline"),                  # scanned layer stack
+    ("norm", None),
+    ("expert", "expert"),                    # MoE expert dim
+)
+
+
+class shard_context:
+    """Everything model code needs for logical shardings to take effect.
+
+    Enters, together: (a) the flax logical-axis-rules context (without it
+    every ``nn.with_logical_constraint`` silently no-ops), (b)
+    ``jax.sharding.set_mesh`` — the abstract-mesh context flax's
+    ``global_mesh_defined()`` actually checks; the plain ``with mesh:``
+    resource env is NOT seen by flax on jax>=0.9 and the constraints would
+    silently vanish from the HLO — and (c) this package's ``active_mesh``
+    (so ring attention can find the physical mesh).  Wrap both init and the
+    jit call site with it.
+    """
+
+    def __init__(self, mesh: Mesh, overrides: Optional[Sequence[tuple[str, Any]]] = None):
+        self.mesh = mesh
+        self._ctxs = [
+            jax.sharding.set_mesh(mesh),
+            nn.logical_axis_rules(rules_for_mesh(mesh, overrides)),
+            active_mesh(mesh),
+        ]
+
+    def __enter__(self) -> Mesh:
+        for c in self._ctxs:
+            c.__enter__()
+        return self.mesh
+
+    def __exit__(self, *exc) -> None:
+        for c in reversed(self._ctxs):
+            c.__exit__(*exc)
+
+
+def rules_for_mesh(
+    mesh: Mesh, overrides: Optional[Sequence[tuple[str, Any]]] = None
+) -> tuple[tuple[str, Any], ...]:
+    """LOGICAL_RULES restricted to axes that exist in ``mesh``.
+
+    A rule whose mesh axis is absent degrades to replication for that logical
+    axis — e.g. on a pure-DP mesh every parameter rule melts away and the
+    model is replicated, with zero model-code changes.
+    """
+    present = set(mesh.axis_names)
+
+    def keep(target: Any) -> Any:
+        if target is None:
+            return None
+        if isinstance(target, str):
+            return target if target in present else None
+        kept = tuple(t for t in target if t in present)
+        return kept if kept else None
+
+    merged: dict[str, Any] = {name: keep(t) for name, t in LOGICAL_RULES}
+    for name, t in overrides or ():
+        merged[name] = keep(t)
+    return tuple(merged.items())
+
+
+def logical_sharding(
+    mesh: Mesh, *logical_axes: Optional[str], overrides=None
+) -> NamedSharding:
+    """NamedSharding for a value whose dims carry the given logical names."""
+    spec = nn.logical_to_mesh_sharding(
+        PartitionSpec(*logical_axes), mesh, rules_for_mesh(mesh, overrides)
+    )
+    return spec
+
+
+def shard_constraint(x: jax.Array, mesh: Mesh, *logical_axes: Optional[str]):
+    """Activation sharding constraint by logical names (use inside jit)."""
+    return jax.lax.with_sharding_constraint(x, logical_sharding(mesh, *logical_axes))
+
+
+def param_shardings(abstract_params: Any, mesh: Mesh, overrides=None) -> Any:
+    """Tree of NamedShardings from flax param-metadata (with_logical_partitioning).
+
+    ``abstract_params`` is the output of ``jax.eval_shape`` over ``model.init``
+    (or the real variables) — anything whose leaves are ``nn.Partitioned``
+    boxes carrying logical names.
+    """
+    logical_spec = nn.get_partition_spec(abstract_params)
+    return nn.logical_to_mesh_sharding(logical_spec, mesh, rules_for_mesh(mesh, overrides))

@@ -1,0 +1,88 @@
+"""Checkpoint/resume: Orbax multi-host async save + topology-reshape restore.
+
+The reference control plane has NO checkpointing of its own (SURVEY.md §5:
+user-owned, framework checkpoints to PVC/GCS; MPIJob restart = rerun the
+launcher).  Here it is first-class, because TPU elasticity IS
+checkpoint-restart (a slice cannot grow in place): save-on-interval +
+save-on-preemption, then restore onto a *different* mesh/world size by
+re-sharding at load (the Tenplex pattern, PAPERS.md).
+
+Orbax already does the hard parts (async device-to-host, per-host shard
+writing, atomic commit via rename); this module pins the framework's
+conventions: step-numbered directories, a single `state` item holding the
+pytree, restore-with-shardings for reshape.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+import orbax.checkpoint as ocp
+
+
+class CheckpointManager:
+    """Thin policy layer over ``ocp.CheckpointManager``.
+
+    save(step, state) is async (returns immediately; Orbax finishes the
+    write in a background thread, multi-host-coordinated).  restore(state
+    shardings) re-shards onto whatever mesh the caller is running now —
+    the world size at save time is irrelevant, which is what makes
+    checkpoint-restart elasticity work.
+    """
+
+    def __init__(
+        self,
+        directory: str,
+        *,
+        save_interval_steps: int = 100,
+        max_to_keep: int = 3,
+    ):
+        self.directory = os.path.abspath(directory)
+        options = ocp.CheckpointManagerOptions(
+            save_interval_steps=save_interval_steps,
+            max_to_keep=max_to_keep,
+            enable_async_checkpointing=True,
+            create=True,
+        )
+        self._mgr = ocp.CheckpointManager(self.directory, options=options)
+
+    def save(self, step: int, state: Any, *, force: bool = False) -> bool:
+        """Async save; returns True if a save was actually started."""
+        return self._mgr.save(
+            step, args=ocp.args.StandardSave(state), force=force)
+
+    def restore(self, target: Any, step: Optional[int] = None) -> Any:
+        """Restore onto the shardings/structure of ``target``.
+
+        ``target`` may be a pytree of real arrays or of
+        ``jax.ShapeDtypeStruct`` with ``.sharding`` set — the reshape path:
+        build the abstract state for the NEW mesh and restore into it.
+        """
+        step = self.latest_step() if step is None else step
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint under {self.directory}")
+        abstract = jax.tree.map(_as_abstract, target)
+        return self._mgr.restore(step, args=ocp.args.StandardRestore(abstract))
+
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def all_steps(self) -> list[int]:
+        return list(self._mgr.all_steps())
+
+    def wait_until_finished(self) -> None:
+        self._mgr.wait_until_finished()
+
+    def close(self) -> None:
+        self._mgr.wait_until_finished()
+        self._mgr.close()
+
+
+def _as_abstract(x: Any) -> Any:
+    if isinstance(x, jax.ShapeDtypeStruct):
+        return x
+    if isinstance(x, jax.Array):
+        return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding)
+    return x

@@ -1,0 +1,94 @@
+"""Input pipeline: per-process sharded batches onto the global mesh.
+
+The reference's data story is "each rank loads its own shard" (DDP samplers,
+TF datasets) — the operator only sets rank envs (SURVEY.md §2.5 DP row).
+The TPU-native equivalent: every host builds only its local slice of the
+global batch and ``jax.make_array_from_process_local_data`` assembles the
+global sharded array; XLA never sees host boundaries.
+
+Synthetic streams keep tests/benches hermetic (zero-egress environment — the
+reference's MNIST/C4 downloads are impossible here); real corpora plug in
+through the same ``BatchSource`` protocol.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Protocol
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding
+
+
+class BatchSource(Protocol):
+    """A per-process source of host-local batch shards."""
+
+    def local_batch(self, step: int) -> dict[str, np.ndarray]:
+        ...
+
+
+class SyntheticLm(BatchSource):
+    """Deterministic fake LM tokens: a fixed-order Markov-ish stream derived
+    from a hash of (step, process, position).  Deterministic across runs and
+    independent of world size for a fixed global batch."""
+
+    def __init__(
+        self,
+        global_batch: int,
+        seq_len: int,
+        vocab_size: int,
+        *,
+        process_index: int | None = None,
+        process_count: int | None = None,
+        seed: int = 0,
+    ):
+        self.global_batch = global_batch
+        self.seq_len = seq_len
+        self.vocab_size = vocab_size
+        self.proc = jax.process_index() if process_index is None else process_index
+        self.nproc = jax.process_count() if process_count is None else process_count
+        if global_batch % self.nproc:
+            raise ValueError(
+                f"global batch {global_batch} not divisible by {self.nproc} processes")
+        self.local_bs = global_batch // self.nproc
+        self.seed = seed
+
+    def local_batch(self, step: int) -> dict[str, np.ndarray]:
+        # rows [proc*local_bs, (proc+1)*local_bs) of the global batch
+        row0 = self.proc * self.local_bs
+        rows = np.arange(row0, row0 + self.local_bs, dtype=np.uint64)
+        # splitmix64-style hash of (seed, step, row) -> per-row start/stride;
+        # uint64 wraparound is the point, so silence overflow warnings
+        with np.errstate(over="ignore"):
+            x = (
+                rows * np.uint64(0x9E3779B97F4A7C15)
+                + np.uint64(step) * np.uint64(0x94D049BB133111EB)
+                + np.uint64(self.seed)
+            )
+            x ^= x >> np.uint64(30)
+            x *= np.uint64(0xBF58476D1CE4E5B9)
+            x ^= x >> np.uint64(27)
+        # each row is an arithmetic token sequence: learnable structure (the
+        # stride is inferable from any two neighbors) with hash-random phase
+        start = (x % np.uint64(self.vocab_size)).astype(np.int64)
+        stride = ((x >> np.uint64(17)) % np.uint64(7) + np.uint64(1)).astype(np.int64)
+        pos = np.arange(self.seq_len + 1, dtype=np.int64)
+        tokens = (start[:, None] + stride[:, None] * pos[None, :]) % self.vocab_size
+        return {"tokens": tokens.astype(np.int32)}
+
+
+def device_batches(
+    source: BatchSource, sharding: NamedSharding, steps: int, start_step: int = 0
+) -> Iterator[dict[str, jax.Array]]:
+    """Assemble host-local shards into global arrays on the mesh.
+
+    ``start_step`` keys the source at the resumed position so a restore
+    continues the data stream instead of replaying it from step 0.
+    """
+    for step in range(start_step, start_step + steps):
+        local = source.local_batch(step)
+        yield {
+            k: jax.make_array_from_process_local_data(sharding, v)
+            for k, v in local.items()
+        }

@@ -1,0 +1,63 @@
+"""JaxJob entrypoint for LLM training: the packaged fine-tune/pretrain main.
+
+The reference analog is the trainer container the SDK's ``train()`` injects
+[upstream: training-operator -> sdk/python/kubeflow/training, train() v1.9
+LLM path] — torch/peft behind a PyTorchJob.  Here: the Trainer over the
+job's global mesh behind a JaxJob, config via env (the CRD-env contract the
+controller injects, same channel the reference uses for MASTER_ADDR et al).
+
+Env knobs (all optional):
+  KFT_MODEL_PRESET  llama preset name (default "tiny")
+  KFT_STEPS, KFT_BATCH, KFT_SEQ_LEN, KFT_LR, KFT_CKPT_DIR, KFT_SAVE_EVERY
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+from ..models import llama as llamalib
+from ..runtime import bootstrap
+from . import trainer as trainlib
+
+
+def config_from_env(ctx: "bootstrap.PodContext") -> trainlib.TrainConfig:
+    e = os.environ
+    preset = e.get("KFT_MODEL_PRESET", "tiny")
+    model = llamalib.PRESETS[preset]()
+    return trainlib.TrainConfig(
+        model=model,
+        mesh_axes=dict(ctx.mesh_axes),
+        global_batch=int(e.get("KFT_BATCH", "8")),
+        seq_len=int(e.get("KFT_SEQ_LEN", "64")),
+        steps=int(e.get("KFT_STEPS", "10")),
+        learning_rate=float(e.get("KFT_LR", "3e-4")),
+        warmup_steps=int(e.get("KFT_WARMUP", "5")),
+        checkpoint_dir=e.get("KFT_CKPT_DIR") or None,
+        save_interval_steps=int(e.get("KFT_SAVE_EVERY", "100")),
+        log_every=int(e.get("KFT_LOG_EVERY", "5")),
+    )
+
+
+def train_main(ctx: "bootstrap.PodContext") -> None:
+    """Runs on every worker; emits per-step metrics from the coordinator."""
+    cfg = config_from_env(ctx)
+    t = trainlib.Trainer(cfg)
+
+    def on_metrics(m: trainlib.StepMetrics) -> None:
+        if ctx.is_coordinator:
+            bootstrap.emit_metric(ctx, "loss", m.loss, step=m.step)
+            bootstrap.emit_metric(
+                ctx, "tokens_per_sec_per_chip", m.tokens_per_sec_per_chip,
+                step=m.step)
+
+    final = t.train(on_metrics=on_metrics)
+    if ctx.is_coordinator and final is not None:
+        bootstrap.emit_metric(ctx, "final_loss", final.loss)
+        bootstrap.emit_metric(ctx, "mfu", final.mfu)
+    # every process syncs before exit so Succeeded means "all ranks done"
+    if ctx.num_processes > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(f"{ctx.job_name}-train-done")

@@ -1,0 +1,229 @@
+"""The in-container training runtime: sharded train step, metering, resume.
+
+This is the layer upstream Kubeflow leaves to third-party frameworks
+(SURVEY.md §1 closing paragraph) and the rebuild owns: given a mesh plan and
+a model config, build the sharded state, run the jitted step loop, meter
+tokens/sec/chip (the headline BASELINE metric), checkpoint/restore with
+reshape.  Equivalent surface in the reference ecosystem: the training loops
+inside TFJob/PyTorchJob user containers plus the SDK's packaged fine-tune
+script [upstream: training-operator -> sdk/python/kubeflow/training, train()].
+
+TPU-first: one ``jax.jit``-compiled step (donated state, sharded in/out) —
+all collectives inserted by XLA from the sharding annotations; no gradient
+bucketing/overlap machinery to hand-tune like NCCL DDP.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import linen as nn
+
+from ..models import llama as llamalib
+from ..parallel import mesh as meshlib
+from ..parallel import sharding as shardlib
+from . import checkpoint as ckptlib
+from . import data as datalib
+
+#: bf16 peak matmul TFLOP/s per chip, for MFU reporting.
+PEAK_TFLOPS = {"tpu v5 lite": 197.0, "tpu v5": 197.0, "cpu": 0.0}
+
+
+@dataclasses.dataclass
+class TrainConfig:
+    model: llamalib.LlamaConfig = dataclasses.field(default_factory=llamalib.tiny)
+    mesh_axes: dict[str, int] = dataclasses.field(default_factory=dict)
+    num_slices: int = 1
+    global_batch: int = 8
+    seq_len: int = 128
+    steps: int = 20
+    learning_rate: float = 3e-4
+    warmup_steps: int = 10
+    weight_decay: float = 0.1
+    grad_clip_norm: float = 1.0
+    b1: float = 0.9
+    b2: float = 0.95
+    checkpoint_dir: Optional[str] = None
+    save_interval_steps: int = 100
+    log_every: int = 10
+
+
+@dataclasses.dataclass
+class StepMetrics:
+    step: int
+    loss: float
+    grad_norm: float
+    step_time_s: float
+    tokens_per_sec: float
+    tokens_per_sec_per_chip: float
+    mfu: float
+
+
+class Trainer:
+    """Builds the sharded train state and runs compiled steps.
+
+    All public methods must be called on every process of the job (SPMD) —
+    the same contract as the reference's per-rank training scripts.
+    """
+
+    def __init__(self, cfg: TrainConfig, devices: Optional[list] = None):
+        self.cfg = cfg
+        devices = devices if devices is not None else jax.devices()
+        axes = dict(cfg.mesh_axes) or {"data": len(devices)}
+        self.mesh = meshlib.build_mesh(axes, devices=devices, num_slices=cfg.num_slices)
+        self.model = llamalib.Llama(cfg.model)
+        self.tx = optax.chain(
+            optax.clip_by_global_norm(cfg.grad_clip_norm),
+            optax.adamw(
+                optax.warmup_cosine_decay_schedule(
+                    0.0, cfg.learning_rate, cfg.warmup_steps,
+                    max(cfg.steps, cfg.warmup_steps + 1)),
+                b1=cfg.b1, b2=cfg.b2, weight_decay=cfg.weight_decay,
+            ),
+        )
+        self.batch_sharding = meshlib.batch_sharding(self.mesh)
+        self._step_fn = None
+        self._abstract_state = None
+        self.ckpt = (
+            ckptlib.CheckpointManager(
+                cfg.checkpoint_dir, save_interval_steps=cfg.save_interval_steps)
+            if cfg.checkpoint_dir
+            else None
+        )
+
+    # -- state ------------------------------------------------------------
+
+    def _init_fn(self, rng: jax.Array) -> dict[str, Any]:
+        # batch = global batch so batch-axis sharding inside the model (e.g.
+        # ring attention's shard_map) sees divisible sizes during init
+        dummy = jnp.ones((self.cfg.global_batch, self.cfg.seq_len), jnp.int32)
+        variables = self.model.init(rng, dummy)
+        params = variables["params"]
+        return {
+            "step": jnp.zeros((), jnp.int32),
+            "params": params,
+            "opt_state": self.tx.init(params),
+        }
+
+    def abstract_state(self) -> Any:
+        """Unboxed ShapeDtypeStructs with shardings attached — the canonical
+        description of the train state on THIS mesh (used by jit shardings,
+        reshape-restore, and the dry-run compile check alike).  Cached: the
+        eval_shape trace over a big scanned model is seconds of work."""
+        if self._abstract_state is None:
+            boxed = jax.eval_shape(self._init_fn, jax.random.PRNGKey(0))
+            shardings = shardlib.param_shardings(boxed, self.mesh)
+            self._abstract_state = jax.tree.map(
+                lambda a, s: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=s),
+                nn.meta.unbox(boxed), shardings,
+            )
+        return self._abstract_state
+
+    def init_state(self, seed: int = 0) -> Any:
+        """Initialize sharded: weights are born on the mesh (no host round
+        trip — a 7B state never materializes on one host)."""
+        shardings = jax.tree.map(lambda a: a.sharding, self.abstract_state())
+        with shardlib.shard_context(self.mesh):
+            state = jax.jit(
+                self._init_fn, out_shardings=shardings
+            )(jax.random.PRNGKey(seed))
+        return nn.meta.unbox(state)
+
+    def restore_or_init(self, seed: int = 0) -> Any:
+        """Resume from the newest checkpoint if one exists — onto the
+        CURRENT mesh, whatever topology wrote it (reshape-restore)."""
+        if self.ckpt and self.ckpt.latest_step() is not None:
+            return self.ckpt.restore(self.abstract_state())
+        return self.init_state(seed)
+
+    # -- step -------------------------------------------------------------
+
+    def _loss_fn(self, params, tokens: jax.Array):
+        inputs, targets = tokens[:, :-1], tokens[:, 1:]
+        logits = self.model.apply({"params": params}, inputs)
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits.astype(jnp.float32), targets).mean()
+        return loss
+
+    def _train_step(self, state, batch):
+        loss, grads = jax.value_and_grad(self._loss_fn)(
+            state["params"], batch["tokens"])
+        grad_norm = optax.global_norm(grads)
+        updates, opt_state = self.tx.update(
+            grads, state["opt_state"], state["params"])
+        params = optax.apply_updates(state["params"], updates)
+        new_state = {
+            "step": state["step"] + 1, "params": params, "opt_state": opt_state}
+        return new_state, {"loss": loss, "grad_norm": grad_norm}
+
+    def compiled_step(self) -> Callable:
+        if self._step_fn is None:
+            shardings = jax.tree.map(lambda a: a.sharding, self.abstract_state())
+            self._step_fn = jax.jit(
+                self._train_step,
+                in_shardings=(shardings, {"tokens": self.batch_sharding}),
+                out_shardings=(shardings, None),
+                donate_argnums=(0,),
+            )
+        return self._step_fn
+
+    # -- loop -------------------------------------------------------------
+
+    def train(
+        self,
+        source: Optional[datalib.BatchSource] = None,
+        on_metrics: Optional[Callable[[StepMetrics], None]] = None,
+    ) -> StepMetrics:
+        cfg = self.cfg
+        source = source or datalib.SyntheticLm(
+            cfg.global_batch, cfg.seq_len, cfg.model.vocab_size)
+        state = self.restore_or_init()
+        step_fn = self.compiled_step()
+        start_step = int(jax.device_get(state["step"]))
+        n_chips = self.mesh.devices.size
+        flops_tok = llamalib.flops_per_token(cfg.model, cfg.seq_len)
+        peak = PEAK_TFLOPS.get(
+            getattr(self.mesh.devices.flat[0], "device_kind", "cpu").lower(), 0.0)
+        tokens_per_step = cfg.global_batch * cfg.seq_len
+
+        metrics = None
+        batches = datalib.device_batches(
+            source, self.batch_sharding, cfg.steps - start_step,
+            start_step=start_step)
+        with shardlib.shard_context(self.mesh):
+            for i, batch in enumerate(batches):
+                step = start_step + i
+                t0 = time.perf_counter()
+                state, out = step_fn(state, batch)
+                loss = float(jax.device_get(out["loss"]))  # blocks on step
+                dt = time.perf_counter() - t0
+                tps = tokens_per_step / dt
+                mfu = (
+                    tps / n_chips * flops_tok / (peak * 1e12)
+                    if peak else 0.0
+                )
+                metrics = StepMetrics(
+                    step=step + 1,
+                    loss=loss,
+                    grad_norm=float(jax.device_get(out["grad_norm"])),
+                    step_time_s=dt,
+                    tokens_per_sec=tps,
+                    tokens_per_sec_per_chip=tps / n_chips,
+                    mfu=mfu,
+                )
+                if on_metrics and ((step + 1) % cfg.log_every == 0 or step == cfg.steps - 1):
+                    on_metrics(metrics)
+                if self.ckpt:
+                    self.ckpt.save(step + 1, state)
+        if self.ckpt:
+            # orbax force=True still refuses to overwrite an existing step,
+            # so skip if the in-loop save already wrote the final step
+            if cfg.steps not in self.ckpt.all_steps():
+                self.ckpt.save(cfg.steps, state, force=True)
+            self.ckpt.wait_until_finished()
+        return metrics
