@@ -34,6 +34,9 @@ from .experiment import (
     ObjectiveType,
     ParameterSpec,
     ParameterType,
+    Suggestion,
+    SuggestionSpec,
+    SuggestionStatus,
     Trial,
     TrialAssignment,
     TrialSpec,

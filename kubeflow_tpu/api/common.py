@@ -267,6 +267,15 @@ class Container(_Model):
     resources: Resources = Field(default_factory=Resources)
     working_dir: Optional[str] = None
 
+    @field_validator("env", mode="before")
+    @classmethod
+    def _stringify_env(cls, v):
+        # env vars are strings by nature; numbers arrive here via typed
+        # trial-parameter substitution (${trialParameters.x} in a template)
+        if isinstance(v, dict):
+            return {k: str(val) for k, val in v.items()}
+        return v
+
 
 class ReplicaSpec(_Model):
     """[upstream: common_types.go ReplicaSpec] — replicas of one role."""

@@ -147,6 +147,29 @@ class Experiment(TypedObject):
     status: ExperimentStatus = Field(default_factory=ExperimentStatus)
 
 
+class SuggestionSpec(_Model):
+    """Request for parameter assignments [upstream: katib ->
+    pkg/apis/controller/suggestions/v1beta1]: the experiment controller bumps
+    ``requests``; the suggestion controller (running the algorithm service)
+    appends to ``status.assignments`` until it catches up."""
+
+    experiment_name: str = ""
+    algorithm: AlgorithmSpec = Field(default_factory=AlgorithmSpec)
+    requests: int = 0
+
+
+class SuggestionStatus(_Model):
+    assignments: list[dict[str, Any]] = Field(default_factory=list)
+    service_address: Optional[str] = None
+    exhausted: bool = False  # algorithm cannot produce more (grid walked out)
+
+
+class Suggestion(TypedObject):
+    kind: str = KIND_SUGGESTION
+    spec: SuggestionSpec = Field(default_factory=SuggestionSpec)
+    status: SuggestionStatus = Field(default_factory=SuggestionStatus)
+
+
 class TrialSpec(_Model):
     experiment_name: str = ""
     assignments: list[TrialAssignment] = Field(default_factory=list)

@@ -16,7 +16,7 @@ from typing import Any, Type
 import yaml
 
 from .common import TypedObject
-from .experiment import Experiment, Trial
+from .experiment import Experiment, Suggestion, Trial
 from .inference import InferenceService, ServingRuntime
 from .jaxjob import JaxJob
 
@@ -24,6 +24,7 @@ KIND_REGISTRY: dict[str, Type[TypedObject]] = {
     "JaxJob": JaxJob,
     "Experiment": Experiment,
     "Trial": Trial,
+    "Suggestion": Suggestion,
     "InferenceService": InferenceService,
     "ServingRuntime": ServingRuntime,
 }

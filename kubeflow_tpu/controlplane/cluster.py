@@ -54,6 +54,56 @@ class Cluster:
         if self._started:
             c.start()
 
+    def enable_serving(self) -> None:
+        """Register the KServe-tier reconciler + the builtin ``tpu``
+        ServingRuntimes (the north star's JAX/XLA runtime replacing the
+        Triton/GPU path [local: BASELINE.json])."""
+        from ..api.inference import ServingRuntime, ServingRuntimeSpec, SupportedModelFormat
+        from ..serving.controller import InferenceServiceController
+
+        for name, formats, server_class in (
+            ("kft-echo", ["echo"], "kubeflow_tpu.serving.runtimes:EchoModel"),
+            ("kft-jax", ["jax", "flax"], "kubeflow_tpu.serving.runtimes:JaxFunctionModel"),
+            ("kft-llama", ["llama", "llm"], "kubeflow_tpu.serving.runtimes:LlamaGenerator"),
+        ):
+            try:
+                self.store.create(
+                    ServingRuntime(
+                        metadata=ObjectMeta(name=name),
+                        spec=ServingRuntimeSpec(
+                            supported_model_formats=[
+                                SupportedModelFormat(name=f) for f in formats
+                            ],
+                            server_class=server_class,
+                        ),
+                    )
+                )
+            except Exception:  # noqa: BLE001 — already registered
+                pass
+        self.add_controller(InferenceServiceController(self.store))
+
+    def enable_hpo(
+        self,
+        metrics_root: Optional[str] = None,
+        log_path_for=None,
+    ) -> None:
+        """Register the Katib-tier reconcilers (SURVEY.md §2.3).  Separate
+        from __init__ because the trial metrics collector needs the kubelet's
+        filesystem layout, which only the platform knows."""
+        from ..hpo.controllers import (
+            ExperimentController,
+            SuggestionController,
+            TrialController,
+        )
+
+        self.add_controller(ExperimentController(self.store))
+        self.add_controller(SuggestionController(self.store))
+        self.add_controller(
+            TrialController(
+                self.store, metrics_root=metrics_root, log_path_for=log_path_for
+            )
+        )
+
     def add_node(
         self,
         name: str,

@@ -1,0 +1,29 @@
+"""HPO plane: Experiment/Suggestion/Trial reconcilers + algorithm services
+(the Katib capability tier, SURVEY.md §2.3)."""
+
+from .algorithms import (
+    BayesianOptimization,
+    GridSearch,
+    Observation,
+    RandomSearch,
+    SuggestRequest,
+    Tpe,
+    get_suggester,
+)
+from .controllers import ExperimentController, SuggestionController, TrialController
+from .service import SuggestionClient, SuggestionServer
+
+__all__ = [
+    "BayesianOptimization",
+    "ExperimentController",
+    "GridSearch",
+    "Observation",
+    "RandomSearch",
+    "SuggestRequest",
+    "SuggestionClient",
+    "SuggestionController",
+    "SuggestionServer",
+    "Tpe",
+    "TrialController",
+    "get_suggester",
+]

@@ -33,6 +33,10 @@ class LocalPlatform:
         self.kubelet = LocalKubelet(
             self.cluster.store, self.root_dir, env_overrides=env_overrides
         )
+        self.cluster.enable_hpo(
+            metrics_root=self.root_dir, log_path_for=self.kubelet.pod_log_path
+        )
+        self.cluster.enable_serving()
 
     @property
     def store(self):

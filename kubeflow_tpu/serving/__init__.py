@@ -1,0 +1,25 @@
+"""Serving plane: InferenceService controller, model server, JAX runtimes
+(the KServe capability tier, SURVEY.md §2.2)."""
+
+from .controller import InferenceServiceController, Router
+from .model import Model
+from .runtimes import EchoModel, JaxFunctionModel, LlamaGenerator
+from .server import MicroBatcher, ModelServer
+from .storage import StorageError, download, fetch_mem, register_mem
+from .transformer import Transformer
+
+__all__ = [
+    "EchoModel",
+    "InferenceServiceController",
+    "JaxFunctionModel",
+    "LlamaGenerator",
+    "MicroBatcher",
+    "Model",
+    "ModelServer",
+    "Router",
+    "StorageError",
+    "Transformer",
+    "download",
+    "fetch_mem",
+    "register_mem",
+]

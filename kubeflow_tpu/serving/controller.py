@@ -1,0 +1,362 @@
+"""InferenceService reconciler + router/autoscaler.
+
+The KServe control plane rebuilt on this cluster (SURVEY.md §2.2, §3.3)
+[upstream: kserve/kserve -> pkg/controller/v1beta1/inferenceservice]:
+
+- reconcile InferenceService -> resolve ServingRuntime (explicit or
+  model-format auto-selection) -> run the storage initializer -> host the
+  predictor Model in ModelServer replicas -> phase Ready + url;
+- a Router per ISvc gives the stable URL and round-robins replicas (the
+  istio/knative routing tier), with knative-activator-style scale-from-zero:
+  a request arriving with no live replica triggers scale-up and waits;
+- the autoscaler loop (KPA analog) scales replicas between min/max on
+  observed concurrency per replica, and to zero after an idle window when
+  ``min_replicas == 0``;
+- a transformer component chains in front of the predictor over HTTP,
+  exactly KServe's transformer -> predictor hop.
+"""
+
+from __future__ import annotations
+
+import importlib
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Optional
+
+from ..api.inference import (
+    KIND_INFERENCE_SERVICE,
+    KIND_SERVING_RUNTIME,
+    ComponentSpec,
+    InferenceService,
+    InferenceServicePhase,
+    ServingRuntime,
+    select_runtime,
+)
+from ..controlplane.controller import Controller, Result
+from ..controlplane.store import NotFound, Store
+from ..utils.net import free_port
+from .model import Model
+from .server import ModelServer
+from .storage import download
+
+SCALE_IDLE_SECONDS = 2.0  # idle window before scale-down (KPA-ish)
+ACTIVATION_TIMEOUT = 15.0
+
+
+def resolve_class(ref: str) -> type:
+    """'pkg.module:Class' -> class object (ServingRuntime.server_class)."""
+    mod, _, cls = ref.partition(":")
+    return getattr(importlib.import_module(mod), cls)
+
+
+class Router:
+    """Stable URL in front of N replica servers: round-robin + activator."""
+
+    def __init__(self, activate: Callable[[], None], port: Optional[int] = None):
+        self.port = port or free_port()
+        self._backends: list[str] = []
+        self._rr = 0
+        self._lock = threading.Lock()
+        self._activate = activate
+        self.last_request_time = 0.0
+        router = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                pass
+
+            def _proxy(self) -> None:
+                router.last_request_time = time.time()
+                backend = router._pick()
+                if backend is None:
+                    router._activate()
+                    deadline = time.time() + ACTIVATION_TIMEOUT
+                    while backend is None and time.time() < deadline:
+                        time.sleep(0.05)
+                        backend = router._pick()
+                if backend is None:
+                    self._respond(503, b'{"error": "no ready replicas"}')
+                    return
+                length = int(self.headers.get("Content-Length", "0"))
+                body = self.rfile.read(length) if length else None
+                req = urllib.request.Request(
+                    backend + self.path, data=body, method=self.command,
+                    headers={"Content-Type": "application/json"})
+                try:
+                    with urllib.request.urlopen(req, timeout=60) as resp:
+                        self._respond(resp.status, resp.read())
+                except urllib.error.HTTPError as e:
+                    self._respond(e.code, e.read())
+                except OSError as e:
+                    self._respond(502, json.dumps({"error": str(e)}).encode())
+
+            def _respond(self, code: int, body: bytes) -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                self._proxy()
+
+            def do_POST(self):
+                self._proxy()
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", self.port), Handler)
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name=f"router-{self.port}", daemon=True)
+        self._thread.start()
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    def set_backends(self, urls: list[str]) -> None:
+        with self._lock:
+            self._backends = list(urls)
+
+    def _pick(self) -> Optional[str]:
+        with self._lock:
+            if not self._backends:
+                return None
+            self._rr = (self._rr + 1) % len(self._backends)
+            return self._backends[self._rr]
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=2)
+
+
+class _Deployment:
+    """Live serving state for one InferenceService."""
+
+    def __init__(self) -> None:
+        self.predictors: list[ModelServer] = []
+        self.transformers: list[ModelServer] = []
+        self.router: Optional[Router] = None
+        self.wants_scale_up = False
+        self.spec_fingerprint = ""
+
+
+class InferenceServiceController(Controller):
+    kind = KIND_INFERENCE_SERVICE
+    # one worker: reconciles mutate live _Deployment state (servers, router
+    # backends); two workers on the same key would race — the workqueue only
+    # dedups queued keys, not in-flight ones
+    workers = 1
+
+    def __init__(self, store: Store) -> None:
+        super().__init__(store)
+        self._deployments: dict[str, _Deployment] = {}
+        self._lock = threading.Lock()
+
+    def stop(self) -> None:
+        super().stop()
+        for d in list(self._deployments.values()):
+            self._teardown_deployment(d)
+        self._deployments.clear()
+
+    # -- reconcile --------------------------------------------------------
+
+    def reconcile(self, namespace: str, name: str) -> Optional[Result]:
+        key = f"{namespace}/{name}"
+        isvc = self.store.try_get(KIND_INFERENCE_SERVICE, name, namespace)
+        if isvc is None:
+            with self._lock:
+                dep = self._deployments.pop(key, None)
+            if dep:
+                self._teardown_deployment(dep)
+            return None
+        assert isinstance(isvc, InferenceService)
+
+        try:
+            runtime_cls, cfg = self._resolve(isvc)
+        except Exception as e:  # noqa: BLE001 — config errors -> Failed phase
+            self._set_status(
+                isvc, phase=InferenceServicePhase.FAILED, message=f"{type(e).__name__}: {e}")
+            return None
+
+        with self._lock:
+            dep = self._deployments.setdefault(key, _Deployment())
+        fingerprint = json.dumps(isvc.spec.model_dump(mode="json"), sort_keys=True)
+        if dep.spec_fingerprint and dep.spec_fingerprint != fingerprint:
+            self._teardown_deployment(dep)
+            with self._lock:
+                dep = self._deployments.setdefault(key, _Deployment())
+                self._deployments[key] = dep
+        dep.spec_fingerprint = fingerprint
+
+        pred = isvc.spec.predictor
+        if dep.router is None:
+            dep.router = Router(activate=lambda: self._request_scale_up(key))
+            self._set_status(isvc, phase=InferenceServicePhase.LOADING,
+                             message="starting predictor")
+
+        desired = self._desired_replicas(dep, pred)
+        changed = self._scale_predictors(isvc, dep, runtime_cls, cfg, desired)
+        self._wire(isvc, dep)
+
+        ready = bool(dep.predictors) or pred.min_replicas == 0
+        self._set_status(
+            isvc,
+            phase=InferenceServicePhase.READY if ready else InferenceServicePhase.LOADING,
+            url=dep.router.url,
+            active_replicas=len(dep.predictors),
+            message="",
+        )
+        # periodic requeue drives the autoscaler loop
+        return Result(requeue_after=0.25)
+
+    # -- scaling ----------------------------------------------------------
+
+    def _desired_replicas(self, dep: _Deployment, pred: ComponentSpec) -> int:
+        n = len(dep.predictors)
+        if dep.wants_scale_up:
+            dep.wants_scale_up = False
+            return max(n, 1, pred.min_replicas)
+        inflight = sum(
+            s.metrics.inflight for s in dep.predictors
+        )
+        if n and inflight / n > pred.scale_target_concurrency:
+            return min(n + 1, pred.max_replicas)
+        idle = (
+            dep.router is not None
+            and time.time() - dep.router.last_request_time > SCALE_IDLE_SECONDS
+        )
+        if idle and n > pred.min_replicas:
+            return max(n - 1, pred.min_replicas)
+        return max(n, pred.min_replicas)
+
+    def _scale_predictors(
+        self, isvc, dep: _Deployment, runtime_cls, cfg: dict, desired: int
+    ) -> bool:
+        changed = False
+        while len(dep.predictors) < desired:
+            server = ModelServer()
+            model = runtime_cls(isvc.metadata.name, cfg)
+            pred = isvc.spec.predictor
+            server.register(
+                model,
+                batch_max_size=pred.batch_max_size,
+                batch_timeout_ms=pred.batch_timeout_ms,
+            )
+            server.start()
+            dep.predictors.append(server)
+            self.emit_event(isvc, "ReplicaStarted", server.url)
+            changed = True
+        while len(dep.predictors) > desired:
+            server = dep.predictors.pop()
+            self._wire(isvc, dep)  # drop from router before stopping
+            server.stop()
+            self.emit_event(isvc, "ReplicaStopped", server.url)
+            changed = True
+        return changed
+
+    def _wire(self, isvc, dep: _Deployment) -> None:
+        """Point the router at the right tier (transformer else predictor)."""
+        tspec = isvc.spec.transformer
+        if tspec and tspec.handler:
+            if not dep.transformers and dep.predictors:
+                cls = resolve_class(tspec.handler)
+                cfg = dict(tspec.config)
+                cfg["predictor_url"] = None  # filled per request via backends
+                server = ModelServer()
+                model = cls(isvc.metadata.name, {
+                    **cfg, "predictor_urls": [s.url for s in dep.predictors],
+                    "model_name": isvc.metadata.name,
+                })
+                server.register(model, batch_max_size=tspec.batch_max_size,
+                                batch_timeout_ms=tspec.batch_timeout_ms)
+                server.start()
+                dep.transformers.append(server)
+            if dep.transformers:
+                # keep the transformer's predictor list current: predictors
+                # churn on every scale event and ports never come back
+                urls = [s.url for s in dep.predictors]
+                for ts in dep.transformers:
+                    for m in ts.models().values():
+                        if hasattr(m, "predictor_urls"):
+                            m.predictor_urls = list(urls)
+                dep.router.set_backends([s.url for s in dep.transformers])
+                return
+        if dep.router:
+            dep.router.set_backends([s.url for s in dep.predictors])
+
+    def _request_scale_up(self, key: str) -> None:
+        with self._lock:
+            dep = self._deployments.get(key)
+        if dep is not None:
+            dep.wants_scale_up = True
+        self.queue.add(key)
+
+    # -- resolution -------------------------------------------------------
+
+    def _resolve(self, isvc: InferenceService):
+        pred = isvc.spec.predictor
+        runtime: Optional[ServingRuntime] = None
+        if pred.runtime:
+            rt = self.store.try_get(KIND_SERVING_RUNTIME, pred.runtime, "default")
+            if rt is None:
+                raise ValueError(f"runtime {pred.runtime!r} not found")
+            assert isinstance(rt, ServingRuntime)
+            runtime = rt
+        elif pred.model_format is not None:
+            runtimes = [
+                r for r in self.store.list(KIND_SERVING_RUNTIME)
+                if isinstance(r, ServingRuntime)
+            ]
+            runtime = select_runtime(pred.model_format, runtimes)
+            if runtime is None:
+                raise ValueError(
+                    f"no ServingRuntime supports model format "
+                    f"{pred.model_format.name!r}")
+        elif pred.handler:
+            cfg = dict(pred.config)
+            if pred.storage_uri:
+                cfg.setdefault("storage_path", download(pred.storage_uri))
+                cfg.setdefault("storage_uri", pred.storage_uri)
+            return resolve_class(pred.handler), cfg
+        else:
+            raise ValueError("predictor needs runtime, model_format, or handler")
+
+        cfg = {**runtime.spec.config, **pred.config}
+        if pred.storage_uri:
+            cfg.setdefault("storage_path", download(pred.storage_uri))
+            cfg.setdefault("storage_uri", pred.storage_uri)
+        return resolve_class(runtime.spec.server_class), cfg
+
+    # -- teardown / status -------------------------------------------------
+
+    def _teardown_deployment(self, dep: _Deployment) -> None:
+        for s in dep.transformers + dep.predictors:
+            s.stop()
+        dep.transformers.clear()
+        dep.predictors.clear()
+        if dep.router:
+            dep.router.stop()
+            dep.router = None
+
+    def _set_status(self, isvc, phase=None, url=None, active_replicas=None, message=None):
+        def mut(o):
+            assert isinstance(o, InferenceService)
+            if phase is not None:
+                o.status.phase = phase
+            if url is not None:
+                o.status.url = url
+            if active_replicas is not None:
+                o.status.active_replicas = active_replicas
+            if message is not None:
+                o.status.message = message
+
+        try:
+            self.store.update_with_retry(
+                KIND_INFERENCE_SERVICE, isvc.metadata.name, isvc.metadata.namespace, mut)
+        except NotFound:
+            pass

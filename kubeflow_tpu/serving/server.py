@@ -1,0 +1,338 @@
+"""ModelServer: V1/V2 inference protocols over HTTP with micro-batching.
+
+[upstream: kserve/kserve -> python/kserve/kserve/model_server.py +
+protocol/{v1,v2} handlers].  Endpoints:
+
+V1:  POST /v1/models/<name>:predict   {"instances": [...]} -> {"predictions": [...]}
+     GET  /v1/models/<name>           readiness per model
+V2:  POST /v2/models/<name>/infer     {"inputs": [{name,shape,datatype,data}]}
+     GET  /v2/models/<name>           model metadata
+     GET  /v2/health/live | /v2/health/ready
+Also GET /metrics (request count/latency, Prometheus text format).
+
+TPU-first: a micro-batcher sits between HTTP threads and the model —
+concurrent single-instance requests coalesce (up to ``batch_max_size`` or
+``batch_timeout_ms``) into one ``predict_batch`` call so the XLA callable
+sees real batches.  The reference gets this from Triton's dynamic batcher on
+GPU; here it is native.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Optional
+
+from ..utils.net import free_port
+from .model import Model
+
+log = logging.getLogger("kubeflow_tpu.serving")
+
+
+@dataclass
+class _Pending:
+    instances: list
+    done: threading.Event = field(default_factory=threading.Event)
+    result: Optional[list] = None
+    error: Optional[Exception] = None
+
+
+class MicroBatcher:
+    """Coalesce concurrent requests into batched predict calls."""
+
+    def __init__(self, model: Model, max_size: int = 8, timeout_ms: float = 2.0):
+        self.model = model
+        self.max_size = max(1, max_size)
+        self.timeout_s = max(timeout_ms, 0.0) / 1e3
+        self._q: "queue.Queue[_Pending]" = queue.Queue()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, name=f"batcher-{model.name}", daemon=True)
+        self._thread.start()
+
+    def submit(self, instances: list) -> list:
+        if self._stop.is_set():
+            raise RuntimeError(f"model {self.model.name} is shutting down")
+        p = _Pending(instances)
+        self._q.put(p)
+        p.done.wait()
+        if p.error is not None:
+            raise p.error
+        assert p.result is not None
+        return p.result
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=2)
+        # fail any requests that raced the shutdown — their HTTP threads
+        # are blocked in submit() and would otherwise hang forever
+        while True:
+            try:
+                p = self._q.get_nowait()
+            except queue.Empty:
+                break
+            p.error = RuntimeError(f"model {self.model.name} shut down")
+            p.done.set()
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                first = self._q.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            batch = [first]
+            n = len(first.instances)
+            deadline = time.perf_counter() + self.timeout_s
+            while n < self.max_size:
+                remaining = deadline - time.perf_counter()
+                if remaining <= 0:
+                    break
+                try:
+                    nxt = self._q.get(timeout=remaining)
+                except queue.Empty:
+                    break
+                batch.append(nxt)
+                n += len(nxt.instances)
+            flat: list = []
+            for p in batch:
+                flat.extend(p.instances)
+            try:
+                out = self.model(flat)
+                if len(out) != len(flat):
+                    raise RuntimeError(
+                        f"model returned {len(out)} predictions for {len(flat)} instances")
+                i = 0
+                for p in batch:
+                    p.result = out[i : i + len(p.instances)]
+                    i += len(p.instances)
+            except Exception as e:  # noqa: BLE001 — propagate per request
+                for p in batch:
+                    p.error = e
+            finally:
+                for p in batch:
+                    p.done.set()
+
+
+class ServerMetrics:
+    def __init__(self) -> None:
+        self.lock = threading.Lock()
+        self.request_count: dict[str, int] = {}
+        self.error_count: dict[str, int] = {}
+        self.latency_sum: dict[str, float] = {}
+        self.inflight = 0
+
+    def observe(self, model: str, seconds: float, error: bool) -> None:
+        with self.lock:
+            self.request_count[model] = self.request_count.get(model, 0) + 1
+            self.latency_sum[model] = self.latency_sum.get(model, 0.0) + seconds
+            if error:
+                self.error_count[model] = self.error_count.get(model, 0) + 1
+
+    def prometheus(self) -> str:
+        lines = [
+            "# TYPE kft_request_count counter",
+            "# TYPE kft_request_latency_seconds_sum counter",
+            "# TYPE kft_requests_inflight gauge",
+        ]
+        with self.lock:
+            for m, c in self.request_count.items():
+                lines.append(f'kft_request_count{{model="{m}"}} {c}')
+            for m, s in self.latency_sum.items():
+                lines.append(f'kft_request_latency_seconds_sum{{model="{m}"}} {s:.6f}')
+            for m, c in self.error_count.items():
+                lines.append(f'kft_error_count{{model="{m}"}} {c}')
+            lines.append(f"kft_requests_inflight {self.inflight}")
+        return "\n".join(lines) + "\n"
+
+
+class ModelServer:
+    """Hosts models behind the V1/V2 HTTP protocols (one per replica)."""
+
+    def __init__(self, port: Optional[int] = None):
+        self.port = port or free_port()
+        self._models: dict[str, Model] = {}
+        self._batchers: dict[str, MicroBatcher] = {}
+        self.metrics = ServerMetrics()
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    # -- model repository (dynamic load/unload) ---------------------------
+
+    def register(
+        self, model: Model, *, batch_max_size: int = 8, batch_timeout_ms: float = 2.0
+    ) -> None:
+        model.start()
+        self._models[model.name] = model
+        self._batchers[model.name] = MicroBatcher(
+            model, batch_max_size, batch_timeout_ms)
+
+    def unregister(self, name: str) -> None:
+        b = self._batchers.pop(name, None)
+        if b:
+            b.stop()
+        m = self._models.pop(name, None)
+        if m:
+            m.stop()
+
+    def models(self) -> dict[str, Model]:
+        return dict(self._models)
+
+    # -- lifecycle --------------------------------------------------------
+
+    def start(self) -> "ModelServer":
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # quiet
+                log.debug("http: " + fmt, *args)
+
+            def _send(self, code: int, payload: Any, raw: Optional[bytes] = None,
+                      content_type: str = "application/json") -> None:
+                body = raw if raw is not None else json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self) -> None:
+                server._handle_get(self)
+
+            def do_POST(self) -> None:
+                server._handle_post(self)
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", self.port), Handler)
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name=f"model-server-{self.port}",
+            daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        for name in list(self._models):
+            self.unregister(name)
+        if self._httpd:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=2)
+
+    # -- request handling -------------------------------------------------
+
+    def _handle_get(self, h) -> None:
+        path = h.path
+        if path in ("/v2/health/live", "/healthz"):
+            h._send(200, {"live": True})
+            return
+        if path == "/v2/health/ready":
+            ready = all(m.ready for m in self._models.values())
+            h._send(200 if ready else 503, {"ready": ready})
+            return
+        if path == "/metrics":
+            h._send(200, None, raw=self.metrics.prometheus().encode(),
+                    content_type="text/plain; version=0.0.4")
+            return
+        if path.startswith("/v1/models/"):
+            name = path[len("/v1/models/"):]
+            m = self._models.get(name)
+            if m is None:
+                h._send(404, {"error": f"model {name} not found"})
+                return
+            h._send(200, {"name": name, "ready": m.ready})
+            return
+        if path.startswith("/v2/models/"):
+            name = path[len("/v2/models/"):].split("/")[0]
+            m = self._models.get(name)
+            if m is None:
+                h._send(404, {"error": f"model {name} not found"})
+                return
+            h._send(200, m.metadata())
+            return
+        h._send(404, {"error": f"unknown path {path}"})
+
+    def _handle_post(self, h) -> None:
+        try:
+            length = int(h.headers.get("Content-Length", "0"))
+            payload = json.loads(h.rfile.read(length) or b"{}")
+        except (ValueError, json.JSONDecodeError) as e:
+            h._send(400, {"error": f"bad request body: {e}"})
+            return
+        path = h.path
+        # V1: /v1/models/<name>:predict
+        if path.startswith("/v1/models/") and path.endswith(":predict"):
+            name = path[len("/v1/models/"):-len(":predict")]
+            self._predict_v1(h, name, payload)
+            return
+        # V2: /v2/models/<name>/infer
+        if path.startswith("/v2/models/") and path.endswith("/infer"):
+            name = path[len("/v2/models/"):-len("/infer")]
+            self._predict_v2(h, name, payload)
+            return
+        h._send(404, {"error": f"unknown path {path}"})
+
+    def _dispatch(self, name: str, instances: list) -> list:
+        batcher = self._batchers.get(name)
+        if batcher is None:
+            raise KeyError(name)
+        with self.metrics.lock:
+            self.metrics.inflight += 1
+        try:
+            return batcher.submit(instances)
+        finally:
+            with self.metrics.lock:
+                self.metrics.inflight -= 1
+
+    def _predict_v1(self, h, name: str, payload: dict) -> None:
+        t0 = time.perf_counter()
+        try:
+            instances = payload["instances"]
+            out = self._dispatch(name, instances)
+            self.metrics.observe(name, time.perf_counter() - t0, error=False)
+            h._send(200, {"predictions": out})
+        except KeyError as e:
+            self.metrics.observe(name, time.perf_counter() - t0, error=True)
+            h._send(404 if str(e).strip("'") == name else 400, {"error": str(e)})
+        except Exception as e:  # noqa: BLE001
+            self.metrics.observe(name, time.perf_counter() - t0, error=True)
+            h._send(500, {"error": f"{type(e).__name__}: {e}"})
+
+    def _predict_v2(self, h, name: str, payload: dict) -> None:
+        t0 = time.perf_counter()
+        try:
+            inputs = payload["inputs"]
+            # V2 tensors -> row-major instances of the first input
+            first = inputs[0]
+            data, shape = first["data"], first.get("shape", [len(first["data"])])
+            batch = shape[0] if shape else len(data)
+            per = max(1, len(data) // max(batch, 1))
+            instances = [
+                data[i * per : (i + 1) * per] if per > 1 else data[i]
+                for i in range(batch)
+            ]
+            out = self._dispatch(name, instances)
+            self.metrics.observe(name, time.perf_counter() - t0, error=False)
+            h._send(200, {
+                "model_name": name,
+                "outputs": [{
+                    "name": "output0",
+                    "shape": [len(out)],
+                    "datatype": "FP32",
+                    "data": out,
+                }],
+            })
+        except KeyError as e:
+            self.metrics.observe(name, time.perf_counter() - t0, error=True)
+            h._send(404 if str(e).strip("'") == name else 400, {"error": str(e)})
+        except Exception as e:  # noqa: BLE001
+            self.metrics.observe(name, time.perf_counter() - t0, error=True)
+            h._send(500, {"error": f"{type(e).__name__}: {e}"})

@@ -1,0 +1,59 @@
+"""Storage initializer: resolve a storage URI to a local model directory.
+
+[upstream: kserve/kserve -> pkg/agent/storage + python/kserve/kserve/storage]
+— the init container that downloads ``gs://``/``s3://``/``pvc://`` into
+``/mnt/models`` before the server starts.  Here a library call with the same
+contract: ``download(uri) -> local path``.
+
+Schemes:
+  file:///abs/path   local directory/file (the PVC analog)
+  mem://<key>        in-process registry (tests, zero-copy handoff)
+  gs:// s3:// hf://  recognized but gated: this environment has zero egress,
+                     so they raise with a clear message instead of hanging.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+_MEM_REGISTRY: dict[str, Any] = {}
+
+
+class StorageError(RuntimeError):
+    pass
+
+
+def register_mem(key: str, value: Any) -> str:
+    """Publish an object under ``mem://<key>`` (test/bench convenience)."""
+    _MEM_REGISTRY[key] = value
+    return f"mem://{key}"
+
+
+def fetch_mem(key: str) -> Any:
+    try:
+        return _MEM_REGISTRY[key]
+    except KeyError:
+        raise StorageError(f"mem://{key} not registered") from None
+
+
+def download(uri: str) -> str:
+    """Resolve ``uri`` to a local filesystem path (V1 storage contract)."""
+    if uri.startswith("file://"):
+        path = uri[len("file://"):]
+        if not os.path.exists(path):
+            raise StorageError(f"{uri}: no such path")
+        return path
+    if uri.startswith("mem://"):
+        # mem objects have no path; callers use fetch_mem directly
+        key = uri[len("mem://"):]
+        if key not in _MEM_REGISTRY:
+            raise StorageError(f"{uri} not registered")
+        return uri
+    for scheme in ("gs://", "s3://", "hf://", "http://", "https://"):
+        if uri.startswith(scheme):
+            raise StorageError(
+                f"{uri}: remote storage requires network egress, which this "
+                "deployment does not have; stage the model locally and use file://"
+            )
+    raise StorageError(f"unsupported storage uri {uri!r}")

@@ -1,0 +1,16 @@
+"""Trial entrypoint for HPO e2e: a cheap analytic objective.
+
+score = 1 - (lr - 0.03)^2 * 100, maximized at lr=0.03 — no model, so a
+trial costs only process startup.  Emitted via the same metric channel real
+trainers use (bootstrap.emit_metric -> status jsonl + stdout name=value).
+"""
+
+import os
+
+from kubeflow_tpu.runtime import bootstrap
+
+
+def objective_main(ctx) -> None:
+    lr = float(os.environ.get("KFT_LR", "0.1"))
+    score = 1.0 - (lr - 0.03) ** 2 * 100.0
+    bootstrap.emit_metric(ctx, "score", score)

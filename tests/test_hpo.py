@@ -1,0 +1,345 @@
+"""HPO plane: algorithms, the gRPC service boundary, and the controller loop.
+
+Mirrors the reference test pyramid (SURVEY.md §4): pure unit tests for the
+suggestion algorithms, a real-socket service test, an envtest-style
+controller run on the fake kubelet, and a full e2e with real trial
+processes in test_e2e_local-style fashion.
+"""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from kubeflow_tpu.api.experiment import (
+    AlgorithmSpec,
+    Experiment,
+    ExperimentSpec,
+    FeasibleSpace,
+    ObjectiveSpec,
+    ObjectiveType,
+    ParameterSpec,
+    ParameterType,
+    TrialTemplate,
+)
+from kubeflow_tpu.api.common import ObjectMeta
+from kubeflow_tpu.hpo import algorithms as alg
+from kubeflow_tpu.hpo.service import SuggestionClient, SuggestionServer
+
+DOUBLE_LR = ParameterSpec(
+    name="lr",
+    parameter_type=ParameterType.DOUBLE,
+    feasible_space=FeasibleSpace(min=0.001, max=0.1, log_scale=True),
+)
+INT_LAYERS = ParameterSpec(
+    name="layers",
+    parameter_type=ParameterType.INT,
+    feasible_space=FeasibleSpace(min=1, max=4),
+)
+CAT_OPT = ParameterSpec(
+    name="opt",
+    parameter_type=ParameterType.CATEGORICAL,
+    feasible_space=FeasibleSpace(**{"list": ["sgd", "adam"]}),
+)
+
+
+def _req(history=None, count=1, obj=ObjectiveType.MINIMIZE, seed=0):
+    return alg.SuggestRequest(
+        parameters=[DOUBLE_LR, INT_LAYERS, CAT_OPT],
+        objective_type=obj,
+        history=history or [],
+        count=count,
+        seed=seed,
+    )
+
+
+def _quadratic(assignments):
+    # minimized at lr=0.03
+    return (assignments["lr"] - 0.03) ** 2
+
+
+class TestAlgorithms:
+    def test_random_respects_space(self):
+        out = alg.RandomSearch().suggest(_req(count=20))
+        assert len(out) == 20
+        for a in out:
+            assert 0.001 <= a["lr"] <= 0.1
+            assert 1 <= a["layers"] <= 4 and isinstance(a["layers"], int)
+            assert a["opt"] in ("sgd", "adam")
+
+    def test_grid_enumerates_exactly_once(self):
+        p = [INT_LAYERS, CAT_OPT]
+        req = alg.SuggestRequest(
+            parameters=p, objective_type=ObjectiveType.MINIMIZE, count=100)
+        out = alg.GridSearch().suggest(req)
+        assert len(out) == 8  # 4 ints x 2 cats
+        assert len({tuple(sorted(a.items())) for a in out}) == 8
+        # a second call with full history walks off the end -> empty
+        req.history = [alg.Observation(assignments=a, value=0.0) for a in out]
+        assert alg.GridSearch().suggest(req) == []
+
+    @pytest.mark.parametrize("name", ["tpe", "bayesianoptimization"])
+    def test_model_based_beats_random_closed_loop(self, name):
+        """Sequential optimize-observe loop at equal budget: the model-based
+        suggester's best observed value should beat random search's."""
+
+        def run(suggester_name: str, budget: int = 24) -> float:
+            history = []
+            s = alg.get_suggester(suggester_name)
+            for i in range(budget):
+                req = _req(history, count=1, seed=i)
+                a = s.suggest(req)[0]
+                history.append(
+                    alg.Observation(assignments=a, value=_quadratic(a)))
+            return min(ob.value for ob in history)
+
+        assert run(name) < run("random")
+
+    def test_unknown_algorithm_raises(self):
+        with pytest.raises(ValueError, match="unknown algorithm"):
+            alg.get_suggester("nope")
+
+    def test_grid_parallel_trials_get_distinct_cells(self):
+        """Caught regression: the grid cursor must follow issued assignments
+        (running trials included), not completed history."""
+        p = [INT_LAYERS]
+        req = alg.SuggestRequest(
+            parameters=p, objective_type=ObjectiveType.MINIMIZE,
+            count=2, issued=2,
+            history=[alg.Observation(assignments={"layers": 1}, value=0.0)],
+        )
+        out = alg.GridSearch().suggest(req)
+        assert [a["layers"] for a in out] == [3, 4]
+
+    def test_random_does_not_replay_after_failure(self):
+        """Caught regression: with no explicit seed, two calls at the same
+        history length must not return identical points."""
+        req1 = alg.SuggestRequest(
+            parameters=[DOUBLE_LR], objective_type=ObjectiveType.MINIMIZE, count=1)
+        req2 = alg.SuggestRequest(
+            parameters=[DOUBLE_LR], objective_type=ObjectiveType.MINIMIZE, count=1)
+        a = alg.RandomSearch().suggest(req1)[0]["lr"]
+        b = alg.RandomSearch().suggest(req2)[0]["lr"]
+        assert a != b
+
+
+class TestService:
+    def test_round_trip_over_real_socket(self):
+        server = SuggestionServer().start()
+        try:
+            client = SuggestionClient(server.address)
+            out = client.get_suggestions(
+                algorithm="random",
+                parameters=[DOUBLE_LR],
+                objective_type=ObjectiveType.MINIMIZE,
+                history=[alg.Observation(assignments={"lr": 0.01}, value=1.0)],
+                count=3,
+            )
+            assert len(out) == 3 and all(0.001 <= a["lr"] <= 0.1 for a in out)
+            client.close()
+        finally:
+            server.stop()
+
+    def test_bad_algorithm_is_rpc_error(self):
+        import grpc
+
+        server = SuggestionServer().start()
+        try:
+            client = SuggestionClient(server.address)
+            with pytest.raises(grpc.RpcError):
+                client.get_suggestions(
+                    algorithm="nope",
+                    parameters=[],
+                    objective_type=ObjectiveType.MINIMIZE,
+                    history=[],
+                    count=1,
+                )
+            client.close()
+        finally:
+            server.stop()
+
+
+def _experiment(name, max_trials=6, parallel=2, algorithm="random", goal=None):
+    return Experiment(
+        metadata=ObjectMeta(name=name),
+        spec=ExperimentSpec(
+            objective=ObjectiveSpec(
+                type=ObjectiveType.MAXIMIZE,
+                objective_metric_name="score",
+                goal=goal,
+            ),
+            algorithm=AlgorithmSpec(algorithm_name=algorithm),
+            parameters=[DOUBLE_LR],
+            parallel_trial_count=parallel,
+            max_trial_count=max_trials,
+            trial_template=TrialTemplate(
+                job_manifest={
+                    "kind": "JaxJob",
+                    "metadata": {"name": "placeholder"},
+                    "spec": {
+                        "replica_specs": {
+                            "worker": {
+                                "replicas": 1,
+                                "template": {
+                                    "entrypoint": "tests.hpo_objective:objective_main",
+                                    "env": {"KFT_LR": "${trialParameters.lr}"},
+                                },
+                            }
+                        }
+                    },
+                }
+            ),
+        ),
+    )
+
+
+class TestControllersEnvtestStyle:
+    """Cluster + FakeKubelet: no real processes; metrics written by a stub
+    collector thread, the envtest analog (SURVEY.md §4)."""
+
+    def test_experiment_completes_and_finds_optimum(self, tmp_path):
+        from kubeflow_tpu.controlplane.cluster import Cluster
+        from kubeflow_tpu.controlplane.fake_kubelet import FakeKubelet
+        from kubeflow_tpu.controlplane.objects import KIND_POD, Pod
+
+        cluster = Cluster()
+        cluster.add_tpu_slice("slice-0", 2, 4)
+        cluster.enable_hpo(metrics_root=str(tmp_path))
+        kubelet = FakeKubelet(cluster.store)
+        stop = threading.Event()
+
+        def metric_writer():
+            # stands in for the trial process: score from the pod's env
+            while not stop.is_set():
+                for pod in cluster.store.list(KIND_POD):
+                    assert isinstance(pod, Pod)
+                    lr = pod.spec.container.env.get("KFT_LR")
+                    if lr is None:
+                        continue
+                    d = tmp_path / "status" / pod.metadata.namespace / pod.metadata.name
+                    d.mkdir(parents=True, exist_ok=True)
+                    score = 1.0 - (float(lr) - 0.03) ** 2 * 100.0
+                    (d / "metrics.jsonl").write_text(
+                        json.dumps({"name": "score", "value": score}) + "\n")
+                stop.wait(0.01)
+
+        writer = threading.Thread(target=metric_writer, daemon=True)
+        with cluster:
+            kubelet.start()
+            writer.start()
+            try:
+                cluster.store.create(_experiment("sweep", max_trials=6))
+                deadline = time.time() + 30
+                exp = None
+                while time.time() < deadline:
+                    exp = cluster.store.try_get("Experiment", "sweep")
+                    if exp is not None and exp.status.completed:
+                        break
+                    time.sleep(0.05)
+                assert exp is not None and exp.status.completed, (
+                    exp.status if exp else None)
+                assert exp.status.trials_succeeded == 6
+                assert exp.status.current_optimal_value is not None
+                assert exp.status.current_optimal_value <= 1.0
+                assert exp.status.current_optimal_assignments[0].name == "lr"
+            finally:
+                stop.set()
+                kubelet.stop()
+
+    def test_metricless_trial_fails_not_succeeds(self, tmp_path):
+        """Caught regression: a job that never emits the objective metric
+        must produce a Failed trial (MetricsUnavailable), not a silent
+        Succeeded-with-None."""
+        from kubeflow_tpu.controlplane.cluster import Cluster
+        from kubeflow_tpu.controlplane.fake_kubelet import FakeKubelet
+
+        cluster = Cluster()
+        cluster.add_tpu_slice("slice-0", 2, 4)
+        cluster.enable_hpo(metrics_root=str(tmp_path))  # no metric writer
+        kubelet = FakeKubelet(cluster.store)
+        with cluster:
+            kubelet.start()
+            try:
+                cluster.store.create(
+                    _experiment("nometrics", max_trials=1, parallel=1))
+                deadline = time.time() + 30
+                exp = None
+                while time.time() < deadline:
+                    exp = cluster.store.try_get("Experiment", "nometrics")
+                    if exp is not None and exp.status.completed:
+                        break
+                    time.sleep(0.05)
+                assert exp is not None and exp.status.completed
+                assert exp.status.trials_failed == 1
+                assert exp.status.trials_succeeded == 0
+                trial = cluster.store.try_get("Trial", "nometrics-t0000")
+                assert trial.status.phase == "Failed"
+            finally:
+                kubelet.stop()
+
+    def test_goal_stops_early(self, tmp_path):
+        from kubeflow_tpu.controlplane.cluster import Cluster
+        from kubeflow_tpu.controlplane.fake_kubelet import FakeKubelet
+        from kubeflow_tpu.controlplane.objects import KIND_POD, Pod
+
+        cluster = Cluster()
+        cluster.add_tpu_slice("slice-0", 2, 4)
+        cluster.enable_hpo(metrics_root=str(tmp_path))
+        kubelet = FakeKubelet(cluster.store)
+        stop = threading.Event()
+
+        def metric_writer():
+            while not stop.is_set():
+                for pod in cluster.store.list(KIND_POD):
+                    assert isinstance(pod, Pod)
+                    if "KFT_LR" not in pod.spec.container.env:
+                        continue
+                    d = tmp_path / "status" / pod.metadata.namespace / pod.metadata.name
+                    d.mkdir(parents=True, exist_ok=True)
+                    (d / "metrics.jsonl").write_text(
+                        json.dumps({"name": "score", "value": 0.99}) + "\n")
+                stop.wait(0.01)
+
+        writer = threading.Thread(target=metric_writer, daemon=True)
+        with cluster:
+            kubelet.start()
+            writer.start()
+            try:
+                # any trial hits goal=0.5 -> completes well before 50 trials
+                cluster.store.create(
+                    _experiment("quick", max_trials=50, parallel=1, goal=0.5))
+                deadline = time.time() + 30
+                exp = None
+                while time.time() < deadline:
+                    exp = cluster.store.try_get("Experiment", "quick")
+                    if exp is not None and exp.status.completed:
+                        break
+                    time.sleep(0.05)
+                assert exp is not None and exp.status.completed
+                assert exp.status.trials_created < 50
+            finally:
+                stop.set()
+                kubelet.stop()
+
+
+@pytest.mark.e2e
+def test_hpo_e2e_real_processes():
+    """Full composition with real trial processes (SURVEY.md §3.4): the
+    sweep's outer loop drives JaxJobs whose pods actually run."""
+    from kubeflow_tpu.runtime.platform import LocalPlatform
+
+    with LocalPlatform() as p:
+        p.store.create(_experiment("e2e-sweep", max_trials=4, parallel=2))
+        deadline = time.time() + 120
+        exp = None
+        while time.time() < deadline:
+            exp = p.store.try_get("Experiment", "e2e-sweep")
+            if exp is not None and exp.status.completed:
+                break
+            time.sleep(0.2)
+        assert exp is not None and exp.status.completed, exp.status if exp else None
+        assert exp.status.trials_succeeded == 4
+        assert exp.status.current_optimal_value is not None
