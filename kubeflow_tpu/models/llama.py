@@ -53,8 +53,11 @@ class LlamaConfig:
     param_dtype: Dtype = jnp.float32
     remat: bool = True
     scan_layers: bool = True
-    #: "dense" = full causal attention (XLA-fused); "ring" = blockwise ring
-    #: attention over the mesh's ``seq`` axis for long contexts (SURVEY §5).
+    #: "dense" = full causal attention (XLA-fused; fastest <= ~2k seq);
+    #: "flash" = our Pallas flash kernel (wins at long seq: measured 1.4x
+    #: over dense and 1.8x over jax's reference flash kernel at seq 4096
+    #: on v5e); "ring" = blockwise ring attention over the mesh's ``seq``
+    #: axis for sequence parallelism (SURVEY §5).
     attention_impl: str = "dense"
     tie_embeddings: bool = False
 
@@ -65,7 +68,7 @@ class LlamaConfig:
     def __post_init__(self):
         if self.num_heads % self.num_kv_heads:
             raise ValueError("num_heads must be a multiple of num_kv_heads")
-        if self.attention_impl not in ("dense", "ring"):
+        if self.attention_impl not in ("dense", "flash", "ring"):
             raise ValueError(f"unknown attention_impl {self.attention_impl!r}")
 
 
@@ -215,6 +218,10 @@ class Attention(nn.Module):
             out = ringlib.ring_attention(
                 q, k, v, axis_name="seq", q_per_kv=cfg.q_per_kv
             )
+        elif cfg.attention_impl == "flash":
+            from ..ops.flash_attention import flash_attention
+
+            out = flash_attention(q, k, v, q_per_kv=cfg.q_per_kv)
         else:
             out = _causal_attention(q, k, v, cfg.q_per_kv)
         out = nn.with_logical_constraint(out, ("batch", "act_seq", "act_heads", "head_dim"))

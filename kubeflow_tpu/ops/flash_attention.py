@@ -1,0 +1,345 @@
+"""Causal GQA flash attention: Pallas TPU kernels (fwd + bwd).
+
+The hot op of the Llama family, owned by the framework (SURVEY.md §7
+"Pallas kernel" hard part).  Standard FlashAttention-2 scheme laid out for
+the TPU memory hierarchy:
+
+- grid iterates (batch*head, q_block, k_block) with the K dimension
+  innermost; online-softmax state (m, l, acc) lives in VMEM scratch and
+  persists across the sequential TPU grid — no [s, s] matrix ever exists
+  in HBM;
+- blocks are MXU-shaped ([block, 128] lanes, f32 accumulation via
+  ``preferred_element_type``), bf16 inputs stream straight from HBM;
+- causal structure is exploited at block granularity (fully-masked blocks
+  are skipped with ``pl.when``, the diagonal block gets the triangular
+  mask);
+- backward recomputes P from the saved logsumexp (no attention matrix
+  residual) in two passes: one accumulating dK/dV per KV block, one
+  accumulating dQ per Q block — wrapped as ``jax.custom_vjp``.
+
+GQA is handled by index-mapping each query head onto its shared KV head —
+KV blocks are never materialized per-query-head.
+
+On non-TPU backends the kernels run in Pallas interpret mode, so the same
+code path is testable on the CPU mesh (SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _block(seq_len: int, want: int) -> int:
+    b = min(want, seq_len)
+    while seq_len % b:
+        b //= 2
+    return max(b, 1)
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+                *, scale: float, block_q: int, block_k: int):
+    qi, ki = pl.program_id(1), pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # causal block skip: compute only if some k position <= some q position
+    @pl.when(ki * block_k <= qi * block_q + block_q - 1)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32) * scale          # [bq, d]
+        k = k_ref[0].astype(jnp.float32)                  # [bk, d]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)           # [bq, bk]
+        # global causal mask; only bites on diagonal-straddling blocks
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        k_pos = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+
+        m_prev, l_prev = m_scr[:], l_scr[:]
+        m_new = jnp.maximum(m_prev, s.max(axis=1))
+        corr = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new[:, None])                   # [bq, bk]
+        l_scr[:] = l_prev * corr + p.sum(axis=1)
+        v = v_ref[0].astype(jnp.float32)                  # [bk, d]
+        pv = jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        acc_scr[:] = acc_scr[:] * corr[:, None] + pv
+        m_scr[:] = m_new
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        l = jnp.maximum(l_scr[:], 1e-30)
+        o_ref[0] = (acc_scr[:] / l[:, None]).astype(o_ref.dtype)
+        lse = m_scr[:] + jnp.log(l)
+        # lse rides a [*, 8] layout: TPU block specs need the trailing
+        # two dims tile-compatible, so scalars-per-row get 8 lanes
+        lse_ref[0] = jnp.broadcast_to(lse[:, None], lse_ref.shape[1:])
+
+
+def _fwd(q3, k3, v3, *, h: int, kv: int, scale: float,
+         block_q: int, block_k: int):
+    """q3: [b*h, s, d]; k3/v3: [b*kv, s, d] -> (o [b*h, s, d], lse [b*h, s])."""
+    bh, s, d = q3.shape
+    g = h // kv
+    nq, nk = s // block_q, s // block_k
+
+    def kv_index(bhi, qi, ki):
+        return ((bhi // h) * kv + (bhi % h) // g, ki, 0)
+
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, block_q=block_q, block_k=block_k)
+    return pl.pallas_call(
+        kernel,
+        grid=(bh, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bhi, qi, ki: (bhi, qi, 0)),
+            pl.BlockSpec((1, block_k, d), kv_index),
+            pl.BlockSpec((1, block_k, d), kv_index),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bhi, qi, ki: (bhi, qi, 0)),
+            pl.BlockSpec((1, block_q, 8), lambda bhi, qi, ki: (bhi, qi, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(q3.shape, q3.dtype),
+            jax.ShapeDtypeStruct((bh, s, 8), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q,), jnp.float32),
+            pltpu.VMEM((block_q,), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(q3, k3, v3)
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_scr, dv_scr,
+                    *, scale: float, block_q: int, block_k: int):
+    ki, qi = pl.program_id(1), pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    @pl.when(qi * block_q + block_q - 1 >= ki * block_k)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32) * scale         # [bq, d]
+        k = k_ref[0].astype(jnp.float32)                 # [bk, d]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)          # [bq, bk]
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        k_pos = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse_ref[0, :, 0][:, None])             # [bq, bk]
+        do = do_ref[0].astype(jnp.float32)               # [bq, d]
+        dv_scr[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)          # [bk, d]
+        v = v_ref[0].astype(jnp.float32)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)          # [bq, bk]
+        ds = p * (dp - delta_ref[0, :, 0][:, None]) * scale  # [bq, bk]
+        dk_scr[:] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) / scale  # q was pre-scaled
+
+    @pl.when(qi == nq - 1)
+    def _finalize():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, dq_scr,
+                   *, scale: float, block_q: int, block_k: int):
+    qi, ki = pl.program_id(1), pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    @pl.when(ki * block_k <= qi * block_q + block_q - 1)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32) * scale
+        k = k_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        k_pos = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse_ref[0, :, 0][:, None])
+        do = do_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0, :, 0][:, None]) * scale
+        dq_scr[:] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _bwd(h, kv, scale, block_q, block_k, residuals, do4):
+    q3, k3, v3, o3, lse = residuals
+    bh, s, d = q3.shape
+    bkv = k3.shape[0]
+    g = h // kv
+    do3 = do4
+    delta2 = jnp.sum(
+        do3.astype(jnp.float32) * o3.astype(jnp.float32), axis=-1)  # [bh, s]
+    delta = jnp.broadcast_to(delta2[:, :, None], (*delta2.shape, 8))
+
+    def kv_index_k_outer(bhi, ki, qi):
+        return ((bhi // h) * kv + (bhi % h) // g, ki, 0)
+
+    nq, nk = s // block_q, s // block_k
+    # dK/dV: one pass per query head; shared KV heads summed afterwards
+    dkv_kernel = functools.partial(
+        _bwd_dkv_kernel, scale=scale, block_q=block_q, block_k=block_k)
+    dk_per_h, dv_per_h = pl.pallas_call(
+        dkv_kernel,
+        grid=(bh, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bhi, ki, qi: (bhi, qi, 0)),
+            pl.BlockSpec((1, block_k, d), kv_index_k_outer),
+            pl.BlockSpec((1, block_k, d), kv_index_k_outer),
+            pl.BlockSpec((1, block_q, d), lambda bhi, ki, qi: (bhi, qi, 0)),
+            pl.BlockSpec((1, block_q, 8), lambda bhi, ki, qi: (bhi, qi, 0)),
+            pl.BlockSpec((1, block_q, 8), lambda bhi, ki, qi: (bhi, qi, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda bhi, ki, qi: (bhi, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bhi, ki, qi: (bhi, ki, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s, d), k3.dtype),
+            jax.ShapeDtypeStruct((bh, s, d), v3.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(q3, k3, v3, do3, lse, delta)
+    # sum query-head contributions into the shared KV heads
+    b = bh // h
+    dk3 = dk_per_h.reshape(b, kv, g, s, d).sum(axis=2).reshape(bkv, s, d)
+    dv3 = dv_per_h.reshape(b, kv, g, s, d).sum(axis=2).reshape(bkv, s, d)
+
+    def kv_index_q_outer(bhi, qi, ki):
+        return ((bhi // h) * kv + (bhi % h) // g, ki, 0)
+
+    dq_kernel = functools.partial(
+        _bwd_dq_kernel, scale=scale, block_q=block_q, block_k=block_k)
+    dq3 = pl.pallas_call(
+        dq_kernel,
+        grid=(bh, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bhi, qi, ki: (bhi, qi, 0)),
+            pl.BlockSpec((1, block_k, d), kv_index_q_outer),
+            pl.BlockSpec((1, block_k, d), kv_index_q_outer),
+            pl.BlockSpec((1, block_q, d), lambda bhi, qi, ki: (bhi, qi, 0)),
+            pl.BlockSpec((1, block_q, 8), lambda bhi, qi, ki: (bhi, qi, 0)),
+            pl.BlockSpec((1, block_q, 8), lambda bhi, qi, ki: (bhi, qi, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda bhi, qi, ki: (bhi, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, s, d), q3.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=_interpret(),
+    )(q3, k3, v3, do3, lse, delta)
+    return dq3, dk3, dv3
+
+
+# ---------------------------------------------------------------------------
+# public api
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash(q3, k3, v3, heads, block):
+    h, kv = heads
+    scale = 1.0 / math.sqrt(q3.shape[-1])
+    o, _ = _fwd(q3, k3, v3, h=h, kv=kv, scale=scale,
+                block_q=block[0], block_k=block[1])
+    return o
+
+
+def _flash_fwd(q3, k3, v3, heads, block):
+    h, kv = heads
+    scale = 1.0 / math.sqrt(q3.shape[-1])
+    o, lse = _fwd(q3, k3, v3, h=h, kv=kv, scale=scale,
+                  block_q=block[0], block_k=block[1])
+    return o, (q3, k3, v3, o, lse)
+
+
+def _flash_bwd(heads, block, residuals, g):
+    h, kv = heads
+    scale = 1.0 / math.sqrt(residuals[0].shape[-1])
+    return _bwd(h, kv, scale, block[0], block[1], residuals, g)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(
+    q: jax.Array, k: jax.Array, v: jax.Array,
+    *, q_per_kv: int = 1, block_q: int = 512, block_k: int = 512,
+) -> jax.Array:
+    """Causal GQA flash attention; drop-in for the dense reference.
+
+    q: [b, s, h, d]; k, v: [b, s, kv, d] with h = kv * q_per_kv.
+    """
+    b, s, h, d = q.shape
+    kv = k.shape[2]
+    bq = _block(s, block_q)
+    bk = _block(s, block_k)
+    q3 = q.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+    k3 = k.transpose(0, 2, 1, 3).reshape(b * kv, s, d)
+    v3 = v.transpose(0, 2, 1, 3).reshape(b * kv, s, d)
+    o3 = _flash(q3, k3, v3, (h, kv), (bq, bk))
+    return o3.reshape(b, h, s, d).transpose(0, 2, 1, 3)
