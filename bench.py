@@ -25,6 +25,7 @@ from kubeflow_tpu.train import trainer as trainlib
 
 WARMUP_STEPS = 3
 MEASURED_STEPS = 10
+WINDOWS = 3
 TARGET_MFU = 0.40
 CPU_REFERENCE_TPS = 2000.0  # fixed constant for CPU-only comparability
 
@@ -44,7 +45,7 @@ def main() -> None:
         mesh_axes={"data": len(devices)} if len(devices) > 1 else {},
         global_batch=batch,
         seq_len=seq,
-        steps=WARMUP_STEPS + MEASURED_STEPS,
+        steps=WARMUP_STEPS + WINDOWS * MEASURED_STEPS,
         warmup_steps=2,
         log_every=10_000,  # quiet
     )
@@ -56,24 +57,36 @@ def main() -> None:
 
     from kubeflow_tpu.parallel import sharding as shardlib
 
-    times = []
-    with shardlib.shard_context(t.mesh):
-        for step in range(WARMUP_STEPS + MEASURED_STEPS):
-            batch_arrays = {
-                k: jax.device_put(v, t.batch_sharding)
-                for k, v in source.local_batch(step).items()
-            }
-            t0 = time.perf_counter()
-            state, out = step_fn(state, batch_arrays)
-            # device_get, not block_until_ready: some PJRT backends (axon
-            # tunnel) report ready before remote execution completes
-            float(jax.device_get(out["loss"]))
-            dt = time.perf_counter() - t0
-            if step >= WARMUP_STEPS:
-                times.append(dt)
+    def put(step: int):
+        return {
+            k: jax.device_put(v, t.batch_sharding)
+            for k, v in source.local_batch(step).items()
+        }
 
-    times.sort()
-    median = times[len(times) // 2]
+    # Steady-state protocol: steps are enqueued asynchronously and the host
+    # blocks once per measured window (matching Trainer.train's metering).
+    # Synchronizing on the loss every step would serialize a full host
+    # round-trip into each step — on a remote-dispatch PJRT backend that is
+    # ~100ms/step of pure dispatch latency, not training throughput.
+    window_times = []
+    step = 0
+    with shardlib.shard_context(t.mesh):
+        for _ in range(WARMUP_STEPS):
+            state, out = step_fn(state, put(step))
+            step += 1
+        # device_get, not block_until_ready: some PJRT backends (axon
+        # tunnel) report ready before remote execution completes
+        float(jax.device_get(out["loss"]))
+        for _ in range(WINDOWS):
+            t0 = time.perf_counter()
+            for _ in range(MEASURED_STEPS):
+                state, out = step_fn(state, put(step))
+                step += 1
+            float(jax.device_get(out["loss"]))
+            window_times.append((time.perf_counter() - t0) / MEASURED_STEPS)
+
+    window_times.sort()
+    median = window_times[len(window_times) // 2]
     n_chips = len(devices)
     tps_chip = batch * seq / median / n_chips
 

@@ -48,6 +48,22 @@ def _block(seq_len: int, want: int) -> int:
     return max(b, 1)
 
 
+def _pad_to_tileable(s: int, want: int) -> int:
+    """Length >= s whose block divisor is MXU-tileable (mult of 8, >= 128).
+
+    Odd sequence lengths (e.g. next-token training slices seq to L-1) would
+    otherwise collapse the block size to 1, which Pallas cannot lay out.
+    Padding the sequence is sound for causal attention: padded keys sit at
+    positions greater than every real query, so the causal mask hides them;
+    padded query rows are sliced off on return.
+    """
+    b = _block(s, want)
+    if b >= 128 and b % 8 == 0:
+        return s
+    unit = min(want, 128)
+    return ((s + unit - 1) // unit) * unit
+
+
 # ---------------------------------------------------------------------------
 # forward
 # ---------------------------------------------------------------------------
@@ -336,10 +352,15 @@ def flash_attention(
     """
     b, s, h, d = q.shape
     kv = k.shape[2]
-    bq = _block(s, block_q)
-    bk = _block(s, block_k)
-    q3 = q.transpose(0, 2, 1, 3).reshape(b * h, s, d)
-    k3 = k.transpose(0, 2, 1, 3).reshape(b * kv, s, d)
-    v3 = v.transpose(0, 2, 1, 3).reshape(b * kv, s, d)
+    sp = _pad_to_tileable(s, max(block_q, block_k))
+    if sp != s:
+        pad = [(0, 0), (0, sp - s), (0, 0), (0, 0)]
+        q, k, v = jnp.pad(q, pad), jnp.pad(k, pad), jnp.pad(v, pad)
+    bq = _block(sp, block_q)
+    bk = _block(sp, block_k)
+    q3 = q.transpose(0, 2, 1, 3).reshape(b * h, sp, d)
+    k3 = k.transpose(0, 2, 1, 3).reshape(b * kv, sp, d)
+    v3 = v.transpose(0, 2, 1, 3).reshape(b * kv, sp, d)
     o3 = _flash(q3, k3, v3, (h, kv), (bq, bk))
-    return o3.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+    out = o3.reshape(b, h, sp, d).transpose(0, 2, 1, 3)
+    return out[:, :s] if sp != s else out
