@@ -329,6 +329,60 @@ class _ScanBlock(nn.Module):
         return Block(self.cfg, self.decode, name="block")(x, positions), None
 
 
+class Embedder(nn.Module):
+    """Token embedding lookup — standalone so the pipeline executor can run
+    it outside the staged block stack (parallel/pipeline.py).  setup-style
+    so both ``__call__`` and ``table`` (tie_embeddings) can touch the param.
+    """
+
+    cfg: LlamaConfig
+
+    def setup(self):
+        self.embedding = self.param(
+            "embedding",
+            nn.with_logical_partitioning(
+                nn.initializers.normal(stddev=0.02), ("vocab", "embed")),
+            (self.cfg.vocab_size, self.cfg.hidden_size), self.cfg.param_dtype,
+        )
+
+    def __call__(self, tokens: jax.Array) -> jax.Array:
+        x = self.embedding.astype(self.cfg.dtype)[tokens]
+        return nn.with_logical_constraint(x, ("batch", "act_seq", "act_embed"))
+
+    def table(self) -> jax.Array:
+        """The raw embedding table (for tie_embeddings heads)."""
+        return self.embedding
+
+
+class Head(nn.Module):
+    """Final norm + unembedding.  ``embed_table`` feeds tie_embeddings."""
+
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(
+        self, x: jax.Array, embed_table: Optional[jax.Array] = None
+    ) -> jax.Array:
+        cfg = self.cfg
+        x = RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="final_norm")(x)
+        # The unembedding matmul runs in the activation dtype (bf16 on TPU:
+        # full MXU rate, half the HBM of f32 logits); the loss fn upcasts
+        # logits to f32 for the softmax/cross-entropy reduction.
+        if cfg.tie_embeddings:
+            if embed_table is None:
+                raise ValueError("tie_embeddings Head needs the embed table")
+            logits = jnp.einsum("bse,ve->bsv", x, embed_table.astype(cfg.dtype))
+        else:
+            unembed = self.param(
+                "unembedding",
+                nn.with_logical_partitioning(
+                    nn.initializers.normal(stddev=0.02), ("embed", "vocab")),
+                (cfg.hidden_size, cfg.vocab_size), cfg.param_dtype,
+            )
+            logits = jnp.einsum("bse,ev->bsv", x, unembed.astype(cfg.dtype))
+        return nn.with_logical_constraint(logits, ("batch", "act_seq", "act_vocab"))
+
+
 class Llama(nn.Module):
     cfg: LlamaConfig
 
@@ -343,14 +397,8 @@ class Llama(nn.Module):
         cfg = self.cfg
         if positions is None:
             positions = jnp.arange(tokens.shape[-1])[None, :]
-        embed = self.param(
-            "embedding",
-            nn.with_logical_partitioning(
-                nn.initializers.normal(stddev=0.02), ("vocab", "embed")),
-            (cfg.vocab_size, cfg.hidden_size), cfg.param_dtype,
-        )
-        x = embed.astype(cfg.dtype)[tokens]
-        x = nn.with_logical_constraint(x, ("batch", "act_seq", "act_embed"))
+        embedder = Embedder(cfg, name="embedder")
+        x = embedder(tokens)
 
         block_cls = Block
         if cfg.remat:
@@ -379,18 +427,44 @@ class Llama(nn.Module):
             for i in range(cfg.num_layers):
                 x = block_cls(cfg, decode, name=f"layer_{i}")(x, positions)
 
-        x = RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="final_norm")(x)
-        if cfg.tie_embeddings:
-            logits = jnp.einsum("bse,ve->bsv", x.astype(jnp.float32), embed.astype(jnp.float32))
-        else:
-            unembed = self.param(
-                "unembedding",
-                nn.with_logical_partitioning(
-                    nn.initializers.normal(stddev=0.02), ("embed", "vocab")),
-                (cfg.hidden_size, cfg.vocab_size), cfg.param_dtype,
-            )
-            logits = jnp.einsum("bse,ev->bsv", x.astype(jnp.float32), unembed.astype(jnp.float32))
-        return nn.with_logical_constraint(logits, ("batch", "act_seq", "act_vocab"))
+        table = embedder.table() if cfg.tie_embeddings else None
+        return Head(cfg, name="head")(x, table)
+
+
+def pipelined_apply(
+    cfg: LlamaConfig,
+    params: Any,
+    tokens: jax.Array,
+    *,
+    mesh=None,
+    num_microbatches: Optional[int] = None,
+) -> jax.Array:
+    """Forward pass with the block stack run as a GPipe microbatch pipeline.
+
+    Embedding and head run data-parallel on every device (they are cheap
+    and replicated over the ``pipeline`` axis); the scanned layer stack —
+    whose params are already stage-sharded by the ``("layers", "pipeline")``
+    rule — executes through ``parallel.pipeline.gpipe``.  Numerically
+    identical to ``Llama.__call__`` (same blocks, same order), so loss
+    trajectories match the single-mesh run.
+    """
+    from ..parallel import pipeline as pipelib
+
+    if not cfg.scan_layers:
+        raise ValueError("pipelined_apply requires scan_layers=True "
+                         "(stage-stacked params)")
+    positions = jnp.arange(tokens.shape[-1])[None, :]
+    x = Embedder(cfg).apply({"params": params["embedder"]}, tokens)
+
+    def block_apply(layer_params, h):
+        return Block(cfg).apply({"params": layer_params}, h, positions)
+
+    x = pipelib.gpipe(
+        block_apply, params["layers"]["block"], x,
+        mesh=mesh, num_microbatches=num_microbatches, remat=cfg.remat,
+    )
+    table = params["embedder"]["embedding"] if cfg.tie_embeddings else None
+    return Head(cfg).apply({"params": params["head"]}, x, table)
 
 
 def num_params(cfg: LlamaConfig) -> int:
@@ -404,7 +478,12 @@ def num_params(cfg: LlamaConfig) -> int:
 
 
 def flops_per_token(cfg: LlamaConfig, seq_len: int) -> float:
-    """Approx train FLOPs/token: 6*N + attention quadratic term."""
+    """Approx train FLOPs/token: 6*N + causal attention quadratic term.
+
+    The quadratic term counts only the lower triangle actually computed by
+    causal attention (QK^T + PV, fwd+bwd = 12*L*h*d*s/2 = 6*L*h*d*s) —
+    counting the full square would overstate MFU ~2x at long seq.
+    """
     n = num_params(cfg)
-    attn_flops = 12 * cfg.num_layers * cfg.num_heads * cfg.head_dim * seq_len
+    attn_flops = 6 * cfg.num_layers * cfg.num_heads * cfg.head_dim * seq_len
     return 6.0 * n + attn_flops

@@ -144,9 +144,20 @@ def build_mesh(
             plan.ici_axes.get(a, 1) for a in plan.axis_names
         )
         dcn = tuple(plan.dcn_axes.get(a, 1) for a in plan.axis_names)
-        dev_array = mesh_utils.create_hybrid_device_mesh(
-            per_slice, dcn, devices=devices, allow_split_physical_axes=True
-        )
+        if hasattr(devices[0], "slice_index"):
+            dev_array = mesh_utils.create_hybrid_device_mesh(
+                per_slice, dcn, devices=devices, allow_split_physical_axes=True
+            )
+        else:
+            # CPU stand-in devices carry no slice_index attribute; build the
+            # same dcn-outermost-per-axis layout by hand (slice-major device
+            # order) so multi-slice plans stay testable on the virtual mesh.
+            # Real TPU topology errors must surface, so this path is gated
+            # on the attribute, not on catching ValueError.
+            n = len(plan.axis_names)
+            arr = np.array(devices).reshape(*dcn, *per_slice)
+            order = [i for pair in ((k, k + n) for k in range(n)) for i in pair]
+            dev_array = arr.transpose(order).reshape(plan.shape)
     else:
         try:
             dev_array = mesh_utils.create_device_mesh(

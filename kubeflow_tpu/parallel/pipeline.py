@@ -1,0 +1,131 @@
+"""GPipe-style pipeline parallelism over the ``pipeline`` mesh axis.
+
+The reference supports PP only as an orchestration contract (the operator
+guarantees gang + env; Megatron/DeepSpeed do the scheduling inside user
+containers) [SURVEY.md §2.5 PP row].  Here the schedule itself is
+TPU-native: the scanned layer stack's leading dim is already sharded over
+``pipeline`` (the ``("layers", "pipeline")`` logical rule), so each device
+holds a contiguous stage of layers; this module adds the microbatch
+schedule — a ``shard_map`` manual over *only* the pipeline axis, with
+``lax.ppermute`` passing activations stage-to-stage, while every other
+mesh axis (data/fsdp/model/seq) stays in GSPMD auto mode so ZeRO gathers
+and TP collectives keep working inside each stage.
+
+Why this shape: the pipeline axis is the DCN-tolerant one (mesh.py) — an
+activation crosses a slice boundary once per microbatch per stage, which
+amortizes over the whole stage's compute; the schedule is classic GPipe
+(fill, steady state, drain: M + P - 1 ticks for M microbatches over P
+stages).  Backward runs the reverse pipeline automatically: ``ppermute``
+transposes to the opposite ring and ``lax.scan`` reverses.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .mesh import current_mesh
+
+AXIS = "pipeline"
+
+
+def pipeline_degree(mesh: Optional[Mesh]) -> int:
+    if mesh is None or AXIS not in mesh.axis_names:
+        return 1
+    return mesh.shape[AXIS]
+
+
+def gpipe(
+    block_apply: Callable[[Any, jax.Array], jax.Array],
+    stacked_params: Any,
+    x: jax.Array,
+    *,
+    mesh: Optional[Mesh] = None,
+    num_microbatches: Optional[int] = None,
+    remat: bool = True,
+) -> jax.Array:
+    """Run ``num_layers`` blocks over ``x`` as a P-stage microbatch pipeline.
+
+    ``block_apply(layer_params, x) -> x`` applies ONE block given one
+    layer's param subtree.  ``stacked_params`` is the scan-stacked tree
+    (leading dim = num_layers, sharded over the ``pipeline`` mesh axis so
+    each device already holds its stage's layers — no weight movement).
+    ``x``: [batch, ...] activations, batch divisible by the microbatch
+    count (default: the pipeline degree).
+
+    Falls back to a plain sequential scan when no pipeline axis is active,
+    so callers can use it unconditionally.
+    """
+    mesh = mesh or current_mesh()
+    p_size = pipeline_degree(mesh)
+
+    one = jax.checkpoint(block_apply) if remat else block_apply
+
+    def apply_stage(layers, h):
+        def body(h, lp):
+            return one(lp, h), None
+        h, _ = lax.scan(body, h, layers)
+        return h
+
+    if p_size == 1:
+        return apply_stage(stacked_params, x)
+
+    m = num_microbatches or p_size
+    batch = x.shape[0]
+    if batch % m:
+        raise ValueError(
+            f"batch {batch} not divisible by {m} microbatches")
+    x_mb = x.reshape(m, batch // m, *x.shape[1:])
+
+    num_layers = jax.tree.leaves(stacked_params)[0].shape[0]
+    if num_layers % p_size:
+        raise ValueError(
+            f"{num_layers} layers not divisible by {p_size} pipeline stages")
+
+    layer_specs = jax.tree.map(lambda _: P(AXIS), stacked_params)
+    perm = [(i, i + 1) for i in range(p_size - 1)]
+
+    def body(local_layers, x_mb):
+        stage = lax.axis_index(AXIS)
+        state = jnp.zeros_like(x_mb[0])
+        out_buf = jnp.zeros_like(x_mb)
+
+        def tick(carry, t):
+            state, out_buf = carry
+            # stage 0 ingests microbatch t during the fill/steady phase
+            inp = lax.dynamic_index_in_dim(
+                x_mb, jnp.clip(t, 0, m - 1), 0, keepdims=False)
+            cur = jnp.where(stage == 0, inp, state)
+            y = apply_stage(local_layers, cur)
+            # last stage emits microbatch t-(P-1) once the fill completes
+            widx = t - (p_size - 1)
+            upd = lax.dynamic_update_index_in_dim(
+                out_buf, y, jnp.clip(widx, 0, m - 1), 0)
+            emit = jnp.logical_and(widx >= 0, stage == p_size - 1)
+            out_buf = jnp.where(emit, upd, out_buf)
+            nxt = lax.ppermute(y, AXIS, perm)
+            return (nxt, out_buf), None
+
+        (_, out_buf), _ = lax.scan(
+            tick, (state, out_buf), jnp.arange(m + p_size - 1))
+        # broadcast the finished buffer from the last stage to every rank
+        # (the head/loss run data-parallel on all devices afterwards)
+        out_buf = lax.psum(
+            jnp.where(stage == p_size - 1, out_buf, jnp.zeros_like(out_buf)),
+            AXIS,
+        )
+        return out_buf
+
+    out = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(layer_specs, P()),
+        out_specs=P(),
+        axis_names={AXIS},
+        check_vma=False,
+    )(stacked_params, x_mb)
+    return out.reshape(batch, *x.shape[1:])

@@ -51,6 +51,16 @@ class TrainConfig:
     checkpoint_dir: Optional[str] = None
     save_interval_steps: int = 100
     log_every: int = 10
+    #: microbatch count for pipeline parallelism (mesh has a ``pipeline``
+    #: axis > 1); default = pipeline degree.  Ignored otherwise.
+    num_microbatches: Optional[int] = None
+    #: when set, capture a jax.profiler trace (XPlane, TensorBoard-loadable)
+    #: of steps [profile_start, profile_stop) into this directory — the
+    #: SURVEY §5 tracing-subsystem hook (reconcile metrics stay Prometheus-
+    #: style on the control plane; device traces live here in the trainer).
+    profile_dir: Optional[str] = None
+    profile_start: int = 3
+    profile_stop: int = 6
 
 
 @dataclasses.dataclass
@@ -145,7 +155,14 @@ class Trainer:
 
     def _loss_fn(self, params, tokens: jax.Array):
         inputs, targets = tokens[:, :-1], tokens[:, 1:]
-        logits = self.model.apply({"params": params}, inputs)
+        if self.mesh.shape.get("pipeline", 1) > 1:
+            logits = llamalib.pipelined_apply(
+                self.cfg.model, params, inputs,
+                mesh=self.mesh,
+                num_microbatches=self.cfg.num_microbatches,
+            )
+        else:
+            logits = self.model.apply({"params": params}, inputs)
         loss = optax.softmax_cross_entropy_with_integer_labels(
             logits.astype(jnp.float32), targets).mean()
         return loss
@@ -195,31 +212,56 @@ class Trainer:
         batches = datalib.device_batches(
             source, self.batch_sharding, cfg.steps - start_step,
             start_step=start_step)
+        profiling = False
+        # Steps are enqueued asynchronously and the host only blocks on
+        # device results at log/profile boundaries: fetching the loss every
+        # step serializes host round-trips into the device timeline (on a
+        # remote-dispatch PJRT backend that is ~100ms/step) and hides none
+        # of it.  Throughput is therefore metered per log window.
+        window_t0 = time.perf_counter()
+        window_steps = 0
         with shardlib.shard_context(self.mesh):
             for i, batch in enumerate(batches):
                 step = start_step + i
-                t0 = time.perf_counter()
-                state, out = step_fn(state, batch)
-                loss = float(jax.device_get(out["loss"]))  # blocks on step
-                dt = time.perf_counter() - t0
-                tps = tokens_per_step / dt
-                mfu = (
-                    tps / n_chips * flops_tok / (peak * 1e12)
-                    if peak else 0.0
-                )
-                metrics = StepMetrics(
-                    step=step + 1,
-                    loss=loss,
-                    grad_norm=float(jax.device_get(out["grad_norm"])),
-                    step_time_s=dt,
-                    tokens_per_sec=tps,
-                    tokens_per_sec_per_chip=tps / n_chips,
-                    mfu=mfu,
-                )
-                if on_metrics and ((step + 1) % cfg.log_every == 0 or step == cfg.steps - 1):
-                    on_metrics(metrics)
+                if cfg.profile_dir and step == cfg.profile_start:
+                    jax.profiler.start_trace(cfg.profile_dir)
+                    profiling = True
+                state, out = step_fn(state, batch)  # async dispatch
+                window_steps += 1
+                if profiling and step + 1 >= cfg.profile_stop:
+                    jax.device_get(out["loss"])  # drain before stopping
+                    jax.profiler.stop_trace()
+                    profiling = False
+                sync = (step + 1) % cfg.log_every == 0 or step == cfg.steps - 1
+                if sync:
+                    loss = float(jax.device_get(out["loss"]))  # blocks
+                    now = time.perf_counter()
+                    dt = (now - window_t0) / window_steps
+                    tps = tokens_per_step / dt
+                    mfu = (
+                        tps / n_chips * flops_tok / (peak * 1e12)
+                        if peak else 0.0
+                    )
+                    metrics = StepMetrics(
+                        step=step + 1,
+                        loss=loss,
+                        grad_norm=float(jax.device_get(out["grad_norm"])),
+                        step_time_s=dt,
+                        tokens_per_sec=tps,
+                        tokens_per_sec_per_chip=tps / n_chips,
+                        mfu=mfu,
+                    )
+                    window_t0 = now
+                    window_steps = 0
+                    if on_metrics:
+                        on_metrics(metrics)
                 if self.ckpt:
                     self.ckpt.save(step + 1, state)
+            if profiling:
+                # loop ended inside the requested window (steps < stop, or
+                # resume landed mid-window) — close the trace so the XPlane
+                # is written and the global profiler session is released
+                jax.profiler.stop_trace()
         if self.ckpt:
             # orbax force=True still refuses to overwrite an existing step,
             # so skip if the in-loop save already wrote the final step

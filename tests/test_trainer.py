@@ -102,8 +102,8 @@ def test_reshape_restore_across_meshes(tmp_ckpt_dir):
     t2 = trainlib.Trainer(_cfg(tmp_ckpt_dir, steps=5, mesh_axes={"data": 8}))
     restored = t2.restore_or_init()
     np.testing.assert_allclose(
-        np.asarray(jax.device_get(saved["params"]["final_norm"]["scale"])),
-        np.asarray(jax.device_get(restored["params"]["final_norm"]["scale"])),
+        np.asarray(jax.device_get(saved["params"]["head"]["final_norm"]["scale"])),
+        np.asarray(jax.device_get(restored["params"]["head"]["final_norm"]["scale"])),
     )
     out = t2.train()
     assert out.step == 5
