@@ -188,13 +188,19 @@ class Tpe(Suggester):
             return np.log(kern.mean(axis=1) + 1e-12).sum(axis=-1)
 
         out = []
+        bad_aug = bad  # grows with each pick so a batch doesn't collapse
         for _ in range(req.count):
             # candidates drawn around the good set
             idx = nprng.integers(0, len(good), self.N_CANDIDATES)
             cand = good[idx] + nprng.normal(0, self.BANDWIDTH, (self.N_CANDIDATES, pts.shape[1]))
             cand = np.clip(cand, 0.0, 1.0)
-            score = density(cand, good) - density(cand, bad)
+            score = density(cand, good) - density(cand, bad_aug)
             best = cand[int(np.argmax(score))]
+            # treat the chosen point as "bad" for the rest of the batch:
+            # the l/g ratio then penalizes re-picking its neighborhood, so
+            # count>1 returns diverse assignments (Katib's TPE batches via
+            # hyperopt get this from sequential model updates)
+            bad_aug = np.concatenate([bad_aug, best[None, :]], axis=0)
             out.append({
                 p.name: _from_unit(p, float(best[i]))
                 for i, p in enumerate(req.parameters)

@@ -130,7 +130,7 @@ class ExperimentController(Controller):
                 return "GoalReached"
             if exp.spec.objective.type == ObjectiveType.MINIMIZE and optimal_value <= goal:
                 return "GoalReached"
-        if exp.spec.max_failed_trial_count and len(failed) > exp.spec.max_failed_trial_count:
+        if exp.spec.max_failed_trial_count and len(failed) >= exp.spec.max_failed_trial_count:
             return "MaxFailedTrialsReached"
         if len(succeeded) + len(failed) >= exp.spec.max_trial_count:
             return "MaxTrialsReached"

@@ -254,7 +254,19 @@ class InferenceServiceController(Controller):
         while len(dep.predictors) > desired:
             server = dep.predictors.pop()
             self._wire(isvc, dep)  # drop from router before stopping
-            server.stop()
+            # drain asynchronously: requests already dispatched to this
+            # replica (or queued in its micro-batcher) finish rather than
+            # surfacing as 5xx, and the reconcile worker is not blocked for
+            # the (bounded) drain period.
+            def _drain_stop(srv=server):
+                deadline = time.monotonic() + 5.0
+                while srv.metrics.inflight > 0 and time.monotonic() < deadline:
+                    time.sleep(0.02)
+                srv.stop()
+
+            threading.Thread(
+                target=_drain_stop, name="replica-drain", daemon=True
+            ).start()
             self.emit_event(isvc, "ReplicaStopped", server.url)
             changed = True
         return changed

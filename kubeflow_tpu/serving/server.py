@@ -51,15 +51,19 @@ class MicroBatcher:
         self.timeout_s = max(timeout_ms, 0.0) / 1e3
         self._q: "queue.Queue[_Pending]" = queue.Queue()
         self._stop = threading.Event()
+        self._gate = threading.Lock()  # serializes enqueue vs. shutdown
         self._thread = threading.Thread(
             target=self._loop, name=f"batcher-{model.name}", daemon=True)
         self._thread.start()
 
     def submit(self, instances: list) -> list:
-        if self._stop.is_set():
-            raise RuntimeError(f"model {self.model.name} is shutting down")
         p = _Pending(instances)
-        self._q.put(p)
+        # check-and-enqueue under the gate: stop() flips _stop under the
+        # same lock, so no submit can slip into the queue after the drain
+        with self._gate:
+            if self._stop.is_set():
+                raise RuntimeError(f"model {self.model.name} is shutting down")
+            self._q.put(p)
         p.done.wait()
         if p.error is not None:
             raise p.error
@@ -67,7 +71,8 @@ class MicroBatcher:
         return p.result
 
     def stop(self) -> None:
-        self._stop.set()
+        with self._gate:
+            self._stop.set()
         self._thread.join(timeout=2)
         # fail any requests that raced the shutdown — their HTTP threads
         # are blocked in submit() and would otherwise hang forever
