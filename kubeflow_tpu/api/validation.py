@@ -37,10 +37,27 @@ def default_jaxjob(job: JaxJob) -> JaxJob:
     if rp.scheduling_policy.min_available is None:
         # all-or-nothing by default: the whole gang (Volcano minMember analog)
         rp.scheduling_policy.min_available = spec.total_replicas
+    elif rp.scheduling_policy.min_available > spec.total_replicas:
+        # elastic resize shrinks the gang: a min_available stamped for the
+        # old world size would make the spec permanently inadmissible, so
+        # defaulting re-clamps it (mutating webhooks run on UPDATE too)
+        rp.scheduling_policy.min_available = spec.total_replicas
+    workers = spec.replica_specs[WORKER]
+    chips_per_host = max(1, workers.template.resources.tpu or 1)
+    total_chips = workers.replicas * chips_per_host
+    if (
+        job.metadata.creation_timestamp is not None
+        and set(spec.mesh) == {"data"}
+        and spec.mesh["data"] != total_chips
+    ):
+        # UPDATE of a live job whose pure-DP default mesh was stamped for an
+        # old world size (elastic resize): re-derive.  On CREATE (no
+        # creation_timestamp yet) a mismatched mesh is the user's own input
+        # and must fail validation, not be silently rewritten; custom
+        # (non-"data") meshes are always left to validation.
+        spec.mesh = {}
     if not spec.mesh:
-        workers = spec.replica_specs[WORKER]
-        chips_per_host = max(1, workers.template.resources.tpu or 1)
-        spec.mesh = {"data": workers.replicas * chips_per_host}
+        spec.mesh = {"data": total_chips}
     return job
 
 

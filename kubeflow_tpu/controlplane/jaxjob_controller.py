@@ -38,6 +38,7 @@ from ..api.common import (
 )
 from ..api.jaxjob import KIND_JAXJOB, WORKER, JaxJob
 from ..api.common import CleanPodPolicy
+from ..api.validation import default_jaxjob
 from .controller import Controller, Result
 from .expectations import Expectations
 from .objects import (
@@ -121,6 +122,10 @@ class JaxJobController(Controller):
 
         if job.spec.run_policy.suspend:
             return self._handle_suspend(job, pods)
+
+        resize_msg = self._resize_needed(job, pods)
+        if resize_msg:
+            return self._handle_resize(job, pods, resize_msg)
 
         self._ensure_condition(job, JobConditionType.CREATED, "JobCreated", "JaxJob accepted")
 
@@ -378,6 +383,71 @@ class JaxJobController(Controller):
             if policy == CleanPodPolicy.RUNNING and p.terminal:
                 continue
             self.store.try_delete(KIND_POD, p.metadata.name, p.metadata.namespace)
+
+    # -- elastic resize --------------------------------------------------------
+
+    def _resize_needed(self, job: JaxJob, pods: list[Pod]) -> Optional[str]:
+        """A live worker whose stamped world size (or index range) no longer
+        matches the spec means the user changed ``replicas`` on a running
+        job — the PyTorchJob ElasticPolicy capability, TPU-style: the
+        collective cannot be patched one rank at a time, so the whole gang
+        re-forms on the new world size and resumes from checkpoint
+        (reshape-restore, SURVEY §2.5 elastic row)."""
+        want = job.spec.worker_count
+        for p in pods:
+            if p.metadata.labels.get(LABEL_REPLICA_TYPE) != WORKER or p.terminal:
+                continue
+            stamped = p.spec.container.env.get(ENV_NUM_PROCESSES)
+            if stamped is not None and int(stamped) != want:
+                return f"world size {stamped} -> {want}"
+            idx = int(p.metadata.labels.get(LABEL_REPLICA_INDEX, 0))
+            if idx >= want:
+                return f"worker index {idx} out of range for {want} replicas"
+        return None
+
+    def _handle_resize(
+        self, job: JaxJob, pods: list[Pod], msg: str
+    ) -> Optional[Result]:
+        """suspend gang -> recompute stale defaults -> re-gang on the new
+        size.  Deleted workers get SIGTERM and save-on-preemption; the new
+        gang's ``restore_or_init`` reshape-restores onto the new mesh.
+        Resizes do not consume the failure backoff budget."""
+
+        def mut(o: JaxJob) -> None:
+            # the new gang is all-or-nothing at its new size: a stamped
+            # min_available from the old world size would under-admit
+            # (scale-up) or over-demand (scale-down) the collective
+            sp = o.spec.run_policy.scheduling_policy
+            if sp is not None:
+                sp.min_available = o.spec.total_replicas
+            default_jaxjob(o)
+
+        job = self._update_job(job, mut)
+        # PodGroup is recreated next reconcile with the new min_member
+        self.store.try_delete(KIND_PODGROUP, job.metadata.name, job.metadata.namespace)
+        # per-replica Services for removed indices would otherwise leak
+        # until job deletion; drop them all — the next reconcile recreates
+        # one per surviving pod
+        for svc in self.store.list(KIND_SERVICE, job.metadata.namespace):
+            if any(
+                r.kind == KIND_JAXJOB and r.name == job.metadata.name
+                for r in svc.metadata.owner_references
+            ):
+                self.store.try_delete(
+                    KIND_SERVICE, svc.metadata.name, job.metadata.namespace)
+        key = job.key
+        live = [
+            p for p in pods
+            if self.store.try_get(KIND_POD, p.metadata.name, p.metadata.namespace)
+        ]
+        self.expectations.expect_deletions(key, len(live))
+        for p in live:
+            if not self.store.try_delete(KIND_POD, p.metadata.name, p.metadata.namespace):
+                self.expectations.deletion_observed(key)
+        self._set_cond(
+            job, JobConditionType.RESTARTING, "Resizing", f"elastic resize: {msg}")
+        self.emit_event(job, "Resizing", msg)
+        return Result(requeue_after=0.05)
 
     def _handle_suspend(self, job: JaxJob, pods: list[Pod]) -> Optional[Result]:
         for p in pods:

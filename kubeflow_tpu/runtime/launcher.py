@@ -202,6 +202,11 @@ class LocalKubelet:
         if code is None:
             return
         del self._procs[pod.key]
+        if code < 0:
+            # Popen reports signal deaths as -N; real kubelets report
+            # 128+N (SIGKILL -> 137, SIGTERM -> 143), which is what the
+            # RestartPolicy ExitCode allowlist treats as retryable.
+            code = 128 - code
         phase = PodPhase.SUCCEEDED if code == 0 else PodPhase.FAILED
         self._set_status(
             pod, phase, exit_code=code, finish_time=time.time()

@@ -257,17 +257,20 @@ class InferenceServiceController(Controller):
             # drain asynchronously: requests already dispatched to this
             # replica (or queued in its micro-batcher) finish rather than
             # surfacing as 5xx, and the reconcile worker is not blocked for
-            # the (bounded) drain period.
-            def _drain_stop(srv=server):
+            # the (bounded) drain period.  The initial settle sleep covers
+            # requests the router already picked this backend for but whose
+            # handler has not yet reached _dispatch's inflight increment.
+            def _drain_stop(srv=server, svc=isvc):
+                time.sleep(0.1)
                 deadline = time.monotonic() + 5.0
                 while srv.metrics.inflight > 0 and time.monotonic() < deadline:
                     time.sleep(0.02)
                 srv.stop()
+                self.emit_event(svc, "ReplicaStopped", srv.url)
 
             threading.Thread(
                 target=_drain_stop, name="replica-drain", daemon=True
             ).start()
-            self.emit_event(isvc, "ReplicaStopped", server.url)
             changed = True
         return changed
 

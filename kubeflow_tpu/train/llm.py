@@ -44,6 +44,10 @@ def train_main(ctx: "bootstrap.PodContext") -> None:
     """Runs on every worker; emits per-step metrics from the coordinator."""
     cfg = config_from_env(ctx)
     t = trainlib.Trainer(cfg)
+    if ctx.is_coordinator and t.ckpt is not None:
+        # observable resume marker: >0 after a gang restart picked up a
+        # checkpoint (the fault-injection e2e asserts step continuity on it)
+        bootstrap.emit_metric(ctx, "resume_step", t.ckpt.latest_step() or 0)
 
     def on_metrics(m: trainlib.StepMetrics) -> None:
         if ctx.is_coordinator:

@@ -16,6 +16,8 @@ bucketing/overlap machinery to hand-tune like NCCL DDP.
 from __future__ import annotations
 
 import dataclasses
+import signal
+import threading
 import time
 from typing import Any, Callable, Optional
 
@@ -208,16 +210,41 @@ class Trainer:
             getattr(self.mesh.devices.flat[0], "device_kind", "cpu").lower(), 0.0)
         tokens_per_step = cfg.global_batch * cfg.seq_len
 
-        metrics = None
         batches = datalib.device_batches(
             source, self.batch_sharding, cfg.steps - start_step,
             start_step=start_step)
-        profiling = False
+        # Save-on-preemption (SURVEY §5 failure detection; Tenplex-style
+        # resume): SIGTERM — what the kubelet sends on pod deletion, gang
+        # restart, or slice preemption — sets a flag; the loop checkpoints
+        # and exits 143 (retryable) so the next incarnation resumes.
+        self._preempted = False
+        prev_handler = None
+        handler_installed = False
+        if self.ckpt and threading.current_thread() is threading.main_thread():
+            def _on_sigterm(signum, frame):
+                self._preempted = True
+            prev_handler = signal.signal(signal.SIGTERM, _on_sigterm)
+            handler_installed = True
+
         # Steps are enqueued asynchronously and the host only blocks on
         # device results at log/profile boundaries: fetching the loss every
         # step serializes host round-trips into the device timeline (on a
         # remote-dispatch PJRT backend that is ~100ms/step) and hides none
         # of it.  Throughput is therefore metered per log window.
+        try:
+            metrics = self._run_loop(
+                state, step_fn, batches, start_step,
+                tokens_per_step, n_chips, flops_tok, peak, on_metrics)
+        finally:
+            if handler_installed:
+                signal.signal(signal.SIGTERM, prev_handler)
+        return metrics
+
+    def _run_loop(self, state, step_fn, batches, start_step,
+                  tokens_per_step, n_chips, flops_tok, peak, on_metrics):
+        cfg = self.cfg
+        metrics = None
+        profiling = False
         window_t0 = time.perf_counter()
         window_steps = 0
         with shardlib.shard_context(self.mesh):
@@ -257,6 +284,11 @@ class Trainer:
                         on_metrics(metrics)
                 if self.ckpt:
                     self.ckpt.save(step + 1, state)
+                if self._preempted and self.ckpt:
+                    if step + 1 not in self.ckpt.all_steps():
+                        self.ckpt.save(step + 1, state, force=True)
+                    self.ckpt.wait_until_finished()
+                    raise SystemExit(143)
             if profiling:
                 # loop ended inside the requested window (steps < stop, or
                 # resume landed mid-window) — close the trace so the XPlane

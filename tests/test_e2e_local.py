@@ -6,6 +6,9 @@ These spawn real subprocesses doing real multi-process JAX on the CPU
 backend — the same XLA code path as a multi-host TPU slice.
 """
 
+import os
+import re
+import signal
 import time
 
 import pytest
@@ -90,3 +93,115 @@ spec:
                 num_workers=1,
                 timeout=120,
             )
+
+    def test_kill_live_worker_gang_restart_resume(self, platform, tmp_path):
+        """SURVEY §5 fault injection: SIGKILL a healthy worker mid-train.
+
+        Expects the full recovery chain: kubelet reports 137 (retryable) ->
+        controller gang-restarts (RESTARTING condition, ALL pods recreated,
+        restart_count bumped; the surviving worker's SIGTERM triggers
+        save-on-preemption) -> new gang resumes from the checkpoint
+        (resume_step > 0) -> Succeeded with the full step count reached.
+        """
+        from kubeflow_tpu.controlplane import events_for
+
+        ckpt_dir = str(tmp_path / "fault-ckpt")
+        client = TrainingClient(platform)
+        client.train(
+            name="fault",
+            entrypoint="kubeflow_tpu.train.llm:train_main",
+            num_workers=2,
+            env={
+                "KFT_STEPS": "40",
+                "KFT_BATCH": "8",
+                "KFT_SEQ_LEN": "16",
+                "KFT_CKPT_DIR": ckpt_dir,
+                "KFT_SAVE_EVERY": "2",
+                "KFT_LOG_EVERY": "2",
+            },
+            backoff_limit=2,
+            wait=False,
+        )
+        # wait until training is genuinely under way: a checkpoint exists
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            steps = [d for d in (os.listdir(ckpt_dir) if os.path.isdir(ckpt_dir) else [])
+                     if d.isdigit()]
+            if steps:
+                break
+            time.sleep(0.2)
+        assert steps, "no checkpoint appeared before the kill"
+
+        pod = platform.store.get("Pod", "fault-worker-1")
+        assert pod.status.pid, pod.status
+        os.kill(pod.status.pid, signal.SIGKILL)
+
+        job = client.wait_for_job_conditions("fault", timeout=300)
+        assert has_condition(job.status.conditions, JobConditionType.SUCCEEDED)
+        assert job.status.restart_count >= 1
+        reasons = [e.reason for e in events_for(platform.store, "JaxJob", "fault")]
+        assert "Restarting" in reasons
+        # step continuity: the restarted gang resumed from a checkpoint,
+        # not step 0, and still reached the configured 40 steps
+        log = client.get_job_logs("fault")["fault-worker-0"]
+        resumes = [int(m) for m in re.findall(r"resume_step=(\d+)", log)]
+        assert len(resumes) >= 2 and resumes[0] == 0 and max(resumes) > 0, resumes
+        final_steps = [d for d in os.listdir(ckpt_dir) if d.isdigit()]
+        assert max(int(s) for s in final_steps) == 40
+
+    def test_elastic_resize_resumes_from_checkpoint(self, platform, tmp_path):
+        """SURVEY §2.5 elastic row: change replicas on a LIVE job.
+
+        4 workers -> 2: controller detects the stale world size, re-gangs
+        (Resizing event; deleted workers save-on-preemption), recomputes the
+        default mesh for the new size, and the 2-worker gang reshape-restores
+        the checkpoint and finishes all steps.  backoff_limit=0 proves the
+        resize does not consume the failure budget.
+        """
+        from kubeflow_tpu.controlplane import events_for
+
+        ckpt_dir = str(tmp_path / "resize-ckpt")
+        client = TrainingClient(platform)
+        client.train(
+            name="elastic",
+            entrypoint="kubeflow_tpu.train.llm:train_main",
+            num_workers=4,
+            env={
+                "KFT_STEPS": "40",
+                "KFT_BATCH": "8",
+                "KFT_SEQ_LEN": "16",
+                "KFT_CKPT_DIR": ckpt_dir,
+                "KFT_SAVE_EVERY": "2",
+                "KFT_LOG_EVERY": "2",
+            },
+            backoff_limit=0,
+            wait=False,
+        )
+        deadline = time.time() + 180
+        steps = []
+        while time.time() < deadline:
+            steps = [d for d in (os.listdir(ckpt_dir) if os.path.isdir(ckpt_dir) else [])
+                     if d.isdigit()]
+            if steps:
+                break
+            time.sleep(0.2)
+        assert steps, "no checkpoint appeared before the resize"
+
+        platform.store.update_with_retry(
+            "JaxJob", "elastic", "default",
+            lambda o: setattr(o.spec.replica_specs["worker"], "replicas", 2),
+        )
+
+        job = client.wait_for_job_conditions("elastic", timeout=300)
+        assert has_condition(job.status.conditions, JobConditionType.SUCCEEDED)
+        reasons = [e.reason for e in events_for(platform.store, "JaxJob", "elastic")]
+        assert "Resizing" in reasons
+        # the resized gang resumed from checkpoint on the smaller mesh
+        log = client.get_job_logs("elastic")["elastic-worker-0"]
+        resumes = [int(m) for m in re.findall(r"resume_step=(\d+)", log)]
+        assert len(resumes) >= 2 and resumes[0] == 0 and max(resumes) > 0, resumes
+        final = platform.store.get("JaxJob", "elastic")
+        assert final.spec.replica_specs["worker"].replicas == 2
+        assert final.spec.mesh == {"data": 2}
+        final_steps = [d for d in os.listdir(ckpt_dir) if d.isdigit()]
+        assert max(int(s) for s in final_steps) == 40
