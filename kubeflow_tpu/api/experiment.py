@@ -114,6 +114,15 @@ def substitute_parameters(obj: Any, assignments: dict[str, Any]) -> Any:
     return obj
 
 
+class EarlyStoppingSpec(_Model):
+    """Early-stopping policy [upstream: Katib EarlyStopping CRD field;
+    algorithms in pkg/earlystopping/].  ``asha`` implemented natively
+    (hpo/early_stopping.py); settings are string KV like AlgorithmSpec."""
+
+    algorithm_name: str = "asha"
+    settings: dict[str, str] = Field(default_factory=dict)
+
+
 class ExperimentSpec(_Model):
     objective: ObjectiveSpec = Field(default_factory=ObjectiveSpec)
     algorithm: AlgorithmSpec = Field(default_factory=AlgorithmSpec)
@@ -122,6 +131,7 @@ class ExperimentSpec(_Model):
     max_trial_count: int = 1
     max_failed_trial_count: int = 0
     trial_template: Optional[TrialTemplate] = None
+    early_stopping: Optional[EarlyStoppingSpec] = None
 
 
 class TrialAssignment(_Model):
@@ -134,11 +144,15 @@ class ExperimentStatus(_Model):
     trials_created: int = 0
     trials_succeeded: int = 0
     trials_failed: int = 0
+    trials_early_stopped: int = 0
     trials_running: int = 0
     current_optimal_trial: Optional[str] = None
     current_optimal_value: Optional[float] = None
     current_optimal_assignments: list[TrialAssignment] = Field(default_factory=list)
     completed: bool = False
+    #: set once observations from a previous control-plane incarnation have
+    #: been replayed from the durable store (hpo/db.py)
+    replayed: bool = False
 
 
 class Experiment(TypedObject):
@@ -181,7 +195,10 @@ class TrialStatus(_Model):
     conditions: list = Field(default_factory=list)
     observation: Optional[float] = None  # final objective metric value
     metrics: dict[str, float] = Field(default_factory=dict)
-    phase: str = "Pending"  # Pending | Running | Succeeded | Failed
+    phase: str = "Pending"  # Pending | Running | Succeeded | Failed | EarlyStopped
+    #: ASHA rung -> objective value recorded when the trial crossed that
+    #: resource milestone (str keys: the status round-trips through JSON)
+    rung_values: dict[str, float] = Field(default_factory=dict)
 
 
 class Trial(TypedObject):

@@ -74,6 +74,50 @@ class InferenceService(TypedObject):
     status: InferenceServiceStatus = Field(default_factory=InferenceServiceStatus)
 
 
+KIND_INFERENCE_GRAPH = "InferenceGraph"
+
+
+class GraphStep(_Model):
+    """One step of a graph node [upstream: kserve ->
+    pkg/apis/serving/v1alpha1/inference_graph_types.go InferenceStep]."""
+
+    #: target InferenceService (exactly one of service_name/node_name)
+    service_name: Optional[str] = None
+    #: target nested node in the same graph
+    node_name: Optional[str] = None
+    #: Switch: simple predicate on the request JSON — ``key == value``,
+    #: ``key != value``, ``key > value``, ``key < value`` (kserve uses
+    #: gjson expressions; this is the same capability, simpler syntax)
+    condition: Optional[str] = None
+    #: Sequence: what the step receives — "$response" (previous step's
+    #: output, default) or "$request" (the original graph input)
+    data: str = "$response"
+
+
+class GraphNode(_Model):
+    #: "Sequence" (steps chained in order) or "Switch" (first step whose
+    #: condition matches the request handles it)
+    router_type: str = "Sequence"
+    steps: list[GraphStep] = Field(default_factory=list)
+
+
+class InferenceGraphSpec(_Model):
+    #: node name -> node; "root" is the entrypoint
+    nodes: dict[str, GraphNode] = Field(default_factory=dict)
+
+
+class InferenceGraphStatus(_Model):
+    phase: InferenceServicePhase = InferenceServicePhase.PENDING
+    url: Optional[str] = None
+    message: str = ""
+
+
+class InferenceGraph(TypedObject):
+    kind: str = KIND_INFERENCE_GRAPH
+    spec: InferenceGraphSpec = Field(default_factory=InferenceGraphSpec)
+    status: InferenceGraphStatus = Field(default_factory=InferenceGraphStatus)
+
+
 class SupportedModelFormat(_Model):
     name: str
     version: Optional[str] = None

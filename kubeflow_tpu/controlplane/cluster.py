@@ -81,26 +81,46 @@ class Cluster:
             except Exception:  # noqa: BLE001 — already registered
                 pass
         self.add_controller(InferenceServiceController(self.store))
+        from ..serving.graph import InferenceGraphController
+
+        self.add_controller(InferenceGraphController(self.store))
 
     def enable_hpo(
         self,
         metrics_root: Optional[str] = None,
         log_path_for=None,
+        db_path: Optional[str] = None,
     ) -> None:
         """Register the Katib-tier reconcilers (SURVEY.md §2.3).  Separate
         from __init__ because the trial metrics collector needs the kubelet's
-        filesystem layout, which only the platform knows."""
+        filesystem layout, which only the platform knows.
+
+        ``db_path`` (defaulting to ``<metrics_root>/observations.sqlite``
+        when a metrics root exists) stands up the durable observation store
+        behind its gRPC facade (hpo/db.py, the katib-db-manager analog):
+        completed-trial history then survives control-plane restarts."""
+        import os
+
         from ..hpo.controllers import (
             ExperimentController,
             SuggestionController,
             TrialController,
         )
+        from ..hpo.db import DbManagerClient, DbManagerServer
 
-        self.add_controller(ExperimentController(self.store))
-        self.add_controller(SuggestionController(self.store))
+        if db_path is None and metrics_root is not None:
+            db_path = os.path.join(metrics_root, "observations.sqlite")
+        db_client = None
+        if db_path is not None:
+            self._db_server = DbManagerServer(db_path).start()
+            db_client = self._db_client = DbManagerClient(self._db_server.address)
+
+        self.add_controller(ExperimentController(self.store, db=db_client))
+        self.add_controller(SuggestionController(self.store, db=db_client))
         self.add_controller(
             TrialController(
-                self.store, metrics_root=metrics_root, log_path_for=log_path_for
+                self.store, metrics_root=metrics_root,
+                log_path_for=log_path_for, db=db_client,
             )
         )
 
@@ -147,6 +167,12 @@ class Cluster:
         for c in self.controllers:
             c.stop()
         self.scheduler.stop()
+        if getattr(self, "_db_client", None) is not None:
+            self._db_client.close()
+            self._db_client = None
+        if getattr(self, "_db_server", None) is not None:
+            self._db_server.stop()
+            self._db_server = None
         self._started = False
 
     def __enter__(self) -> "Cluster":

@@ -51,6 +51,8 @@ from ..api.yaml_io import from_dict
 from ..controlplane.controller import Controller, Result
 from ..controlplane.store import AlreadyExists, NotFound, Store
 from . import algorithms
+from .db import DbManagerClient
+from .early_stopping import Asha
 from .service import SuggestionClient, SuggestionServer
 
 _METRIC_LINE_RE = re.compile(r"^([A-Za-z0-9_.\-]+)=([-+0-9.eE]+)\s*$")
@@ -64,6 +66,10 @@ class ExperimentController(Controller):
     kind = KIND_EXPERIMENT
     owned_kinds = (KIND_TRIAL, KIND_SUGGESTION)
 
+    def __init__(self, store: Store, db: Optional["DbManagerClient"] = None) -> None:
+        super().__init__(store)
+        self.db = db
+
     def reconcile(self, namespace: str, name: str) -> Optional[Result]:
         exp = self.store.try_get(KIND_EXPERIMENT, name, namespace)
         if exp is None:
@@ -72,6 +78,9 @@ class ExperimentController(Controller):
         if exp.status.completed:
             return None
 
+        if self.db is not None and not exp.status.replayed:
+            exp = self._replay_observations(exp)
+
         trials = [
             t
             for t in self.store.list(KIND_TRIAL, namespace)
@@ -79,14 +88,16 @@ class ExperimentController(Controller):
         ]
         succeeded = [t for t in trials if t.status.phase == "Succeeded"]
         failed = [t for t in trials if t.status.phase == "Failed"]
+        early = [t for t in trials if t.status.phase == "EarlyStopped"]
         running = [t for t in trials if t.status.phase in ("Pending", "Running")]
 
         optimal_name, optimal_value, optimal_assign = self._optimum(exp, succeeded)
 
-        done_reason = self._done_reason(exp, len(trials), succeeded, failed, optimal_value)
+        done_reason = self._done_reason(
+            exp, len(trials), succeeded, failed, early, optimal_value)
         if done_reason and not running:
             self._finish(
-                exp, done_reason, trials, succeeded, failed,
+                exp, done_reason, trials, succeeded, failed, early,
                 optimal_name, optimal_value, optimal_assign)
             return None
 
@@ -105,12 +116,74 @@ class ExperimentController(Controller):
                 created += 1
 
         self._update_status(
-            exp, trials, succeeded, failed, running,
+            exp, trials, succeeded, failed, early, running,
             optimal_name, optimal_value, optimal_assign)
         # requeue while in flight: metric scraping + suggestion fills are async
         return Result(requeue_after=0.05 if (running or want > created) else None)
 
     # -- pieces ---------------------------------------------------------------
+
+    def _replay_observations(self, exp: Experiment) -> Experiment:
+        """Rebuild Succeeded Trials from the durable observation store.
+
+        After a control-plane restart the in-memory Trial objects are gone
+        but the db-manager still has every completed observation; recreating
+        them as terminal Trials restores full history — counters, optimum
+        tracking, and algorithm history all work unchanged — without
+        re-running finished trials (katib-db-manager capability, SURVEY
+        §2.3)."""
+        ns, name = exp.metadata.namespace, exp.metadata.name
+        replayed = 0
+        try:
+            records = self.db.get_observations(name, ns)
+        except Exception:  # noqa: BLE001 — db unavailable: retry next pass
+            return exp
+        for rec in records:
+            if (
+                rec.get("phase") not in ("Succeeded", "EarlyStopped")
+                or rec.get("value") is None
+            ):
+                continue
+            if self.store.try_get(KIND_TRIAL, rec["trial"], ns) is not None:
+                continue
+            trial = Trial(
+                metadata=ObjectMeta(
+                    name=rec["trial"], namespace=ns,
+                    owner_references=[
+                        OwnerReference(kind=KIND_EXPERIMENT, name=name,
+                                       uid=exp.metadata.uid)],
+                ),
+                spec=TrialSpec(
+                    experiment_name=name,
+                    assignments=[
+                        TrialAssignment(name=k, value=v)
+                        for k, v in rec["assignments"].items()
+                    ],
+                    objective_metric_name=exp.spec.objective.objective_metric_name,
+                ),
+            )
+            trial.status.phase = rec["phase"]
+            trial.status.observation = rec["value"]
+            try:
+                self.store.create(trial)
+                replayed += 1
+            except AlreadyExists:
+                pass
+
+        def mut(o):
+            assert isinstance(o, Experiment)
+            o.status.replayed = True
+
+        try:
+            exp = self.store.update_with_retry(KIND_EXPERIMENT, name, ns, mut)
+        except NotFound:
+            pass
+        if replayed:
+            self.emit_event(
+                exp, "ObservationsReplayed",
+                f"{replayed} completed trials restored from the observation store")
+        assert isinstance(exp, Experiment)
+        return exp
 
     def _optimum(self, exp: Experiment, succeeded: list[Trial]):
         best_name, best_val, best_assign = None, None, []
@@ -123,8 +196,9 @@ class ExperimentController(Controller):
                 best_name, best_val, best_assign = t.metadata.name, v, t.spec.assignments
         return best_name, best_val, best_assign
 
-    def _done_reason(self, exp, n_trials, succeeded, failed, optimal_value) -> str:
+    def _done_reason(self, exp, n_trials, succeeded, failed, early, optimal_value) -> str:
         goal = exp.spec.objective.goal
+        terminal = len(succeeded) + len(failed) + len(early)
         if goal is not None and optimal_value is not None:
             if exp.spec.objective.type == ObjectiveType.MAXIMIZE and optimal_value >= goal:
                 return "GoalReached"
@@ -132,13 +206,13 @@ class ExperimentController(Controller):
                 return "GoalReached"
         if exp.spec.max_failed_trial_count and len(failed) >= exp.spec.max_failed_trial_count:
             return "MaxFailedTrialsReached"
-        if len(succeeded) + len(failed) >= exp.spec.max_trial_count:
+        if terminal >= exp.spec.max_trial_count:
             return "MaxTrialsReached"
         sugg = self.store.try_get(KIND_SUGGESTION, exp.metadata.name, exp.metadata.namespace)
         if (
             isinstance(sugg, Suggestion)
             and sugg.status.exhausted
-            and len(succeeded) + len(failed) >= len(sugg.status.assignments)
+            and terminal >= len(sugg.status.assignments)
         ):
             return "SearchSpaceExhausted"
         return ""
@@ -208,7 +282,7 @@ class ExperimentController(Controller):
             return False
 
     def _finish(
-        self, exp, reason, trials, succeeded, failed,
+        self, exp, reason, trials, succeeded, failed, early,
         opt_name, opt_value, opt_assign,
     ) -> None:
         def mut(o):
@@ -217,6 +291,7 @@ class ExperimentController(Controller):
             o.status.trials_created = len(trials)
             o.status.trials_succeeded = len(succeeded)
             o.status.trials_failed = len(failed)
+            o.status.trials_early_stopped = len(early)
             o.status.trials_running = 0
             o.status.current_optimal_trial = opt_name
             o.status.current_optimal_value = opt_value
@@ -237,7 +312,7 @@ class ExperimentController(Controller):
             KIND_SUGGESTION, exp.metadata.name, exp.metadata.namespace)
 
     def _update_status(
-        self, exp, trials, succeeded, failed, running,
+        self, exp, trials, succeeded, failed, early, running,
         opt_name, opt_value, opt_assign,
     ) -> None:
         def mut(o):
@@ -245,6 +320,7 @@ class ExperimentController(Controller):
             o.status.trials_created = len(trials)
             o.status.trials_succeeded = len(succeeded)
             o.status.trials_failed = len(failed)
+            o.status.trials_early_stopped = len(early)
             o.status.trials_running = len(running)
             o.status.current_optimal_trial = opt_name
             o.status.current_optimal_value = opt_value
@@ -268,8 +344,9 @@ class SuggestionController(Controller):
 
     kind = KIND_SUGGESTION
 
-    def __init__(self, store: Store) -> None:
+    def __init__(self, store: Store, db: Optional[DbManagerClient] = None) -> None:
         super().__init__(store)
+        self.db = db
         self._servers: dict[str, SuggestionServer] = {}
         self._clients: dict[str, SuggestionClient] = {}
 
@@ -335,7 +412,7 @@ class SuggestionController(Controller):
         return None
 
     def _history(self, namespace: str, exp_name: str) -> list[algorithms.Observation]:
-        out = []
+        seen: dict[str, algorithms.Observation] = {}
         for t in self.store.list(KIND_TRIAL, namespace):
             if (
                 isinstance(t, Trial)
@@ -343,13 +420,25 @@ class SuggestionController(Controller):
                 and t.status.phase == "Succeeded"
                 and t.status.observation is not None
             ):
-                out.append(
-                    algorithms.Observation(
-                        assignments={a.name: a.value for a in t.spec.assignments},
-                        value=t.status.observation,
-                    )
+                seen[t.metadata.name] = algorithms.Observation(
+                    assignments={a.name: a.value for a in t.spec.assignments},
+                    value=t.status.observation,
                 )
-        return out
+        # fold in the durable store (keyed by trial name, live objects win):
+        # after a restart the algorithm keeps its full optimization history
+        if self.db is not None:
+            try:
+                for rec in self.db.get_observations(exp_name, namespace):
+                    if (
+                        rec.get("phase") == "Succeeded"
+                        and rec.get("value") is not None
+                        and rec["trial"] not in seen
+                    ):
+                        seen[rec["trial"]] = algorithms.Observation(
+                            assignments=rec["assignments"], value=rec["value"])
+            except Exception:  # noqa: BLE001 — db unavailable: use live view
+                pass
+        return list(seen.values())
 
     def _teardown(self, key: str) -> None:
         client = self._clients.pop(key, None)
@@ -371,12 +460,15 @@ class TrialController(Controller):
         store: Store,
         metrics_root: Optional[str] = None,
         log_path_for: Optional[Callable[[str, str], str]] = None,
+        db: Optional[DbManagerClient] = None,
     ) -> None:
         super().__init__(store)
         #: root of the kubelet's per-pod status dirs (metrics.jsonl files)
         self.metrics_root = metrics_root
         #: (namespace, pod_name) -> stdout log path (Katib stdout collector)
         self.log_path_for = log_path_for
+        #: durable observation store client (katib-db-manager analog)
+        self.db = db
 
     def reconcile(self, namespace: str, name: str) -> Optional[Result]:
         trial = self.store.try_get(KIND_TRIAL, name, namespace)
@@ -424,6 +516,20 @@ class TrialController(Controller):
                     "observed in any worker's metrics", type_="Warning")
                 return None
             self._set_phase(trial, "Succeeded", observation=objective, metrics=metrics)
+            if self.db is not None:
+                try:
+                    self.db.report_observation(
+                        experiment=trial.spec.experiment_name,
+                        trial=name,
+                        assignments={
+                            a.name: a.value for a in trial.spec.assignments},
+                        value=objective,
+                        namespace=namespace,
+                    )
+                except Exception:  # noqa: BLE001 — db down: trial still valid
+                    self.emit_event(
+                        trial, "ObservationReportFailed",
+                        "db-manager unreachable", type_="Warning")
             self.emit_event(
                 trial, "TrialSucceeded",
                 f"{trial.spec.objective_metric_name}={objective}")
@@ -432,41 +538,125 @@ class TrialController(Controller):
             self._set_phase(trial, "Failed")
             self.emit_event(trial, "TrialFailed", "job failed", type_="Warning")
             return None
+        if self._maybe_early_stop(namespace, name, trial, job):
+            return None
         self._set_phase(trial, "Running")
         return Result(requeue_after=0.05)
+
+    # -- ASHA early stopping (SURVEY §2.3 suggestion/early-stopping zoo) ------
+
+    def _maybe_early_stop(
+        self, namespace: str, name: str, trial: Trial, job: JaxJob
+    ) -> bool:
+        """Record rung crossings and stop under-performing trials.
+
+        Returns True when the trial was early-stopped (job deleted, phase
+        EarlyStopped with the last observation recorded)."""
+        exp = self.store.try_get(
+            KIND_EXPERIMENT, trial.spec.experiment_name, namespace)
+        if (
+            not isinstance(exp, Experiment)
+            or exp.spec.early_stopping is None
+            or exp.spec.early_stopping.algorithm_name != "asha"
+        ):
+            return False
+        asha = Asha.from_spec(exp.spec.early_stopping)
+        metrics, steps = self._scrape_with_steps(namespace, job)
+        value = metrics.get(trial.spec.objective_metric_name)
+        step = steps.get(trial.spec.objective_metric_name)
+        if value is None or step is None:
+            return False
+        rung = asha.rung_for(int(step))
+        if rung is None or str(rung) in trial.status.rung_values:
+            return False
+        rkey = str(rung)
+
+        def mut(o):
+            assert isinstance(o, Trial)
+            o.status.rung_values[rkey] = value
+
+        try:
+            trial = self.store.update_with_retry(KIND_TRIAL, name, namespace, mut)
+        except NotFound:
+            return False
+        # asynchronous decision: judge against whatever peers have recorded
+        # at this rung so far (no bracket synchronization)
+        peers = [
+            t.status.rung_values[rkey]
+            for t in self.store.list(KIND_TRIAL, namespace)
+            if isinstance(t, Trial)
+            and t.spec.experiment_name == trial.spec.experiment_name
+            and t.metadata.name != name
+            and rkey in t.status.rung_values
+        ]
+        if not asha.should_stop(exp.spec.objective.type, rung, value, peers):
+            return False
+        self.store.try_delete(KIND_JAXJOB, name, namespace)
+        self._set_phase(trial, "EarlyStopped", observation=value, metrics=metrics)
+        if self.db is not None:
+            try:
+                self.db.report_observation(
+                    experiment=trial.spec.experiment_name,
+                    trial=name,
+                    assignments={a.name: a.value for a in trial.spec.assignments},
+                    value=value,
+                    namespace=namespace,
+                    phase="EarlyStopped",
+                )
+            except Exception:  # noqa: BLE001
+                pass
+        self.emit_event(
+            trial, "TrialEarlyStopped",
+            f"ASHA rung {rung} (step {step}): "
+            f"{trial.spec.objective_metric_name}={value} below promotion cut")
+        return True
 
     # -- metrics collection (SURVEY.md §5 observability) ----------------------
 
     def _scrape(self, namespace: str, job: JaxJob) -> dict[str, float]:
         """Last value wins per metric name, scanning every worker pod:
         structured jsonl first, stdout ``name=value`` lines as fallback."""
+        return self._scrape_with_steps(namespace, job)[0]
+
+    def _scrape_with_steps(
+        self, namespace: str, job: JaxJob
+    ) -> tuple[dict[str, float], dict[str, int]]:
+        """(metrics, steps): steps carries each metric's latest ``step``
+        extra from the jsonl stream — the resource axis ASHA rungs use."""
         metrics: dict[str, float] = {}
+        steps: dict[str, int] = {}
         for rtype, rspec in job.spec.replica_specs.items():
             for idx in range(rspec.replicas):
                 pod = replica_pod_name(job.metadata.name, rtype, idx)
                 if self.metrics_root:
                     path = os.path.join(
                         self.metrics_root, "status", namespace, pod, "metrics.jsonl")
-                    metrics.update(self._read_jsonl(path))
+                    vals, stps = self._read_jsonl(path)
+                    metrics.update(vals)
+                    steps.update(stps)
                 if self.log_path_for:
                     metrics.update(
                         self._read_stdout(self.log_path_for(namespace, pod)))
-        return metrics
+        return metrics, steps
 
     @staticmethod
-    def _read_jsonl(path: str) -> dict[str, float]:
-        out: dict[str, float] = {}
+    def _read_jsonl(path: str) -> tuple[dict[str, float], dict[str, int]]:
+        values: dict[str, float] = {}
+        steps: dict[str, int] = {}
         try:
             with open(path) as f:
                 for line in f:
                     try:
                         rec = json.loads(line)
-                        out[str(rec["name"])] = float(rec["value"])
+                        name = str(rec["name"])
+                        values[name] = float(rec["value"])
+                        if "step" in rec:
+                            steps[name] = int(rec["step"])
                     except (ValueError, KeyError):
                         continue
         except OSError:
             pass
-        return out
+        return values, steps
 
     @staticmethod
     def _read_stdout(path: str) -> dict[str, float]:

@@ -1,0 +1,214 @@
+"""Durable observation store — the katib-db-manager analog.
+
+Katib persists observation logs in MySQL behind a gRPC facade
+(``ReportObservationLog``/``GetObservationLog`` [upstream: kubeflow/katib ->
+cmd/db-manager, pkg/db]) so trial history survives control-plane restarts.
+Same shape here: a sqlite-backed store behind a real gRPC boundary (JSON
+payloads over grpc's generic handler, matching kubeflow_tpu.hpo.service's
+convention since protoc stubs aren't available in this image).
+
+Consumers:
+- TrialController reports each completed trial's objective observation;
+- SuggestionController folds stored observations into algorithm history;
+- ExperimentController REPLAYS stored observations on restart: completed
+  trials from a previous incarnation of the control plane are recreated as
+  Succeeded Trial objects, so a resumed experiment keeps its full history
+  and does not re-run finished work.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sqlite3
+import threading
+from concurrent import futures
+from typing import Optional
+
+import grpc
+
+from ..utils.net import free_port
+
+SERVICE = "kubeflow_tpu.hpo.DbManager"
+METHOD_REPORT = f"/{SERVICE}/ReportObservation"
+METHOD_GET = f"/{SERVICE}/GetObservations"
+
+
+class ObservationDb:
+    """sqlite-backed observation log (one row per completed trial)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        parent = os.path.dirname(os.path.abspath(path))
+        os.makedirs(parent, exist_ok=True)
+        self._lock = threading.Lock()
+        self._conn = sqlite3.connect(path, check_same_thread=False)
+        with self._lock:
+            self._conn.execute(
+                """CREATE TABLE IF NOT EXISTS observations (
+                    experiment TEXT NOT NULL,
+                    namespace TEXT NOT NULL DEFAULT 'default',
+                    trial TEXT NOT NULL,
+                    assignments TEXT NOT NULL,
+                    value REAL,
+                    phase TEXT NOT NULL DEFAULT 'Succeeded',
+                    ts REAL DEFAULT (strftime('%s', 'now')),
+                    PRIMARY KEY (experiment, namespace, trial)
+                )"""
+            )
+            self._conn.commit()
+
+    def report(
+        self,
+        experiment: str,
+        trial: str,
+        assignments: dict,
+        value: Optional[float],
+        namespace: str = "default",
+        phase: str = "Succeeded",
+    ) -> None:
+        with self._lock:
+            self._conn.execute(
+                "INSERT OR REPLACE INTO observations "
+                "(experiment, namespace, trial, assignments, value, phase) "
+                "VALUES (?, ?, ?, ?, ?, ?)",
+                (experiment, namespace, trial, json.dumps(assignments), value, phase),
+            )
+            self._conn.commit()
+
+    def observations(self, experiment: str, namespace: str = "default") -> list[dict]:
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT trial, assignments, value, phase FROM observations "
+                "WHERE experiment = ? AND namespace = ? ORDER BY trial",
+                (experiment, namespace),
+            ).fetchall()
+        return [
+            {
+                "trial": t,
+                "assignments": json.loads(a),
+                "value": v,
+                "phase": ph,
+            }
+            for t, a, v, ph in rows
+        ]
+
+    def close(self) -> None:
+        with self._lock:
+            self._conn.close()
+
+
+def _serialize(payload: dict) -> bytes:
+    return json.dumps(payload).encode()
+
+
+def _deserialize(data: bytes) -> dict:
+    return json.loads(data.decode())
+
+
+class _Handler(grpc.GenericRpcHandler):
+    def __init__(self, db: ObservationDb) -> None:
+        self._db = db
+        self._methods = {
+            METHOD_REPORT: grpc.unary_unary_rpc_method_handler(
+                self._report,
+                request_deserializer=_deserialize,
+                response_serializer=_serialize,
+            ),
+            METHOD_GET: grpc.unary_unary_rpc_method_handler(
+                self._get,
+                request_deserializer=_deserialize,
+                response_serializer=_serialize,
+            ),
+        }
+
+    def service(self, handler_call_details):
+        return self._methods.get(handler_call_details.method)
+
+    def _report(self, request: dict, context) -> dict:
+        try:
+            self._db.report(
+                experiment=request["experiment"],
+                trial=request["trial"],
+                assignments=request.get("assignments", {}),
+                value=request.get("value"),
+                namespace=request.get("namespace", "default"),
+                phase=request.get("phase", "Succeeded"),
+            )
+            return {"ok": True}
+        except Exception as e:  # noqa: BLE001 — surface as RPC error
+            context.abort(grpc.StatusCode.INTERNAL, f"{type(e).__name__}: {e}")
+
+    def _get(self, request: dict, context) -> dict:
+        try:
+            obs = self._db.observations(
+                request["experiment"], request.get("namespace", "default"))
+            return {"observations": obs}
+        except Exception as e:  # noqa: BLE001
+            context.abort(grpc.StatusCode.INTERNAL, f"{type(e).__name__}: {e}")
+
+
+class DbManagerServer:
+    """The katib-db-manager deployment analog: one per control plane."""
+
+    def __init__(self, db_path: str, port: Optional[int] = None):
+        self.db = ObservationDb(db_path)
+        self.port = port or free_port()
+        self._server = grpc.server(futures.ThreadPoolExecutor(max_workers=2))
+        self._server.add_generic_rpc_handlers((_Handler(self.db),))
+        self._server.add_insecure_port(f"127.0.0.1:{self.port}")
+
+    @property
+    def address(self) -> str:
+        return f"127.0.0.1:{self.port}"
+
+    def start(self) -> "DbManagerServer":
+        self._server.start()
+        return self
+
+    def stop(self) -> None:
+        self._server.stop(grace=1.0)
+        self.db.close()
+
+
+class DbManagerClient:
+    def __init__(self, address: str):
+        self._channel = grpc.insecure_channel(address)
+        self._report = self._channel.unary_unary(
+            METHOD_REPORT, request_serializer=_serialize,
+            response_deserializer=_deserialize)
+        self._get = self._channel.unary_unary(
+            METHOD_GET, request_serializer=_serialize,
+            response_deserializer=_deserialize)
+
+    def report_observation(
+        self,
+        experiment: str,
+        trial: str,
+        assignments: dict,
+        value: Optional[float],
+        namespace: str = "default",
+        phase: str = "Succeeded",
+        timeout: float = 10.0,
+    ) -> None:
+        self._report(
+            {
+                "experiment": experiment,
+                "namespace": namespace,
+                "trial": trial,
+                "assignments": assignments,
+                "value": value,
+                "phase": phase,
+            },
+            timeout=timeout,
+        )
+
+    def get_observations(
+        self, experiment: str, namespace: str = "default", timeout: float = 10.0
+    ) -> list[dict]:
+        return self._get(
+            {"experiment": experiment, "namespace": namespace}, timeout=timeout
+        )["observations"]
+
+    def close(self) -> None:
+        self._channel.close()

@@ -60,6 +60,14 @@ class LlamaConfig:
     #: axis for sequence parallelism (SURVEY §5).
     attention_impl: str = "dense"
     tie_embeddings: bool = False
+    #: Mixture-of-Experts MLP (models/moe.py): 0 = dense MLP; >0 = number
+    #: of experts with top-k routing and expert-axis dispatch (SURVEY §2.5
+    #: EP row).  With normalize_topk and identical expert weights the MoE
+    #: layer equals the dense MLP exactly (tested).
+    moe_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_normalize_topk: bool = True
 
     @property
     def q_per_kv(self) -> int:
@@ -313,7 +321,12 @@ class Block(nn.Module):
         h = RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="attn_norm")(x)
         x = x + Attention(cfg, self.decode, name="attn")(h, positions)
         h = RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="mlp_norm")(x)
-        x = x + Mlp(cfg, name="mlp")(h)
+        if cfg.moe_experts > 0:
+            from .moe import MoeMlp
+
+            x = x + MoeMlp(cfg, name="mlp")(h)
+        else:
+            x = x + Mlp(cfg, name="mlp")(h)
         x = nn.with_logical_constraint(x, ("batch", "act_seq", "act_embed"))
         return x
 

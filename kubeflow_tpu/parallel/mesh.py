@@ -44,8 +44,11 @@ AXIS_ORDER = ("replica", "data", "fsdp", "pipeline", "expert", "seq", "model")
 DCN_TOLERANT_AXES = ("replica", "pipeline", "data")
 
 #: Axes that shard the batch dimension (their product is the data-parallel
-#: degree for input pipelines and loss scaling).
-BATCH_AXES = ("replica", "data", "fsdp")
+#: degree for input pipelines and loss scaling).  ``expert`` doubles as a
+#: data axis outside MoE layers (the GShard convention: EP groups share
+#: DP), which is what makes the MoE dispatch a true all-to-all instead of
+#: a batch replication.
+BATCH_AXES = ("replica", "data", "fsdp", "expert")
 
 
 class MeshPlanError(ValueError):

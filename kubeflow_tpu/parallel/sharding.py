@@ -27,7 +27,11 @@ from .mesh import BATCH_AXES, active_mesh
 #: lookup time, which is what makes one table serve every parallelism mix.
 LOGICAL_RULES: tuple[tuple[str, Any], ...] = (
     # -- activations ----------------------------------------------------
-    ("batch", ("replica", "data", "fsdp")),  # batch dim of activations
+    # expert doubles as a data axis outside MoE layers (GShard convention)
+    ("batch", ("replica", "data", "fsdp", "expert")),
+    # batch dim INSIDE expert groups (the expert axis is spent on the
+    # expert dim there, so it must not reappear on batch)
+    ("expert_batch", ("replica", "data", "fsdp")),
     ("act_seq", "seq"),                      # sequence dim under SP/CP
     ("act_embed", None),                     # residual stream feature dim
     ("act_heads", "model"),                  # per-head activations under TP
@@ -43,7 +47,8 @@ LOGICAL_RULES: tuple[tuple[str, Any], ...] = (
     ("mlp", "model"),                        # ffn hidden dim under TP
     ("layers", "pipeline"),                  # scanned layer stack
     ("norm", None),
-    ("expert", "expert"),                    # MoE expert dim
+    ("expert", "expert"),                    # MoE expert dim (params + groups)
+    ("expert_dim", None),                    # router logits output dim
 )
 
 

@@ -1,0 +1,61 @@
+"""Gang-startup latency p50 — the second headline BASELINE metric.
+
+Launches N JaxJobs on a LocalPlatform, collects each job's
+``status.gang_startup_seconds`` (apply -> every rank past its first global
+collective, measured by the controller from per-pod barrier stamps), and
+prints the percentile summary as one JSON line.
+
+Usage: JAX_PLATFORMS=cpu python scripts/gang_startup_bench.py [N] [workers]
+Record the p50 in BASELINE.md next to the throughput number.
+"""
+
+from __future__ import annotations
+
+import json
+import statistics
+import sys
+import tempfile
+
+sys.path.insert(0, ".")
+
+
+def main() -> None:
+    n_jobs = int(sys.argv[1]) if len(sys.argv) > 1 else 10
+    workers = int(sys.argv[2]) if len(sys.argv) > 2 else 2
+
+    from kubeflow_tpu.runtime.platform import LocalPlatform
+    from kubeflow_tpu.sdk.client import TrainingClient
+
+    samples: list[float] = []
+    with LocalPlatform(
+        num_hosts=max(workers, 2), chips_per_host=4,
+        root_dir=tempfile.mkdtemp(prefix="gangbench-"),
+    ) as platform:
+        client = TrainingClient(platform)
+        for i in range(n_jobs):
+            job = client.train(
+                name=f"gang-{i}",
+                entrypoint="kubeflow_tpu.models.mnist:train_main",
+                num_workers=workers,
+                env={"KFT_STEPS": "1", "KFT_BATCH": "8"},
+                timeout=180,
+            )
+            gs = job.status.gang_startup_seconds
+            assert gs is not None and gs > 0, job.status
+            samples.append(gs)
+            print(f"# job {i}: gang_startup={gs:.3f}s", file=sys.stderr)
+            client.delete_job(f"gang-{i}")
+
+    samples.sort()
+    print(json.dumps({
+        "metric": "gang_startup_p50_seconds",
+        "value": round(statistics.median(samples), 3),
+        "unit": f"s (n={n_jobs}, workers={workers}, local CPU runtime)",
+        "p90": round(samples[int(0.9 * (len(samples) - 1))], 3),
+        "min": round(samples[0], 3),
+        "max": round(samples[-1], 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -324,6 +324,205 @@ class TestControllersEnvtestStyle:
                 stop.set()
                 kubelet.stop()
 
+    def test_observations_survive_control_plane_restart(self, tmp_path):
+        """katib-db-manager capability (SURVEY §2.3): kill the control plane
+        mid-experiment; a new control plane on the same observation db
+        replays completed trials — the experiment finishes with full
+        history and does not re-run finished work."""
+        from kubeflow_tpu.controlplane.cluster import Cluster
+        from kubeflow_tpu.controlplane.fake_kubelet import FakeKubelet
+        from kubeflow_tpu.controlplane.objects import KIND_POD, Pod
+        from kubeflow_tpu.hpo.db import ObservationDb
+
+        db_path = str(tmp_path / "observations.sqlite")
+
+        def make(cluster):
+            kubelet = FakeKubelet(cluster.store)
+            stop = threading.Event()
+
+            def metric_writer():
+                while not stop.is_set():
+                    for pod in cluster.store.list(KIND_POD):
+                        assert isinstance(pod, Pod)
+                        lr = pod.spec.container.env.get("KFT_LR")
+                        if lr is None:
+                            continue
+                        d = tmp_path / "status" / pod.metadata.namespace / pod.metadata.name
+                        d.mkdir(parents=True, exist_ok=True)
+                        score = 1.0 - (float(lr) - 0.03) ** 2 * 100.0
+                        (d / "metrics.jsonl").write_text(
+                            json.dumps({"name": "score", "value": score}) + "\n")
+                    stop.wait(0.01)
+
+            writer = threading.Thread(target=metric_writer, daemon=True)
+            return kubelet, stop, writer
+
+        # -- incarnation 1: run partway, then kill the control plane ------
+        c1 = Cluster()
+        c1.add_tpu_slice("slice-0", 2, 4)
+        c1.enable_hpo(metrics_root=str(tmp_path), db_path=db_path)
+        kubelet1, stop1, writer1 = make(c1)
+        c1.start()
+        kubelet1.start()
+        writer1.start()
+        try:
+            c1.store.create(_experiment("durable", max_trials=6, parallel=2))
+            deadline = time.time() + 60
+            done_before_kill = 0
+            while time.time() < deadline:
+                exp = c1.store.try_get("Experiment", "durable")
+                if exp is not None and exp.status.trials_succeeded >= 2:
+                    done_before_kill = exp.status.trials_succeeded
+                    break
+                time.sleep(0.02)
+            assert done_before_kill >= 2
+        finally:
+            stop1.set()
+            kubelet1.stop()
+            c1.stop()
+
+        recorded = len(ObservationDb(db_path).observations("durable"))
+        assert recorded >= 2
+
+        # -- incarnation 2: fresh store, same db -------------------------
+        c2 = Cluster()
+        c2.add_tpu_slice("slice-0", 2, 4)
+        c2.enable_hpo(metrics_root=str(tmp_path), db_path=db_path)
+        kubelet2, stop2, writer2 = make(c2)
+        with c2:
+            kubelet2.start()
+            writer2.start()
+            try:
+                c2.store.create(_experiment("durable", max_trials=6, parallel=2))
+                deadline = time.time() + 60
+                exp = None
+                while time.time() < deadline:
+                    exp = c2.store.try_get("Experiment", "durable")
+                    if exp is not None and exp.status.completed:
+                        break
+                    time.sleep(0.05)
+                assert exp is not None and exp.status.completed, (
+                    exp.status if exp else None)
+                # full history: replayed + freshly-run == max_trial_count
+                assert exp.status.trials_succeeded == 6
+                assert exp.status.replayed
+                from kubeflow_tpu.controlplane import events_for
+
+                reasons = [e.reason for e in events_for(c2.store, "Experiment", "durable")]
+                assert "ObservationsReplayed" in reasons
+                # replayed trials were NOT re-run: fewer jobs than trials
+                jobs = [
+                    j for j in c2.store.list("JaxJob")
+                    if j.metadata.name.startswith("durable-")
+                ]
+                assert len(jobs) <= 6 - recorded
+                assert len(ObservationDb(db_path).observations("durable")) == 6
+            finally:
+                stop2.set()
+                kubelet2.stop()
+
+
+class TestAshaEarlyStopping:
+    def test_unit_rungs_and_promotion(self):
+        from kubeflow_tpu.hpo.early_stopping import Asha
+
+        asha = Asha(min_resource=10, reduction_factor=3)
+        assert asha.rung_for(9) is None
+        assert asha.rung_for(10) == 0
+        assert asha.rung_for(29) == 0
+        assert asha.rung_for(30) == 1
+        assert asha.milestone(1) == 30
+        # maximize: bottom of 3 recorded values at a rung is cut
+        assert asha.should_stop(ObjectiveType.MAXIMIZE, 0, 0.1, [0.9, 0.8])
+        assert not asha.should_stop(ObjectiveType.MAXIMIZE, 0, 0.95, [0.9, 0.8])
+        # fewer than reduction_factor records: always promote
+        assert not asha.should_stop(ObjectiveType.MAXIMIZE, 0, 0.1, [0.9])
+
+    def test_asha_saves_steps_at_equal_best_objective(self, tmp_path):
+        """Closed loop vs no early stopping on the same grid: same optimum,
+        strictly fewer total training steps spent."""
+        from kubeflow_tpu.api.experiment import EarlyStoppingSpec
+        from kubeflow_tpu.controlplane.cluster import Cluster
+        from kubeflow_tpu.controlplane.fake_kubelet import FakeKubelet, PodScript
+        from kubeflow_tpu.controlplane.objects import KIND_POD, Pod
+
+        layers_param = ParameterSpec(
+            name="layers",
+            parameter_type=ParameterType.INT,
+            feasible_space=FeasibleSpace(min=1, max=6),
+        )
+
+        def quality(layers: int) -> float:
+            return 1.0 - abs(layers - 2) * 0.2
+
+        def run(name: str, early_stopping) -> tuple[float, int, object]:
+            cluster = Cluster()
+            cluster.add_tpu_slice("slice-0", 2, 4)
+            root = tmp_path / name
+            cluster.enable_hpo(metrics_root=str(root))
+            kubelet = FakeKubelet(
+                cluster.store, script=lambda pod: PodScript(run_seconds=1.5))
+            stop = threading.Event()
+            steps_written: dict[str, int] = {}
+
+            def metric_writer():
+                while not stop.is_set():
+                    for pod in cluster.store.list(KIND_POD):
+                        assert isinstance(pod, Pod)
+                        layers = pod.spec.container.env.get("KFT_LAYERS")
+                        if layers is None:
+                            continue
+                        step = steps_written.get(pod.metadata.name, 0) + 1
+                        steps_written[pod.metadata.name] = step
+                        # value ramps to its asymptote by step 10, so rung
+                        # observations at step>=10 equal the final quality
+                        val = quality(int(layers)) * min(1.0, step / 10.0)
+                        d = root / "status" / pod.metadata.namespace / pod.metadata.name
+                        d.mkdir(parents=True, exist_ok=True)
+                        with open(d / "metrics.jsonl", "a") as f:
+                            f.write(json.dumps(
+                                {"name": "score", "value": val, "step": step}) + "\n")
+                    stop.wait(0.02)
+
+            exp = _experiment(name, max_trials=6, parallel=3, algorithm="grid")
+            exp.spec.parameters = [layers_param]
+            exp.spec.trial_template.job_manifest["spec"]["replica_specs"]["worker"][
+                "template"]["env"] = {"KFT_LAYERS": "${trialParameters.layers}"}
+            exp.spec.early_stopping = early_stopping
+
+            writer = threading.Thread(target=metric_writer, daemon=True)
+            with cluster:
+                kubelet.start()
+                writer.start()
+                try:
+                    cluster.store.create(exp)
+                    deadline = time.time() + 60
+                    out = None
+                    while time.time() < deadline:
+                        out = cluster.store.try_get("Experiment", name)
+                        if out is not None and out.status.completed:
+                            break
+                        time.sleep(0.05)
+                    assert out is not None and out.status.completed, (
+                        out.status if out else None)
+                finally:
+                    stop.set()
+                    kubelet.stop()
+            return out.status.current_optimal_value, sum(steps_written.values()), out
+
+        es = EarlyStoppingSpec(
+            algorithm_name="asha",
+            settings={"min_resource": "10", "reduction_factor": "3"},
+        )
+        best_asha, steps_asha, exp_asha = run("asha", es)
+        best_plain, steps_plain, _ = run("plain", None)
+
+        assert exp_asha.status.trials_early_stopped >= 1
+        # equal best objective: the grid's best cell (layers=2) completes
+        assert best_asha == pytest.approx(best_plain, abs=1e-6) == pytest.approx(1.0)
+        # and it cost strictly fewer total steps
+        assert steps_asha < steps_plain
+
 
 @pytest.mark.e2e
 def test_hpo_e2e_real_processes():

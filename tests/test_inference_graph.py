@@ -1,0 +1,193 @@
+"""InferenceGraph: sequence/switch DAG routing over InferenceServices
+(SURVEY §2.2 InferenceGraph row — r1 verdict missing #7)."""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kubeflow_tpu.api.common import ObjectMeta
+from kubeflow_tpu.api.inference import (
+    ComponentSpec,
+    GraphNode,
+    GraphStep,
+    InferenceGraph,
+    InferenceGraphSpec,
+    InferenceService,
+    InferenceServicePhase,
+    InferenceServiceSpec,
+    ModelFormat,
+    ServingRuntime,
+    ServingRuntimeSpec,
+    SupportedModelFormat,
+)
+from kubeflow_tpu.serving.graph import eval_condition
+from kubeflow_tpu.serving.model import Model
+
+
+class AddOneModel(Model):
+    def predict_batch(self, instances):
+        return [x + 1 for x in instances]
+
+
+class DoubleModel(Model):
+    def predict_batch(self, instances):
+        return [x * 2 for x in instances]
+
+
+def _post(url, payload):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=15) as resp:
+            return resp.status, json.load(resp)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}")
+
+
+def _isvc(name, fmt):
+    return InferenceService(
+        metadata=ObjectMeta(name=name),
+        spec=InferenceServiceSpec(
+            predictor=ComponentSpec(model_format=ModelFormat(name=fmt))),
+    )
+
+
+@pytest.fixture()
+def graph_cluster():
+    from kubeflow_tpu.controlplane.cluster import Cluster
+
+    cluster = Cluster()
+    cluster.add_tpu_slice("slice-0", 1, 4)
+    cluster.enable_serving()
+    for fmt, cls in (("addone", "AddOneModel"), ("double", "DoubleModel")):
+        cluster.store.create(
+            ServingRuntime(
+                metadata=ObjectMeta(name=f"rt-{fmt}"),
+                spec=ServingRuntimeSpec(
+                    supported_model_formats=[SupportedModelFormat(name=fmt)],
+                    server_class=f"tests.test_inference_graph:{cls}",
+                ),
+            )
+        )
+    with cluster:
+        cluster.store.create(_isvc("inc", "addone"))
+        cluster.store.create(_isvc("dbl", "double"))
+        yield cluster
+
+
+def _wait_phase(cluster, kind, name, phase=InferenceServicePhase.READY, timeout=30):
+    deadline = time.time() + timeout
+    obj = None
+    while time.time() < deadline:
+        obj = cluster.store.try_get(kind, name)
+        if obj is not None and obj.status.phase == phase:
+            return obj
+        time.sleep(0.05)
+    raise AssertionError(f"{kind} {name} never {phase}: {obj.status if obj else None}")
+
+
+class TestConditions:
+    def test_eval_condition_forms(self):
+        assert eval_condition("model == a", {"model": "a"})
+        assert not eval_condition("model == a", {"model": "b"})
+        assert eval_condition("x > 3", {"x": 5})
+        assert eval_condition("x != 3", {"x": 5})
+        assert not eval_condition("missing == 1", {})
+
+
+class TestGraphE2E:
+    def test_sequence_chains_two_services(self, graph_cluster):
+        """Two-stage transformer->predictor graph through the router:
+        (x + 1) * 2."""
+        graph_cluster.store.create(
+            InferenceGraph(
+                metadata=ObjectMeta(name="chain"),
+                spec=InferenceGraphSpec(nodes={
+                    "root": GraphNode(router_type="Sequence", steps=[
+                        GraphStep(service_name="inc"),
+                        GraphStep(service_name="dbl"),
+                    ]),
+                }),
+            )
+        )
+        g = _wait_phase(graph_cluster, "InferenceGraph", "chain")
+        code, out = _post(g.status.url, {"instances": [1, 2, 3]})
+        assert code == 200 and out["predictions"] == [4, 6, 8]
+
+    def test_switch_routes_by_condition(self, graph_cluster):
+        graph_cluster.store.create(
+            InferenceGraph(
+                metadata=ObjectMeta(name="switch"),
+                spec=InferenceGraphSpec(nodes={
+                    "root": GraphNode(router_type="Switch", steps=[
+                        GraphStep(service_name="inc", condition="op == inc"),
+                        GraphStep(service_name="dbl", condition="op == dbl"),
+                    ]),
+                }),
+            )
+        )
+        g = _wait_phase(graph_cluster, "InferenceGraph", "switch")
+        code, out = _post(g.status.url, {"op": "inc", "instances": [10]})
+        assert code == 200 and out["predictions"] == [11]
+        code, out = _post(g.status.url, {"op": "dbl", "instances": [10]})
+        assert code == 200 and out["predictions"] == [20]
+        code, out = _post(g.status.url, {"op": "nope", "instances": [10]})
+        assert code == 404
+
+    def test_nested_node_and_request_data(self, graph_cluster):
+        """A sequence step can target another node; $request resets input."""
+        graph_cluster.store.create(
+            InferenceGraph(
+                metadata=ObjectMeta(name="nested"),
+                spec=InferenceGraphSpec(nodes={
+                    "root": GraphNode(router_type="Sequence", steps=[
+                        GraphStep(node_name="double-twice"),
+                        # ignores the previous output, re-feeds the original
+                        GraphStep(service_name="inc", data="$request"),
+                    ]),
+                    "double-twice": GraphNode(router_type="Sequence", steps=[
+                        GraphStep(service_name="dbl"),
+                        GraphStep(service_name="dbl"),
+                    ]),
+                }),
+            )
+        )
+        g = _wait_phase(graph_cluster, "InferenceGraph", "nested")
+        code, out = _post(g.status.url, {"instances": [5]})
+        # root: double-twice(5)=20 discarded; inc($request 5) = 6
+        assert code == 200 and out["predictions"] == [6]
+
+    def test_missing_root_fails(self, graph_cluster):
+        graph_cluster.store.create(
+            InferenceGraph(
+                metadata=ObjectMeta(name="broken"),
+                spec=InferenceGraphSpec(nodes={
+                    "notroot": GraphNode(steps=[GraphStep(service_name="inc")]),
+                }),
+            )
+        )
+        g = _wait_phase(
+            graph_cluster, "InferenceGraph", "broken",
+            phase=InferenceServicePhase.FAILED)
+        assert "root" in g.status.message
+
+    def test_waits_for_missing_service(self, graph_cluster):
+        graph_cluster.store.create(
+            InferenceGraph(
+                metadata=ObjectMeta(name="waiting"),
+                spec=InferenceGraphSpec(nodes={
+                    "root": GraphNode(steps=[GraphStep(service_name="ghost")]),
+                }),
+            )
+        )
+        g = _wait_phase(
+            graph_cluster, "InferenceGraph", "waiting",
+            phase=InferenceServicePhase.LOADING)
+        assert "ghost" in g.status.message
+        # request through the router while not ready -> 503
+        code, out = _post(g.status.url, {"instances": [1]})
+        assert code == 503

@@ -1,0 +1,141 @@
+"""MoE + expert parallelism (SURVEY §2.5 EP row).
+
+Pins down: exact dense equivalence (identical experts, renormalized top-k),
+capacity-based token dropping, sharded-vs-single-device numerical parity on
+a mesh with an ``expert`` axis, and the presence of all-to-all collectives
+in the compiled expert-parallel HLO.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubeflow_tpu.models import llama as llamalib
+from kubeflow_tpu.models.moe import MoeMlp
+from kubeflow_tpu.models.llama import Mlp
+from kubeflow_tpu.parallel import mesh as meshlib
+from kubeflow_tpu.parallel import sharding as shardlib
+from flax import linen as nn
+
+
+def _cfg(**kw):
+    base = dict(moe_experts=4, moe_top_k=2, moe_capacity_factor=2.0)
+    base.update(kw)
+    return llamalib.tiny(**base)
+
+
+def _tie_experts(moe_params, mlp_params):
+    """Give every expert the dense MLP's weights (for equivalence tests)."""
+    e = moe_params["w_gate"].shape[0]
+    out = dict(moe_params)
+    for name, src in (("w_gate", "w_gate"), ("w_up", "w_up"), ("w_down", "w_down")):
+        w = mlp_params[src]["kernel"]
+        if name == "w_down":
+            out[name] = jnp.broadcast_to(w[None], (e, *w.shape))
+        else:
+            out[name] = jnp.broadcast_to(w[None], (e, *w.shape))
+    return out
+
+
+class TestDenseEquivalence:
+    def test_identical_experts_match_dense_mlp(self):
+        """top-k renormalized + identical experts + ample capacity == Mlp."""
+        cfg = _cfg()
+        x = jax.random.normal(jax.random.PRNGKey(0), (2, 16, cfg.hidden_size),
+                              jnp.float32)
+        mlp = Mlp(cfg)
+        mlp_params = nn.meta.unbox(mlp.init(jax.random.PRNGKey(1), x)["params"])
+        moe = MoeMlp(cfg)
+        moe_params = nn.meta.unbox(moe.init(jax.random.PRNGKey(2), x)["params"])
+        tied = _tie_experts(moe_params, mlp_params)
+        ref = mlp.apply({"params": mlp_params}, x)
+        out = moe.apply({"params": tied}, x)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=2e-5)
+
+    def test_top1_identical_experts_match_dense(self):
+        cfg = _cfg(moe_top_k=1)
+        x = jax.random.normal(jax.random.PRNGKey(0), (2, 8, cfg.hidden_size),
+                              jnp.float32)
+        mlp = Mlp(cfg)
+        mlp_params = nn.meta.unbox(mlp.init(jax.random.PRNGKey(1), x)["params"])
+        moe = MoeMlp(cfg)
+        moe_params = nn.meta.unbox(moe.init(jax.random.PRNGKey(2), x)["params"])
+        tied = _tie_experts(moe_params, mlp_params)
+        ref = mlp.apply({"params": mlp_params}, x)
+        out = moe.apply({"params": tied}, x)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+class TestRoutingMechanics:
+    def test_capacity_drops_tokens(self):
+        """capacity_factor ~0 forces dropping: output magnitude shrinks."""
+        x = jax.random.normal(jax.random.PRNGKey(0), (1, 32, 64), jnp.float32)
+        big = MoeMlp(_cfg(moe_capacity_factor=8.0))
+        small = MoeMlp(_cfg(moe_capacity_factor=0.01))
+        p = nn.meta.unbox(big.init(jax.random.PRNGKey(1), x)["params"])
+        out_big = big.apply({"params": p}, x)
+        out_small = small.apply({"params": p}, x)
+        # capacity 0.01 -> capacity=1 slot per expert: most tokens dropped
+        assert float(jnp.abs(out_small).mean()) < float(jnp.abs(out_big).mean())
+
+    def test_aux_loss_sown(self):
+        cfg = _cfg()
+        x = jax.random.normal(jax.random.PRNGKey(0), (2, 16, cfg.hidden_size))
+        moe = MoeMlp(cfg)
+        p = nn.meta.unbox(moe.init(jax.random.PRNGKey(1), x)["params"])
+        _, inter = moe.apply(
+            {"params": p}, x, mutable=["intermediates"])
+        (aux,) = inter["intermediates"]["moe_aux_loss"]
+        # balanced routing gives aux ~1.0; any finite positive value is sane
+        assert 0.0 < float(aux) < 16.0
+
+
+class TestExpertParallel:
+    def test_sharded_matches_single_device(self):
+        """MoE Llama forward on {expert,data,model} mesh == single device."""
+        cfg = _cfg(num_layers=2)
+        model = llamalib.Llama(cfg)
+        tokens = jnp.arange(4 * 16, dtype=jnp.int32).reshape(4, 16) % cfg.vocab_size
+        params = model.init(jax.random.PRNGKey(0), tokens)
+        ref = model.apply(params, tokens)
+
+        mesh = meshlib.build_mesh({"expert": 2, "data": 2, "model": 2})
+        with shardlib.shard_context(mesh):
+            sharded = jax.jit(model.apply)(params, tokens)
+        np.testing.assert_allclose(
+            np.asarray(sharded), np.asarray(ref), atol=3e-2, rtol=3e-2)
+
+    def test_all_to_all_in_expert_parallel_hlo(self):
+        """GSPMD lowers the batch->expert resharding to all-to-all."""
+        cfg = _cfg(num_layers=1)
+        model = llamalib.Llama(cfg)
+        tokens = jnp.ones((8, 16), jnp.int32)
+        mesh = meshlib.build_mesh({"expert": 4, "data": 2})
+        with shardlib.shard_context(mesh):
+            params = model.init(jax.random.PRNGKey(0), tokens)
+            compiled = (
+                jax.jit(model.apply)
+                .lower(params, tokens)
+                .compile()
+            )
+        hlo = compiled.as_text()
+        assert "all-to-all" in hlo, "expert dispatch did not lower to all-to-all"
+
+    def test_moe_trains_on_expert_mesh(self):
+        """One optimization step of the MoE Llama on an expert-axis mesh."""
+        from kubeflow_tpu.train import trainer as trainlib
+
+        cfg = trainlib.TrainConfig(
+            model=_cfg(num_layers=2),
+            mesh_axes={"expert": 2, "data": 2, "model": 2},
+            global_batch=8,
+            seq_len=16,
+            steps=2,
+            log_every=1,
+        )
+        t = trainlib.Trainer(cfg, devices=jax.devices())
+        m = t.train()
+        assert m is not None and m.step == 2
+        assert np.isfinite(m.loss)
