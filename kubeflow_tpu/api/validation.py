@@ -73,7 +73,7 @@ def validate_jaxjob(job: JaxJob) -> None:
         )
     if spec.run_policy.backoff_limit < 0:
         raise AdmissionError("backoff_limit must be >= 0")
-    if not (0 < spec.coordinator_port < 65536):
+    if not (0 <= spec.coordinator_port < 65536):  # 0 = controller-allocated
         raise AdmissionError(f"coordinator_port {spec.coordinator_port} out of range")
     if spec.elastic_policy and spec.elastic_policy.max_replicas < workers.replicas:
         raise AdmissionError("elastic_policy.max_replicas < worker replicas")

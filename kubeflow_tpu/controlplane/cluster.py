@@ -65,6 +65,7 @@ class Cluster:
             ("kft-echo", ["echo"], "kubeflow_tpu.serving.runtimes:EchoModel"),
             ("kft-jax", ["jax", "flax"], "kubeflow_tpu.serving.runtimes:JaxFunctionModel"),
             ("kft-llama", ["llama", "llm"], "kubeflow_tpu.serving.runtimes:LlamaGenerator"),
+            ("kft-bert", ["bert"], "kubeflow_tpu.serving.runtimes:BertClassifierModel"),
         ):
             try:
                 self.store.create(
@@ -84,6 +85,27 @@ class Cluster:
         from ..serving.graph import InferenceGraphController
 
         self.add_controller(InferenceGraphController(self.store))
+
+    def enable_platform_ux(self) -> None:
+        """Register the L7 shell tier (SURVEY.md §2.4): Profile multi-
+        tenancy (quota enforced by the gang scheduler), Notebook workbenches,
+        PodDefault injection.  The dashboard is ``serve_dashboard``."""
+        from ..controlplane.objects import KIND_POD
+        from ..ux.notebooks import NotebookController
+        from ..ux.poddefaults import pod_default_mutator
+        from ..ux.profiles import ProfileController
+
+        self.store.register_admission(KIND_POD, mutate=pod_default_mutator(self.store))
+        self.add_controller(ProfileController(self.store))
+        self.add_controller(NotebookController(self.store))
+
+    def serve_dashboard(self, port: int = 0) -> str:
+        """Start the central dashboard over this cluster's store; returns
+        its URL.  Stopped with the cluster."""
+        from ..ux.dashboard import Dashboard
+
+        self._dashboard = Dashboard(self.store, port=port or None)
+        return self._dashboard.url
 
     def enable_hpo(
         self,
@@ -157,6 +179,54 @@ class Cluster:
             for i in range(num_hosts)
         ]
 
+    def metrics_text(self) -> str:
+        """Prometheus exposition for every reconciler (the manager's
+        ``--metrics-bind-address`` surface [upstream: training-operator
+        cmd/training-operator.v1/main.go])."""
+        parts = [
+            "# TYPE kft_reconcile_total counter",
+            "# TYPE kft_reconcile_errors_total counter",
+            "# TYPE kft_reconcile_time_seconds histogram",
+            "# TYPE kft_workqueue_depth gauge",
+        ]
+        for c in self.controllers:
+            parts.append(c.metrics.prometheus(len(c.queue)).rstrip("\n"))
+        return "\n".join(parts) + "\n"
+
+    def serve_metrics(self, port: int = 0) -> str:
+        """Expose ``/metrics`` over HTTP; returns the bound URL."""
+        import threading
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        from ..utils.net import allocate_port
+
+        cluster = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                pass
+
+            def do_GET(self):
+                if self.path != "/metrics":
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                body = cluster.metrics_text().encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        port = port or allocate_port()
+        self._metrics_httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self._metrics_httpd.daemon_threads = True
+        threading.Thread(
+            target=self._metrics_httpd.serve_forever,
+            name="cluster-metrics", daemon=True,
+        ).start()
+        return f"http://127.0.0.1:{port}/metrics"
+
     def start(self) -> None:
         self.scheduler.start()
         for c in self.controllers:
@@ -167,6 +237,13 @@ class Cluster:
         for c in self.controllers:
             c.stop()
         self.scheduler.stop()
+        if getattr(self, "_metrics_httpd", None) is not None:
+            self._metrics_httpd.shutdown()
+            self._metrics_httpd.server_close()
+            self._metrics_httpd = None
+        if getattr(self, "_dashboard", None) is not None:
+            self._dashboard.stop()
+            self._dashboard = None
         if getattr(self, "_db_client", None) is not None:
             self._db_client.close()
             self._db_client = None

@@ -84,6 +84,60 @@ class Result:
     requeue_after: Optional[float] = None
 
 
+class ReconcileMetrics:
+    """Per-controller reconcile metrics, Prometheus text exposition.
+
+    The controller-runtime metrics surface [upstream: controller-runtime ->
+    pkg/internal/controller/metrics: controller_runtime_reconcile_total,
+    _errors_total, _time_seconds, workqueue depth], which the reference
+    operators export on ``--metrics-bind-address`` (SURVEY.md §5 tracing).
+    """
+
+    #: reconcile-duration histogram upper bounds (seconds)
+    BUCKETS = (0.001, 0.005, 0.025, 0.1, 0.5, 2.5, 10.0)
+
+    def __init__(self, controller: str) -> None:
+        self.controller = controller
+        self._lock = threading.Lock()
+        self.total = 0
+        self.errors = 0
+        self.duration_sum = 0.0
+        self.bucket_counts = [0] * (len(self.BUCKETS) + 1)  # +inf tail
+
+    def observe(self, seconds: float, error: bool) -> None:
+        with self._lock:
+            self.total += 1
+            if error:
+                self.errors += 1
+            self.duration_sum += seconds
+            for i, ub in enumerate(self.BUCKETS):
+                if seconds <= ub:
+                    self.bucket_counts[i] += 1
+                    break
+            else:
+                self.bucket_counts[-1] += 1
+
+    def prometheus(self, queue_depth: int) -> str:
+        lab = f'controller="{self.controller}"'
+        with self._lock:
+            lines = [
+                f"kft_reconcile_total{{{lab}}} {self.total}",
+                f"kft_reconcile_errors_total{{{lab}}} {self.errors}",
+                f"kft_reconcile_time_seconds_sum{{{lab}}} {self.duration_sum:.6f}",
+                f"kft_reconcile_time_seconds_count{{{lab}}} {self.total}",
+            ]
+            cum = 0
+            for ub, c in zip(self.BUCKETS, self.bucket_counts):
+                cum += c
+                lines.append(
+                    f'kft_reconcile_time_seconds_bucket{{{lab},le="{ub}"}} {cum}')
+            cum += self.bucket_counts[-1]
+            lines.append(
+                f'kft_reconcile_time_seconds_bucket{{{lab},le="+Inf"}} {cum}')
+        lines.append(f"kft_workqueue_depth{{{lab}}} {queue_depth}")
+        return "\n".join(lines) + "\n"
+
+
 class Controller:
     """Base reconciler.  Subclasses set ``kind``, ``owned_kinds`` and
     implement ``reconcile(namespace, name) -> Optional[Result]``."""
@@ -95,6 +149,7 @@ class Controller:
     def __init__(self, store: Store) -> None:
         self.store = store
         self.queue = WorkQueue()
+        self.metrics = ReconcileMetrics(self.kind or type(self).__name__)
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
         self._watch = None
@@ -159,14 +214,17 @@ class Controller:
             if key is None:
                 continue
             ns, name = key.split("/", 1)
+            t0 = time.perf_counter()
             try:
                 res = self.reconcile(ns, name)
             except Exception:  # noqa: BLE001
+                self.metrics.observe(time.perf_counter() - t0, error=True)
                 log.exception("reconcile %s %s failed", self.kind, key)
                 back = min(self._backoff.get(key, 0.05) * 2, 5.0)
                 self._backoff[key] = back
                 self.queue.add(key, delay=back)
                 continue
+            self.metrics.observe(time.perf_counter() - t0, error=False)
             self._backoff.pop(key, None)
             if res and res.requeue_after is not None:
                 self.queue.add(key, delay=res.requeue_after)

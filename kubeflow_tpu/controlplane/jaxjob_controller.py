@@ -58,6 +58,7 @@ from .objects import (
     ServiceSpec,
 )
 from .store import ADDED, AlreadyExists, DELETED, NotFound, Store, WatchEvent
+from ..utils.net import allocate_port
 
 #: Env var names — the runtime bootstrap contract
 #: (kubeflow_tpu.runtime.bootstrap reads exactly these).
@@ -134,6 +135,7 @@ class JaxJobController(Controller):
             self._fail(job, pods, "GangScheduleTimeout", "pod group unschedulable past timeout")
             return None
 
+        job = self._resolve_coordinator_port(job)
         self._ensure_pods_services(job, pods)
 
         # refresh pod view after creations for status aggregation
@@ -181,6 +183,32 @@ class JaxJobController(Controller):
         created = pg.metadata.creation_timestamp or time.time()
         return (time.time() - created) > sp.schedule_timeout_seconds
 
+    # -- coordinator port ------------------------------------------------------
+
+    def _resolve_coordinator_port(self, job: JaxJob) -> JaxJob:
+        """Allocate the rendezvous port at bind time, not submit time.
+
+        spec.coordinator_port == 0 means "controller's choice": the port is
+        picked here — in the one process that sees every gang on the host —
+        and persisted to status so it survives gang restarts (r1 weak #6:
+        SDK-side free_port() raced between pick and pod spawn, and parallel
+        HPO trials could collide).
+        """
+        if job.spec.coordinator_port or job.status.coordinator_port:
+            return job
+        port = allocate_port()
+        updated = self.store.update_with_retry(
+            KIND_JAXJOB,
+            job.metadata.name,
+            job.metadata.namespace,
+            lambda o: setattr(o.status, "coordinator_port", o.status.coordinator_port or port),
+        )
+        assert isinstance(updated, JaxJob)
+        return updated
+
+    def _job_port(self, job: JaxJob) -> int:
+        return job.spec.coordinator_port or job.status.coordinator_port or 0
+
     # -- ensure: pods + headless services -------------------------------------
 
     def _ensure_pods_services(self, job: JaxJob, pods: list[Pod]) -> None:
@@ -226,7 +254,7 @@ class JaxJobController(Controller):
         if rtype == WORKER:
             # only workers join the jax.distributed collective; auxiliary
             # roles (e.g. a dataset service) run outside it
-            env[ENV_COORDINATOR_ADDRESS] = f"{coord_dns}:{job.spec.coordinator_port}"
+            env[ENV_COORDINATOR_ADDRESS] = f"{coord_dns}:{self._job_port(job)}"
             env[ENV_NUM_PROCESSES] = str(n_workers)
             env[ENV_PROCESS_ID] = str(idx)
         container.env = {**env, **container.env}
@@ -260,7 +288,7 @@ class JaxJobController(Controller):
                     ),
                     spec=ServiceSpec(
                         selector=dict(pod.metadata.labels),
-                        ports=[job.spec.coordinator_port],
+                        ports=[self._job_port(job)],
                     ),
                 )
             )

@@ -145,6 +145,11 @@ class Store:
                     f"{obj.kind} {obj.key}: rv {obj.metadata.resource_version} "
                     f"!= {cur.metadata.resource_version}"
                 )
+            if obj == cur:
+                # no-op write: like the real apiserver, don't bump the rv or
+                # fire MODIFIED — otherwise every reconcile's unchanged
+                # status write would requeue its own key in a hot loop
+                return copy.deepcopy(cur)
             obj.metadata.resource_version = next(self._rv)
             self._objs[k] = obj
             self._notify(WatchEvent(MODIFIED, copy.deepcopy(obj)))

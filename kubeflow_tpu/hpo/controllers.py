@@ -476,7 +476,10 @@ class TrialController(Controller):
             self.store.try_delete(KIND_JAXJOB, name, namespace)
             return None
         assert isinstance(trial, Trial)
-        if trial.status.phase in ("Succeeded", "Failed"):
+        # EarlyStopped is terminal too: its reconcile fires once more when
+        # ASHA deletes the owned job, and recreating the job here would
+        # resurrect the trial and overwrite the phase with Succeeded
+        if trial.status.phase in ("Succeeded", "Failed", "EarlyStopped"):
             return None
 
         job = self.store.try_get(KIND_JAXJOB, name, namespace)

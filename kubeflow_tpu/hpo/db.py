@@ -27,7 +27,7 @@ from typing import Optional
 
 import grpc
 
-from ..utils.net import free_port
+from ..utils.net import allocate_port
 
 SERVICE = "kubeflow_tpu.hpo.DbManager"
 METHOD_REPORT = f"/{SERVICE}/ReportObservation"
@@ -153,7 +153,7 @@ class DbManagerServer:
 
     def __init__(self, db_path: str, port: Optional[int] = None):
         self.db = ObservationDb(db_path)
-        self.port = port or free_port()
+        self.port = port or allocate_port()
         self._server = grpc.server(futures.ThreadPoolExecutor(max_workers=2))
         self._server.add_generic_rpc_handlers((_Handler(self.db),))
         self._server.add_insecure_port(f"127.0.0.1:{self.port}")

@@ -18,7 +18,7 @@ from typing import Optional
 import grpc
 
 from ..api.experiment import ObjectiveType, ParameterSpec
-from ..utils.net import free_port
+from ..utils.net import allocate_port
 from . import algorithms
 
 SERVICE = "kubeflow_tpu.hpo.Suggestion"
@@ -69,7 +69,7 @@ class SuggestionServer:
     """One algorithm service instance (the Katib suggestion Deployment analog)."""
 
     def __init__(self, port: Optional[int] = None, max_workers: int = 2):
-        self.port = port or free_port()
+        self.port = port or allocate_port()
         self._server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
         self._server.add_generic_rpc_handlers((_Handler(),))
         self._server.add_insecure_port(f"127.0.0.1:{self.port}")

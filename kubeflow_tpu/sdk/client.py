@@ -27,7 +27,6 @@ from ..api import (
 from ..api.common import JobConditionType, has_condition, replica_pod_name
 from ..api.jaxjob import KIND_JAXJOB, WORKER
 from ..runtime.platform import LocalPlatform
-from ..utils.net import free_port
 
 
 class JobTimeoutError(TimeoutError):
@@ -136,7 +135,8 @@ class TrainingClient:
         job = JaxJob(
             metadata=ObjectMeta(name=name, namespace=namespace),
             spec={
-                "coordinator_port": free_port(),
+                # coordinator_port defaults to 0 = allocated by the
+                # controller at gang-bind time (r1 weak #6)
                 "run_policy": RunPolicy(backoff_limit=backoff_limit),
                 **({"mesh": mesh} if mesh else {}),
                 "replica_specs": {

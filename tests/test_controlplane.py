@@ -5,7 +5,9 @@ The envtest-tier analog (SURVEY.md §4b): real store + real reconcilers +
 scripted kubelet, no real processes.
 """
 
+import re
 import time
+import urllib.request
 
 import pytest
 
@@ -57,9 +59,21 @@ class TestStore:
         s = Store()
         job = s.create(make_job())
         stale = s.get(KIND_JAXJOB, "job")
-        s.update(job)  # bump rv
+        job.status.restart_count = 1  # real change: bumps rv
+        s.update(job)
+        stale.status.restart_count = 2
         with pytest.raises(Conflict):
             s.update(stale)
+
+    def test_noop_update_does_not_bump_rv(self):
+        """apiserver parity: an unchanged write is suppressed, so reconcile
+        loops that rewrite identical status don't self-requeue forever."""
+        s = Store()
+        job = s.create(make_job())
+        w = s.watch([KIND_JAXJOB])
+        out = s.update(job)
+        assert out.metadata.resource_version == job.metadata.resource_version
+        assert w.q.qsize() == 0
 
     def test_watch_sees_lifecycle(self):
         s = Store()
@@ -181,7 +195,15 @@ class TestJaxJobLifecycle:
                 )
                 envs = {p.metadata.name: p.spec.container.env for p in pods}
                 e0 = envs["envs-worker-0"]
-                assert e0["JAX_COORDINATOR_ADDRESS"] == "envs-worker-0.default.svc:1234"
+                # default coordinator_port=0 -> controller allocates at gang
+                # bind time and records the choice in status (r1 weak #6)
+                job = c.store.get(KIND_JAXJOB, "envs")
+                port = job.status.coordinator_port
+                assert port and 0 < port < 65536
+                assert (
+                    e0["JAX_COORDINATOR_ADDRESS"]
+                    == f"envs-worker-0.default.svc:{port}"
+                )
                 assert e0["JAX_NUM_PROCESSES"] == "2"
                 assert e0["JAX_PROCESS_ID"] == "0"
                 assert envs["envs-worker-1"]["JAX_PROCESS_ID"] == "1"
@@ -367,5 +389,42 @@ class TestJaxJobLifecycle:
                     and c.store.try_get(KIND_PODGROUP, "gone") is None,
                     desc="owned objects gc'd",
                 )
+            finally:
+                kubelet.stop()
+
+
+class TestReconcileMetrics:
+    def test_metrics_exposed_after_reconciles(self):
+        """SURVEY §5 tracing row: reconcile durations + queue depth are
+        exported Prometheus-style per controller."""
+        c = Cluster()
+        c.add_tpu_slice("s0", num_hosts=2, chips_per_host=4)
+        kubelet = FakeKubelet(c.store, lambda pod: PodScript(run_seconds=0.05))
+        with c:
+            kubelet.start()
+            try:
+                c.store.create(make_job(name="metered", replicas=2))
+                job = c.store.try_get(KIND_JAXJOB, "metered")
+                deadline = time.time() + 10
+                while time.time() < deadline:
+                    job = c.store.try_get(KIND_JAXJOB, "metered")
+                    if job and has_condition(
+                        job.status.conditions, JobConditionType.SUCCEEDED
+                    ):
+                        break
+                    time.sleep(0.05)
+                text = c.metrics_text()
+                assert 'kft_reconcile_total{controller="JaxJob"}' in text
+                total = int(re.search(
+                    r'kft_reconcile_total\{controller="JaxJob"\} (\d+)', text
+                ).group(1))
+                assert total >= 3  # created -> running -> succeeded at least
+                assert 'kft_reconcile_time_seconds_bucket{controller="JaxJob",le="+Inf"}' in text
+                assert 'kft_workqueue_depth{controller="JaxJob"}' in text
+                # HTTP surface
+                url = c.serve_metrics()
+                with urllib.request.urlopen(url, timeout=5) as resp:
+                    assert resp.status == 200
+                    assert b"kft_reconcile_total" in resp.read()
             finally:
                 kubelet.stop()
