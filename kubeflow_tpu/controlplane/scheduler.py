@@ -42,6 +42,19 @@ def _fits(need: dict[str, float], free: dict[str, float]) -> bool:
     return all(need.get(k, 0.0) <= free.get(k, 0.0) + 1e-9 for k in RESOURCE_KEYS)
 
 
+def _quota_fits(need: dict[str, float], quota: dict[str, float]) -> bool:
+    """A quota constrains only the resources it names (upstream
+    ResourceQuota semantics)."""
+    return all(need.get(k, 0.0) <= v + 1e-9 for k, v in quota.items())
+
+
+def _quota_sub(quota: dict[str, float], need: dict[str, float]) -> None:
+    """Subtract usage from the resources the quota names — ONLY those, or
+    unnamed resources would accumulate negative phantom limits."""
+    for k in list(quota):
+        quota[k] -= need.get(k, 0.0)
+
+
 def _sub(free: dict[str, float], need: dict[str, float]) -> None:
     for k in RESOURCE_KEYS:
         free[k] = free.get(k, 0.0) - need.get(k, 0.0)
@@ -95,6 +108,27 @@ class GangScheduler:
             slice_of[n.metadata.name] = n.spec.slice_id
         return sorted(nodes, key=lambda name: (slice_of.get(name, ""), name))
 
+    def _quota_left(self) -> dict[str, dict[str, float]]:
+        """Tenant namespace -> remaining Profile quota (SURVEY §2.4: the
+        ResourceQuota capability, enforced here so gangs stay atomic)."""
+        from ..api.platform import KIND_PROFILE, Profile
+
+        left: dict[str, dict[str, float]] = {}
+        for prof in self.store.list(KIND_PROFILE):
+            if isinstance(prof, Profile) and prof.spec.resource_quota:
+                left[prof.metadata.name] = dict(prof.spec.resource_quota)
+        if not left:
+            return left
+        for pod in self.store.list(KIND_POD):
+            assert isinstance(pod, Pod)
+            if (
+                pod.spec.node_name
+                and not pod.terminal
+                and pod.metadata.namespace in left
+            ):
+                _quota_sub(left[pod.metadata.namespace], pod_resources(pod))
+        return left
+
     def _bind(self, pod: Pod, node_name: str) -> None:
         def mut(o):
             assert isinstance(o, Pod)
@@ -106,6 +140,7 @@ class GangScheduler:
         """Returns the number of pods bound this pass."""
         free = self._free_by_node()
         order = self._node_order(free)
+        quota = self._quota_left()
         bound = 0
 
         all_pods = [p for p in self.store.list(KIND_POD) if isinstance(p, Pod)]
@@ -140,6 +175,16 @@ class GangScheduler:
             assert isinstance(pg, PodGroup)
             if live_members.get(group_key, 0) < pg.spec.min_member:
                 continue  # gang not fully materialized yet
+            if ns in quota:
+                need_total: dict[str, float] = {}
+                for p in pods:
+                    for k, v in pod_resources(p).items():
+                        need_total[k] = need_total.get(k, 0.0) + v
+                if not _quota_fits(need_total, quota[ns]):
+                    self._set_group_phase(
+                        pg, PodGroupPhase.PENDING,
+                        f"profile quota exceeded in namespace {ns}")
+                    continue
             placement = self._plan_gang(pods, free, order)
             if placement is None:
                 self._set_group_phase(pg, PodGroupPhase.PENDING, "insufficient capacity")
@@ -147,16 +192,23 @@ class GangScheduler:
             for pod, node_name in placement:
                 self._bind(pod, node_name)
                 _sub(free[node_name], pod_resources(pod))
+                if ns in quota:
+                    _quota_sub(quota[ns], pod_resources(pod))
                 bound += 1
             self._set_group_phase(pg, PodGroupPhase.RUNNING, "gang admitted")
 
         # --- singles ------------------------------------------------------------
         for pod in singles:
             need = pod_resources(pod)
+            ns = pod.metadata.namespace
+            if ns in quota and not _quota_fits(need, quota[ns]):
+                continue  # over profile quota: stays Pending
             for node_name in order:
                 if _fits(need, free[node_name]):
                     self._bind(pod, node_name)
                     _sub(free[node_name], need)
+                    if ns in quota:
+                        _quota_sub(quota[ns], need)
                     bound += 1
                     break
         return bound

@@ -38,7 +38,7 @@ from ..api.inference import (
 )
 from ..controlplane.controller import Controller, Result
 from ..controlplane.store import NotFound, Store
-from ..utils.net import free_port
+from ..utils.net import allocate_port
 from .model import Model
 from .server import ModelServer
 from .storage import download
@@ -57,8 +57,9 @@ class Router:
     """Stable URL in front of N replica servers: round-robin + activator."""
 
     def __init__(self, activate: Callable[[], None], port: Optional[int] = None):
-        self.port = port or free_port()
+        self.port = port or allocate_port()
         self._backends: list[str] = []
+        self._explain_backends: list[str] = []  # ``:explain`` verb tier
         self._rr = 0
         self._lock = threading.Lock()
         self._activate = activate
@@ -71,13 +72,14 @@ class Router:
 
             def _proxy(self) -> None:
                 router.last_request_time = time.time()
-                backend = router._pick()
+                explain = self.path.endswith(":explain")
+                backend = router._pick(explain)
                 if backend is None:
                     router._activate()
                     deadline = time.time() + ACTIVATION_TIMEOUT
                     while backend is None and time.time() < deadline:
                         time.sleep(0.05)
-                        backend = router._pick()
+                        backend = router._pick(explain)
                 if backend is None:
                     self._respond(503, b'{"error": "no ready replicas"}')
                     return
@@ -121,12 +123,19 @@ class Router:
         with self._lock:
             self._backends = list(urls)
 
-    def _pick(self) -> Optional[str]:
+    def set_explain_backends(self, urls: list[str]) -> None:
+        """Backends for the ``:explain`` verb (KServe routes the verb to the
+        explainer component, everything else to transformer/predictor)."""
         with self._lock:
-            if not self._backends:
+            self._explain_backends = list(urls)
+
+    def _pick(self, explain: bool = False) -> Optional[str]:
+        with self._lock:
+            pool = self._explain_backends if explain and self._explain_backends else self._backends
+            if not pool:
                 return None
-            self._rr = (self._rr + 1) % len(self._backends)
-            return self._backends[self._rr]
+            self._rr = (self._rr + 1) % len(pool)
+            return pool[self._rr]
 
     def stop(self) -> None:
         self._httpd.shutdown()
@@ -140,6 +149,7 @@ class _Deployment:
     def __init__(self) -> None:
         self.predictors: list[ModelServer] = []
         self.transformers: list[ModelServer] = []
+        self.explainers: list[ModelServer] = []
         self.router: Optional[Router] = None
         self.wants_scale_up = False
         self.spec_fingerprint = ""
@@ -275,7 +285,33 @@ class InferenceServiceController(Controller):
         return changed
 
     def _wire(self, isvc, dep: _Deployment) -> None:
-        """Point the router at the right tier (transformer else predictor)."""
+        """Point the router at the right tier (transformer else predictor);
+        the ``:explain`` verb routes to the explainer component when one is
+        specified [upstream: kserve routes verbs per component]."""
+        espec = isvc.spec.explainer
+        if espec and espec.handler:
+            if not dep.explainers and dep.predictors:
+                cls = resolve_class(espec.handler)
+                server = ModelServer()
+                model = cls(isvc.metadata.name, {
+                    **dict(espec.config),
+                    "predictor_urls": [s.url for s in dep.predictors],
+                    "model_name": isvc.metadata.name,
+                })
+                server.register(model, batch_max_size=1, batch_timeout_ms=0.0)
+                server.start()
+                dep.explainers.append(server)
+            if dep.explainers:
+                urls = [s.url for s in dep.predictors]
+                for es in dep.explainers:
+                    for m in es.models().values():
+                        if hasattr(m, "predictor_urls"):
+                            m.predictor_urls = list(urls)
+                # with zero predictors, :explain must fall through to the
+                # activator (empty pool -> scale-from-zero) instead of
+                # reaching an explainer that has nothing to call
+                dep.router.set_explain_backends(
+                    [s.url for s in dep.explainers] if urls else [])
         tspec = isvc.spec.transformer
         if tspec and tspec.handler:
             if not dep.transformers and dep.predictors:
@@ -335,7 +371,8 @@ class InferenceServiceController(Controller):
         elif pred.handler:
             cfg = dict(pred.config)
             if pred.storage_uri:
-                cfg.setdefault("storage_path", download(pred.storage_uri))
+                cfg.setdefault("storage_path", download(
+                    pred.storage_uri, cache_dir=cfg.get("model_cache_dir")))
                 cfg.setdefault("storage_uri", pred.storage_uri)
             return resolve_class(pred.handler), cfg
         else:
@@ -343,15 +380,19 @@ class InferenceServiceController(Controller):
 
         cfg = {**runtime.spec.config, **pred.config}
         if pred.storage_uri:
-            cfg.setdefault("storage_path", download(pred.storage_uri))
+            # merged cfg so a ServingRuntime can enable the cache for all
+            # of its models, with the component able to override
+            cfg.setdefault("storage_path", download(
+                    pred.storage_uri, cache_dir=cfg.get("model_cache_dir")))
             cfg.setdefault("storage_uri", pred.storage_uri)
         return resolve_class(runtime.spec.server_class), cfg
 
     # -- teardown / status -------------------------------------------------
 
     def _teardown_deployment(self, dep: _Deployment) -> None:
-        for s in dep.transformers + dep.predictors:
+        for s in dep.explainers + dep.transformers + dep.predictors:
             s.stop()
+        dep.explainers.clear()
         dep.transformers.clear()
         dep.predictors.clear()
         if dep.router:

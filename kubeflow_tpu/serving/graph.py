@@ -28,7 +28,7 @@ from ..api.inference import (
 )
 from ..controlplane.controller import Controller, Result
 from ..controlplane.store import NotFound, Store
-from ..utils.net import free_port
+from ..utils.net import allocate_port
 
 
 class GraphExecutionError(Exception):
@@ -133,7 +133,7 @@ class GraphRouter:
 
     def __init__(self, executor: GraphExecutor, port: Optional[int] = None):
         self.executor = executor
-        self.port = port or free_port()
+        self.port = port or allocate_port()
         router = self
 
         class Handler(BaseHTTPRequestHandler):

@@ -52,6 +52,11 @@ class Model:
     def postprocess(self, predictions: Instances) -> Instances:
         return predictions
 
+    def explain_batch(self, instances: Instances) -> Instances:
+        """Per-instance explanations (the ``:explain`` verb); only
+        explainer components implement this."""
+        raise NotImplementedError(f"model {self.name} does not explain")
+
     def __call__(self, instances: Instances) -> Instances:
         return self.postprocess(self.predict_batch(self.preprocess(instances)))
 

@@ -166,3 +166,63 @@ BUILTIN_RUNTIMES = {
     "kubeflow_tpu.serving.runtimes:JaxFunctionModel": JaxFunctionModel,
     "kubeflow_tpu.serving.runtimes:LlamaGenerator": LlamaGenerator,
 }
+
+
+class BertClassifierModel(Model):
+    """BERT sequence classification — baseline config 3's predictor
+    ("KServe BERT-base InferenceService" -> the ``tpu`` runtime).
+
+    config:
+      params_ref:   "mem://key" holding (BertConfig, params)
+      seq_buckets:  sequence-length buckets AOT-visible to XLA (pad-up),
+                    default (32, 64, 128, 512-capped-to-max_position)
+
+    Instances are token-id lists (ragged); predictions are per-class
+    probability lists.  Padding tokens are masked out of attention, so a
+    padded batch scores identically to per-instance evaluation.
+    """
+
+    def __init__(self, name: str, config: Optional[dict[str, Any]] = None):
+        super().__init__(name, config)
+        self.batch_buckets = tuple(self.config.get("buckets", DEFAULT_BUCKETS))
+
+    def load(self) -> None:
+        from ..models import bert as bertlib
+
+        ref = self.config["params_ref"]
+        self.cfg, self.params = fetch_mem(ref[len("mem://"):])
+        self.model = bertlib.BertClassifier(self.cfg)
+        default_buckets = [b for b in (32, 64, 128, 512)
+                           if b <= self.cfg.max_position] or [self.cfg.max_position]
+        self.seq_buckets = tuple(self.config.get("seq_buckets", default_buckets))
+
+        def forward(params, ids, mask):
+            logits = self.model.apply(params, ids, mask)
+            return jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+
+        self._forward = jax.jit(forward)
+        self.ready = True
+
+    def _pad_to(self, n: int, buckets: tuple) -> int:
+        for b in buckets:
+            if n <= b:
+                return b
+        return buckets[-1]
+
+    def predict_batch(self, instances):
+        out: list = []
+        cap = self.batch_buckets[-1]
+        for i in range(0, len(instances), cap):
+            chunk = instances[i : i + cap]
+            b = self._pad_to(len(chunk), self.batch_buckets)
+            s = self._pad_to(max(len(x) for x in chunk), self.seq_buckets)
+            ids = np.zeros((b, s), np.int32)
+            mask = np.zeros((b, s), np.bool_)
+            for j, toks in enumerate(chunk):
+                toks = toks[:s]
+                ids[j, : len(toks)] = toks
+                mask[j, : len(toks)] = True
+            probs = np.asarray(jax.device_get(
+                self._forward(self.params, jnp.asarray(ids), jnp.asarray(mask))))
+            out.extend(probs[: len(chunk)].tolist())
+        return out

@@ -28,7 +28,7 @@ from dataclasses import dataclass, field
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Optional
 
-from ..utils.net import free_port
+from ..utils.net import allocate_port
 from .model import Model
 
 log = logging.getLogger("kubeflow_tpu.serving")
@@ -159,7 +159,7 @@ class ModelServer:
     """Hosts models behind the V1/V2 HTTP protocols (one per replica)."""
 
     def __init__(self, port: Optional[int] = None):
-        self.port = port or free_port()
+        self.port = port or allocate_port()
         self._models: dict[str, Model] = {}
         self._batchers: dict[str, MicroBatcher] = {}
         self.metrics = ServerMetrics()
@@ -278,6 +278,11 @@ class ModelServer:
             name = path[len("/v1/models/"):-len(":predict")]
             self._predict_v1(h, name, payload)
             return
+        # V1: /v1/models/<name>:explain (explainer components)
+        if path.startswith("/v1/models/") and path.endswith(":explain"):
+            name = path[len("/v1/models/"):-len(":explain")]
+            self._explain_v1(h, name, payload)
+            return
         # V2: /v2/models/<name>/infer
         if path.startswith("/v2/models/") and path.endswith("/infer"):
             name = path[len("/v2/models/"):-len("/infer")]
@@ -304,6 +309,31 @@ class ModelServer:
             out = self._dispatch(name, instances)
             self.metrics.observe(name, time.perf_counter() - t0, error=False)
             h._send(200, {"predictions": out})
+        except KeyError as e:
+            self.metrics.observe(name, time.perf_counter() - t0, error=True)
+            h._send(404 if str(e).strip("'") == name else 400, {"error": str(e)})
+        except Exception as e:  # noqa: BLE001
+            self.metrics.observe(name, time.perf_counter() - t0, error=True)
+            h._send(500, {"error": f"{type(e).__name__}: {e}"})
+
+    def _explain_v1(self, h, name: str, payload: dict) -> None:
+        # explanations are per-request heavy (each fans out its own batched
+        # predictor calls), so they bypass the micro-batcher
+        t0 = time.perf_counter()
+        try:
+            instances = payload["instances"]
+            m = self._models.get(name)
+            if m is None:
+                raise KeyError(name)
+            with self.metrics.lock:
+                self.metrics.inflight += 1
+            try:
+                out = m.explain_batch(m.preprocess(instances))
+            finally:
+                with self.metrics.lock:
+                    self.metrics.inflight -= 1
+            self.metrics.observe(name, time.perf_counter() - t0, error=False)
+            h._send(200, {"explanations": out})
         except KeyError as e:
             self.metrics.observe(name, time.perf_counter() - t0, error=True)
             h._send(404 if str(e).strip("'") == name else 400, {"error": str(e)})

@@ -10,14 +10,28 @@ Schemes:
   mem://<key>        in-process registry (tests, zero-copy handoff)
   gs:// s3:// hf://  recognized but gated: this environment has zero egress,
                      so they raise with a clear message instead of hanging.
+
+Cache tier (the kserve agent's local-model-cache capability): pass
+``cache_dir`` (or set ``KFT_MODEL_CACHE``) and ``download`` stages the
+source into a content-addressed entry with a ``manifest.json`` recording
+every file's size + sha256.  Subsequent downloads of the same URI verify
+the manifest instead of re-copying; a corrupted entry is re-staged.  New
+replicas on the same host then share one staged copy of the weights.
 """
 
 from __future__ import annotations
 
+import hashlib
+import json
 import os
-from typing import Any
+import shutil
+import time
+import uuid
+from typing import Any, Optional
 
 _MEM_REGISTRY: dict[str, Any] = {}
+
+MANIFEST_NAME = "manifest.json"
 
 
 class StorageError(RuntimeError):
@@ -37,12 +51,20 @@ def fetch_mem(key: str) -> Any:
         raise StorageError(f"mem://{key} not registered") from None
 
 
-def download(uri: str) -> str:
-    """Resolve ``uri`` to a local filesystem path (V1 storage contract)."""
+def download(uri: str, cache_dir: Optional[str] = None) -> str:
+    """Resolve ``uri`` to a local filesystem path (V1 storage contract).
+
+    With ``cache_dir`` (or ``$KFT_MODEL_CACHE``), file sources are staged
+    through the manifest-verified local cache and the cached path is
+    returned instead of the source path.
+    """
+    cache_dir = cache_dir or os.environ.get("KFT_MODEL_CACHE")
     if uri.startswith("file://"):
         path = uri[len("file://"):]
         if not os.path.exists(path):
             raise StorageError(f"{uri}: no such path")
+        if cache_dir:
+            return stage_to_cache(uri, path, cache_dir)
         return path
     if uri.startswith("mem://"):
         # mem objects have no path; callers use fetch_mem directly
@@ -57,3 +79,191 @@ def download(uri: str) -> str:
                 "deployment does not have; stage the model locally and use file://"
             )
     raise StorageError(f"unsupported storage uri {uri!r}")
+
+
+# ---------------------------------------------------------------------------
+# Local model cache with manifests
+# ---------------------------------------------------------------------------
+
+
+def _sha256_file(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _walk_files(root: str) -> list[str]:
+    """Relative paths of every regular file under root (root may be a file)."""
+    if os.path.isfile(root):
+        return [""]
+    out = []
+    for dirpath, _, names in os.walk(root):
+        for n in names:
+            out.append(os.path.relpath(os.path.join(dirpath, n), root))
+    return sorted(out)
+
+
+def build_manifest(uri: str, root: str) -> dict:
+    files = []
+    for rel in _walk_files(root):
+        p = root if rel == "" else os.path.join(root, rel)
+        st = os.stat(p)
+        files.append({
+            "path": rel or os.path.basename(root),
+            "size": st.st_size,
+            "mtime_ns": st.st_mtime_ns,
+            "sha256": _sha256_file(p),
+        })
+    return {"uri": uri, "created": time.time(), "files": files}
+
+
+def verify_manifest(entry_dir: str) -> bool:
+    """True when every file named by the entry's manifest matches on size
+    and sha256 (the cache-hit validity check)."""
+    mpath = os.path.join(entry_dir, MANIFEST_NAME)
+    try:
+        with open(mpath) as f:
+            manifest = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return False
+    model_root = os.path.join(entry_dir, "model")
+    for rec in manifest.get("files", []):
+        p = os.path.join(model_root, rec["path"])
+        try:
+            if os.path.getsize(p) != rec["size"]:
+                return False
+            if _sha256_file(p) != rec["sha256"]:
+                return False
+        except OSError:
+            return False
+    return True
+
+
+#: entry dirs fully hash-verified once by this process; later hits only
+#: size-check, so warm-path cost is O(files), not O(bytes)
+_verified_entries: set[str] = set()
+
+
+def _sizes_ok(entry_dir: str) -> bool:
+    """Cheap validity check: size + mtime match the manifest (catches
+    rewrites without re-reading the bytes)."""
+    mpath = os.path.join(entry_dir, MANIFEST_NAME)
+    try:
+        with open(mpath) as f:
+            manifest = json.load(f)
+        model_root = os.path.join(entry_dir, "model")
+        for rec in manifest.get("files", []):
+            st = os.stat(os.path.join(model_root, rec["path"]))
+            if st.st_size != rec["size"]:
+                return False
+            if "mtime_ns" in rec and st.st_mtime_ns != rec["mtime_ns"]:
+                return False
+        return True
+    except (OSError, json.JSONDecodeError, KeyError):
+        return False
+
+
+def stage_to_cache(uri: str, src_path: str, cache_dir: str) -> str:
+    """Stage ``src_path`` into the cache under a URI-keyed entry; return the
+    staged model path.  A valid existing entry is reused without copying;
+    an invalid one (interrupted copy, corruption) is re-staged."""
+    key = hashlib.sha256(uri.encode()).hexdigest()[:16]
+    entry_dir = os.path.join(cache_dir, key)
+    model_root = os.path.join(entry_dir, "model")
+
+    def staged_path() -> str:
+        if os.path.isdir(src_path):
+            return model_root
+        return os.path.join(model_root, os.path.basename(src_path))
+
+    if os.path.exists(os.path.join(entry_dir, MANIFEST_NAME)):
+        if entry_dir in _verified_entries:
+            # full-hash verified once this process; cheap size check after
+            if _sizes_ok(entry_dir):
+                return staged_path()
+            _verified_entries.discard(entry_dir)
+        if verify_manifest(entry_dir):
+            _verified_entries.add(entry_dir)
+            return staged_path()
+        shutil.rmtree(entry_dir, ignore_errors=True)
+
+    # hidden staging name: list_cache skips dot-entries; unique per attempt
+    # so concurrent stagers (other processes OR other threads here) never
+    # collide.  Only *stale* leftovers (dead stagers) are garbage-collected.
+    tmp_dir = os.path.join(
+        cache_dir, f".staging-{key}-{os.getpid()}-{uuid.uuid4().hex[:8]}")
+    for leftover in _stale_staging_dirs(cache_dir, key):
+        shutil.rmtree(leftover, ignore_errors=True)
+    tmp_model = os.path.join(tmp_dir, "model")
+    if os.path.isdir(src_path):
+        shutil.copytree(src_path, tmp_model)
+    else:
+        os.makedirs(tmp_model, exist_ok=True)
+        shutil.copy2(src_path, os.path.join(tmp_model, os.path.basename(src_path)))
+    # manifest is built from the STAGED copy so manifest and bytes agree by
+    # construction even if the source mutates mid-copy
+    manifest = build_manifest(uri, tmp_model)
+    with open(os.path.join(tmp_dir, MANIFEST_NAME), "w") as f:
+        json.dump(manifest, f)
+    # rename() publishes the entry atomically; never remove a published
+    # entry here — a concurrent replica may already be serving from it
+    try:
+        os.rename(tmp_dir, entry_dir)
+        _verified_entries.add(entry_dir)
+    except OSError:
+        # lost the publish race to a concurrent replica; use the winner's
+        shutil.rmtree(tmp_dir, ignore_errors=True)
+        if not verify_manifest(entry_dir):
+            raise StorageError(f"cache entry for {uri} is invalid after race")
+        _verified_entries.add(entry_dir)
+    return staged_path()
+
+
+#: a staging dir untouched this long is presumed orphaned by a dead stager
+STAGING_STALE_SECONDS = 3600.0
+
+
+def _stale_staging_dirs(cache_dir: str, key: str) -> list[str]:
+    """Staging dirs for ``key`` old enough to be crash leftovers — live
+    concurrent stagers are younger than this and must not be deleted."""
+    out = []
+    try:
+        names = os.listdir(cache_dir)
+    except OSError:
+        return out
+    prefix = f".staging-{key}-"
+    now = time.time()
+    for n in names:
+        if not n.startswith(prefix):
+            continue
+        p = os.path.join(cache_dir, n)
+        try:
+            if now - os.path.getmtime(p) > STAGING_STALE_SECONDS:
+                out.append(p)
+        except OSError:
+            continue
+    return out
+
+
+def list_cache(cache_dir: str) -> list[dict]:
+    """Manifests of every cache entry (the repository-listing surface)."""
+    out = []
+    try:
+        entries = sorted(os.listdir(cache_dir))
+    except OSError:
+        return out
+    for name in entries:
+        if name.startswith("."):  # in-flight/orphaned staging dirs
+            continue
+        mpath = os.path.join(cache_dir, name, MANIFEST_NAME)
+        try:
+            with open(mpath) as f:
+                m = json.load(f)
+            m["entry"] = name
+            m["valid"] = verify_manifest(os.path.join(cache_dir, name))
+            out.append(m)
+        except (OSError, json.JSONDecodeError):
+            continue
+    return out

@@ -5,6 +5,7 @@ in-process server) plus controller tests on the fake cluster.
 """
 
 import json
+import os
 import threading
 import time
 import urllib.error
@@ -203,6 +204,42 @@ class TestStorage:
         with pytest.raises(StorageError):
             download("ftp://nope")
 
+    def test_cache_stage_and_hit(self, tmp_path):
+        from kubeflow_tpu.serving.storage import list_cache, verify_manifest
+
+        src = tmp_path / "model"
+        src.mkdir()
+        (src / "weights.bin").write_bytes(b"W" * 1024)
+        (src / "config.json").write_text('{"d": 1}')
+        cache = tmp_path / "cache"
+        uri = f"file://{src}"
+
+        staged = download(uri, cache_dir=str(cache))
+        assert staged != str(src) and (
+            (tmp_path / "cache") in __import__("pathlib").Path(staged).parents)
+        assert (set(os.listdir(staged)) == {"weights.bin", "config.json"})
+        # second download: manifest-verified hit, same path, no re-stage
+        mtime = os.path.getmtime(os.path.join(staged, "weights.bin"))
+        assert download(uri, cache_dir=str(cache)) == staged
+        assert os.path.getmtime(os.path.join(staged, "weights.bin")) == mtime
+        entries = list_cache(str(cache))
+        assert len(entries) == 1 and entries[0]["valid"]
+        assert {f["path"] for f in entries[0]["files"]} == {
+            "weights.bin", "config.json"}
+
+    def test_cache_corruption_restaged(self, tmp_path):
+        src = tmp_path / "w.bin"
+        src.write_bytes(b"GOOD")
+        cache = tmp_path / "cache"
+        uri = f"file://{src}"
+        staged = download(uri, cache_dir=str(cache))
+        staged_file = staged if os.path.isfile(staged) else os.path.join(staged, "w.bin")
+        with open(staged_file, "wb") as f:
+            f.write(b"EVIL")  # same size, wrong sha256
+        restaged = download(uri, cache_dir=str(cache))
+        refile = restaged if os.path.isfile(restaged) else os.path.join(restaged, "w.bin")
+        assert open(refile, "rb").read() == b"GOOD"
+
 
 def _isvc(name="svc", **pred):
     defaults = dict(model_format=ModelFormat(name="echo"), min_replicas=1,
@@ -287,3 +324,37 @@ class TestInferenceServiceController:
                 return
             time.sleep(0.1)
         raise AssertionError("router still serving after delete")
+
+
+class FirstTwoSum(Model):
+    """Score = x[0] + x[1]; features 2+ are irrelevant (explainer ground
+    truth: occluding segment 0/1 drops the score by exactly that feature)."""
+
+    def predict_batch(self, instances):
+        return [float(x[0]) + float(x[1]) for x in instances]
+
+
+class TestExplainer:
+    def test_explain_verb_and_attributions(self, serving_cluster):
+        """KServe explainer parity: the ``:explain`` verb routes to the
+        explainer component, which scores occlusions through the predictor."""
+        serving_cluster.store.create(InferenceService(
+            metadata=ObjectMeta(name="exp"),
+            spec=InferenceServiceSpec(
+                predictor=ComponentSpec(handler="tests.test_serving:FirstTwoSum"),
+                explainer=ComponentSpec(
+                    handler="kubeflow_tpu.serving.explainer:OcclusionExplainer",
+                    config={"num_segments": 4}),
+            )))
+        isvc = _wait_ready(serving_cluster, "exp")
+        code, out = _post(f"{isvc.status.url}/v1/models/exp:explain",
+                          {"instances": [[3.0, 5.0, 1.0, 2.0]]})
+        assert code == 200
+        e = out["explanations"][0]
+        assert e["base_score"] == 8.0
+        # informative features carry exactly their contribution; dead ones zero
+        assert e["attributions"] == [3.0, 5.0, 0.0, 0.0]
+        # ``:predict`` still reaches the predictor tier through the same URL
+        code, out = _post(f"{isvc.status.url}/v1/models/exp:predict",
+                          {"instances": [[1.0, 2.0, 9.0, 9.0]]})
+        assert code == 200 and out["predictions"] == [3.0]
