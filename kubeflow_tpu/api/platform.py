@@ -1,0 +1,89 @@
+"""Platform-UX API objects: Profile, Notebook, PodDefault.
+
+The kubeflow/kubeflow shell tier (SURVEY.md §2.4) [upstream:
+kubeflow/kubeflow -> components/profile-controller (Profile CRD: namespace-
+per-user multi-tenancy + ResourceQuota), components/notebook-controller
+(Notebook CRD: a stateful per-user workbench pod with stable URL + idle
+culling), components/admission-webhook (PodDefault: label-selected env/
+volume injection)].  TPU-first divergences: quotas are enforced by the gang
+scheduler at admission (so a whole gang either fits the profile's quota or
+stays Pending — quota overcommit can't strand half a TPU slice), and
+notebooks are plain entrypoint pods on the same kubelet contract as jobs.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from pydantic import Field
+
+from .common import Container, TypedObject, _Model
+
+KIND_PROFILE = "Profile"
+KIND_NOTEBOOK = "Notebook"
+KIND_PODDEFAULT = "PodDefault"
+
+#: annotation a culler (or user) stamps on a Notebook to stop its pod; the
+#: kubeflow analog is ``kubeflow-resource-stopped``
+STOPPED_ANNOTATION = "kft-stopped"
+
+
+class ProfileSpec(_Model):
+    #: owning user (email in upstream kubeflow; an opaque id here)
+    owner: str = ""
+    contributors: list[str] = Field(default_factory=list)
+    #: hard caps for the profile's namespace, enforced gang-atomically by
+    #: the scheduler: {"cpu": ..., "memory_gb": ..., "tpu": ...}
+    resource_quota: dict[str, float] = Field(default_factory=dict)
+
+
+class ProfileStatus(_Model):
+    phase: str = "Pending"  # Pending | Ready
+    #: live resource usage of non-terminal pods in the namespace
+    usage: dict[str, float] = Field(default_factory=dict)
+    message: str = ""
+
+
+class Profile(TypedObject):
+    """A Profile's name IS the tenant namespace (upstream convention)."""
+
+    kind: str = KIND_PROFILE
+    spec: ProfileSpec = Field(default_factory=ProfileSpec)
+    status: ProfileStatus = Field(default_factory=ProfileStatus)
+
+
+class NotebookSpec(_Model):
+    #: the workbench process (``module:function(ctx)`` entrypoint or command)
+    template: Container = Field(default_factory=Container)
+    #: stop the pod after this long without activity; 0 disables culling
+    idle_cull_seconds: float = 0.0
+
+
+class NotebookStatus(_Model):
+    phase: str = "Pending"  # Pending | Running | Stopped | Failed
+    url: Optional[str] = None
+    #: wall-clock of the last observed activity (pod start or heartbeat)
+    last_activity: Optional[float] = None
+    message: str = ""
+
+
+class Notebook(TypedObject):
+    kind: str = KIND_NOTEBOOK
+    spec: NotebookSpec = Field(default_factory=NotebookSpec)
+    status: NotebookStatus = Field(default_factory=NotebookStatus)
+
+
+class PodDefaultSpec(_Model):
+    #: pods whose labels include every (k, v) here get the injection;
+    #: empty selector matches nothing (upstream matchLabels semantics)
+    selector: dict[str, str] = Field(default_factory=dict)
+    env: dict[str, str] = Field(default_factory=dict)
+    annotations: dict[str, str] = Field(default_factory=dict)
+
+
+class PodDefault(TypedObject):
+    """Namespace-scoped injection defaults [upstream: kubeflow/kubeflow ->
+    components/admission-webhook PodDefault CRD]."""
+
+    kind: str = KIND_PODDEFAULT
+    spec: PodDefaultSpec = Field(default_factory=PodDefaultSpec)

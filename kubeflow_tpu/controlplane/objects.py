@@ -56,6 +56,9 @@ class PodStatus(_Model):
     # Wall-clock when the in-pod runtime reported passing its first
     # collective barrier — source for the gang-startup metric.
     barrier_time: Optional[float] = None
+    # Wall-clock of the pod's last self-reported activity heartbeat
+    # (status-dir ``activity`` file) — the notebook culler's signal.
+    last_activity: Optional[float] = None
     pid: Optional[int] = None
 
 

@@ -198,6 +198,15 @@ class LocalKubelet:
                     proc.barrier_reported = True
                 except (ValueError, OSError):
                     pass
+        # surface activity heartbeats (notebook culling signal); only write
+        # through when the stamp moved, to keep status churn low
+        afile = os.path.join(proc.status_dir, "activity")
+        try:
+            t = float(open(afile).read().strip())
+            if t > (pod.status.last_activity or 0.0) + 0.5:
+                self._set_status(pod, None, last_activity=t)
+        except (ValueError, OSError):
+            pass
         code = proc.popen.poll()
         if code is None:
             return

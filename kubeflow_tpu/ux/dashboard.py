@@ -1,0 +1,143 @@
+"""Central dashboard: one URL aggregating every plane's status.
+
+[upstream: kubeflow/kubeflow -> components/centraldashboard (TS web app)]:
+the landing surface listing jobs, experiments, inference services,
+notebooks, and profiles across the platform.  Here a single HTTP server
+over the store: JSON APIs per kind (what the upstream web apps fetch from
+their backends) plus a minimal server-rendered HTML index — enough for a
+human to see the whole cluster at a glance, with zero JS build tooling.
+"""
+
+from __future__ import annotations
+
+import html
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from ..api.experiment import KIND_EXPERIMENT
+from ..api.inference import KIND_INFERENCE_GRAPH, KIND_INFERENCE_SERVICE
+from ..api.jaxjob import KIND_JAXJOB
+from ..api.platform import KIND_NOTEBOOK, KIND_PROFILE
+from ..controlplane.objects import KIND_EVENT, KIND_NODE, KIND_POD
+from ..controlplane.store import Store
+from ..utils.net import allocate_port
+
+#: API path segment -> store kind
+_SECTIONS = {
+    "jaxjobs": KIND_JAXJOB,
+    "experiments": KIND_EXPERIMENT,
+    "inferenceservices": KIND_INFERENCE_SERVICE,
+    "inferencegraphs": KIND_INFERENCE_GRAPH,
+    "notebooks": KIND_NOTEBOOK,
+    "profiles": KIND_PROFILE,
+    "nodes": KIND_NODE,
+    "pods": KIND_POD,
+    "events": KIND_EVENT,
+}
+
+
+def _summarize(obj) -> dict:
+    out = {
+        "name": obj.metadata.name,
+        "namespace": obj.metadata.namespace,
+        "kind": obj.kind,
+    }
+    status = getattr(obj, "status", None)
+    if status is not None:
+        out["status"] = status.model_dump(mode="json")
+    for attr in ("reason", "message", "type", "involved_kind", "involved_name"):
+        v = getattr(obj, attr, None)
+        if isinstance(v, str) and v:
+            out[attr] = v
+    return out
+
+
+def _phase_of(summary: dict) -> str:
+    st = summary.get("status", {})
+    if "phase" in st and st["phase"]:
+        return str(st["phase"])
+    conds = st.get("conditions") or []
+    return str(conds[-1]["type"]) if conds else ""
+
+
+class Dashboard:
+    """Serve ``/`` (HTML index), ``/api/overview`` and ``/api/<section>``."""
+
+    def __init__(self, store: Store, port: Optional[int] = None):
+        self.store = store
+        self.port = port or allocate_port()
+        dash = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                pass
+
+            def _send(self, code: int, body: bytes, ctype: str) -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                try:
+                    if self.path == "/":
+                        self._send(200, dash.index_html().encode(), "text/html")
+                    elif self.path == "/api/overview":
+                        self._send(200, json.dumps(dash.overview()).encode(),
+                                   "application/json")
+                    elif self.path.startswith("/api/"):
+                        section = self.path[len("/api/"):].strip("/")
+                        if section not in _SECTIONS:
+                            self._send(404, b'{"error": "unknown section"}',
+                                       "application/json")
+                            return
+                        self._send(200, json.dumps(dash.section(section)).encode(),
+                                   "application/json")
+                    else:
+                        self._send(404, b"not found", "text/plain")
+                except Exception as e:  # noqa: BLE001
+                    self._send(500, json.dumps({"error": str(e)}).encode(),
+                               "application/json")
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", self.port), Handler)
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="dashboard", daemon=True)
+        self._thread.start()
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=2)
+
+    # -- data -------------------------------------------------------------
+
+    def section(self, name: str) -> list[dict]:
+        return [_summarize(o) for o in self.store.list(_SECTIONS[name])]
+
+    def overview(self) -> dict:
+        return {name: len(self.store.list(kind))
+                for name, kind in _SECTIONS.items()}
+
+    def index_html(self) -> str:
+        parts = ["<html><head><title>kubeflow-tpu</title></head><body>",
+                 "<h1>kubeflow-tpu dashboard</h1>"]
+        for name in _SECTIONS:
+            if name in ("events", "pods"):
+                continue  # noisy sections stay API-only, like upstream
+            rows = self.section(name)
+            parts.append(f"<h2>{name} ({len(rows)})</h2><ul>")
+            for r in rows:
+                label = html.escape(f"{r['namespace']}/{r['name']}")
+                phase = html.escape(_phase_of(r))
+                parts.append(f"<li>{label} — {phase}</li>")
+            parts.append("</ul>")
+        parts.append("</body></html>")
+        return "".join(parts)

@@ -1,0 +1,227 @@
+"""Platform-UX tier (SURVEY.md §2.4): profiles/quota, notebooks + culling,
+PodDefault injection, central dashboard."""
+
+import json
+import time
+import urllib.request
+
+from kubeflow_tpu.api.common import Container, ObjectMeta, Resources
+from kubeflow_tpu.api.platform import (
+    Notebook,
+    NotebookSpec,
+    PodDefault,
+    PodDefaultSpec,
+    Profile,
+    ProfileSpec,
+    STOPPED_ANNOTATION,
+)
+from kubeflow_tpu.controlplane import Cluster, FakeKubelet, PodScript
+from kubeflow_tpu.controlplane.objects import (
+    KIND_POD,
+    KIND_PODGROUP,
+    LABEL_JOB_NAME,
+    PodGroupPhase,
+)
+from kubeflow_tpu.api.jaxjob import KIND_JAXJOB
+
+from .test_controlplane import make_job, wait_for
+
+
+def _cluster():
+    c = Cluster()
+    c.add_tpu_slice("s0", num_hosts=4, chips_per_host=4)
+    c.enable_platform_ux()
+    return c
+
+
+class TestProfiles:
+    def test_quota_blocks_oversized_gang_atomically(self):
+        c = _cluster()
+        kubelet = FakeKubelet(c.store, lambda pod: PodScript(hang=True))
+        with c:
+            kubelet.start()
+            try:
+                c.store.create(Profile(
+                    metadata=ObjectMeta(name="team-a"),
+                    spec=ProfileSpec(owner="a@corp", resource_quota={"tpu": 4})))
+                # 2 workers x 4 chips = 8 > quota 4: the WHOLE gang pends
+                job = make_job(name="big", replicas=2, tpu=4)
+                job.metadata.namespace = "team-a"
+                c.store.create(job)
+                pg = wait_for(
+                    lambda: (
+                        g := c.store.try_get(KIND_PODGROUP, "big", "team-a")
+                    ) and g.status.message.startswith("profile quota") and g,
+                    desc="quota rejection")
+                assert pg.status.phase == PodGroupPhase.PENDING
+                pods = c.store.list(KIND_POD, "team-a", labels={LABEL_JOB_NAME: "big"})
+                assert all(p.spec.node_name is None for p in pods)
+                # an in-quota gang from the same profile admits fine
+                ok = make_job(name="small", replicas=1, tpu=4)
+                ok.metadata.namespace = "team-a"
+                c.store.create(ok)
+                wait_for(
+                    lambda: all(
+                        p.spec.node_name
+                        for p in c.store.list(
+                            KIND_POD, "team-a", labels={LABEL_JOB_NAME: "small"})
+                    ) and c.store.list(KIND_POD, "team-a", labels={LABEL_JOB_NAME: "small"}),
+                    desc="in-quota gang bound")
+                # usage shows up on profile status
+                prof = wait_for(
+                    lambda: (
+                        p := c.store.try_get("Profile", "team-a")
+                    ) and p.status.usage.get("tpu") == 4.0 and p,
+                    desc="usage accounted")
+                assert prof.status.phase == "Ready"
+            finally:
+                kubelet.stop()
+
+    def test_no_profile_means_no_quota(self):
+        c = _cluster()
+        kubelet = FakeKubelet(c.store, lambda pod: PodScript(hang=True))
+        with c:
+            kubelet.start()
+            try:
+                c.store.create(make_job(name="free", replicas=2, tpu=4))
+                wait_for(
+                    lambda: all(
+                        p.spec.node_name
+                        for p in c.store.list(KIND_POD, labels={LABEL_JOB_NAME: "free"})
+                    ) and c.store.list(KIND_POD, labels={LABEL_JOB_NAME: "free"}),
+                    desc="unquota'd gang binds")
+            finally:
+                kubelet.stop()
+
+
+class TestPodDefaults:
+    def test_env_injected_by_selector(self):
+        c = _cluster()
+        with c:
+            c.store.create(PodDefault(
+                metadata=ObjectMeta(name="add-tracking"),
+                spec=PodDefaultSpec(
+                    selector={LABEL_JOB_NAME: "tagged"},
+                    env={"KFT_TRACKING": "on", "KFT_STEPS": "999"},
+                    annotations={"team": "a"})))
+            job = make_job(name="tagged", replicas=1)
+            job.spec.replica_specs["worker"].template.env = {"KFT_STEPS": "3"}
+            c.store.create(job)
+            pods = wait_for(
+                lambda: c.store.list(KIND_POD, labels={LABEL_JOB_NAME: "tagged"}),
+                desc="pod created")
+            env = pods[0].spec.container.env
+            assert env["KFT_TRACKING"] == "on"
+            assert env["KFT_STEPS"] == "3"  # pod's own value wins
+            assert pods[0].metadata.annotations["team"] == "a"
+            # unmatched pods untouched
+            c.store.create(make_job(name="plain", replicas=1))
+            pods = wait_for(
+                lambda: c.store.list(KIND_POD, labels={LABEL_JOB_NAME: "plain"}),
+                desc="plain pod")
+            assert "KFT_TRACKING" not in pods[0].spec.container.env
+
+
+class TestNotebooks:
+    def _nb(self, name="wb", cull=0.0):
+        return Notebook(
+            metadata=ObjectMeta(name=name),
+            spec=NotebookSpec(
+                template=Container(
+                    entrypoint="kubeflow_tpu.ux.notebook_server:main",
+                    resources=Resources(cpu=1)),
+                idle_cull_seconds=cull))
+
+    def test_notebook_runs_with_url(self):
+        c = _cluster()
+        kubelet = FakeKubelet(c.store, lambda pod: PodScript(hang=True))
+        with c:
+            kubelet.start()
+            try:
+                c.store.create(self._nb())
+                nb = wait_for(
+                    lambda: (n := c.store.try_get("Notebook", "wb"))
+                    and n.status.phase == "Running" and n,
+                    desc="notebook running")
+                assert nb.status.url and "wb-notebook-0" in nb.status.url
+                assert c.store.try_get(KIND_POD, "wb-notebook-0") is not None
+                assert c.store.try_get("Service", "wb-notebook-0") is not None
+            finally:
+                kubelet.stop()
+
+    def test_idle_culling_then_resume(self):
+        c = _cluster()
+        kubelet = FakeKubelet(c.store, lambda pod: PodScript(hang=True))
+        with c:
+            kubelet.start()
+            try:
+                c.store.create(self._nb(name="idle", cull=0.5))
+                nb = wait_for(
+                    lambda: (n := c.store.try_get("Notebook", "idle"))
+                    and n.status.phase == "Stopped" and n,
+                    timeout=15, desc="culled")
+                assert nb.metadata.annotations[STOPPED_ANNOTATION] == "idle-culled"
+                assert c.store.try_get(KIND_POD, "idle-notebook-0") is None
+                # resume: drop the annotation -> pod recreated
+                def unstamp(o):
+                    o.metadata.annotations.pop(STOPPED_ANNOTATION, None)
+                    o.spec.idle_cull_seconds = 0.0
+                c.store.update_with_retry("Notebook", "idle", "default", unstamp)
+                wait_for(
+                    lambda: (n := c.store.try_get("Notebook", "idle"))
+                    and n.status.phase == "Running",
+                    timeout=15, desc="resumed")
+            finally:
+                kubelet.stop()
+
+    def test_delete_cleans_pod_and_service(self):
+        c = _cluster()
+        kubelet = FakeKubelet(c.store, lambda pod: PodScript(hang=True))
+        with c:
+            kubelet.start()
+            try:
+                c.store.create(self._nb(name="gone"))
+                wait_for(
+                    lambda: (n := c.store.try_get("Notebook", "gone"))
+                    and n.status.phase == "Running",
+                    desc="running")
+                c.store.try_delete("Notebook", "gone")
+                wait_for(
+                    lambda: c.store.try_get(KIND_POD, "gone-notebook-0") is None
+                    and c.store.try_get("Service", "gone-notebook-0") is None,
+                    desc="cleaned")
+            finally:
+                kubelet.stop()
+
+
+class TestDashboard:
+    def test_overview_sections_and_html(self):
+        c = _cluster()
+        kubelet = FakeKubelet(c.store, lambda pod: PodScript(run_seconds=0.05))
+        with c:
+            kubelet.start()
+            try:
+                url = c.serve_dashboard()
+                c.store.create(make_job(name="dashjob", replicas=1))
+                c.store.create(Profile(
+                    metadata=ObjectMeta(name="team-b"),
+                    spec=ProfileSpec(owner="b@corp")))
+                wait_for(
+                    lambda: (j := c.store.try_get(KIND_JAXJOB, "dashjob"))
+                    and j.status.conditions, desc="job visible")
+
+                with urllib.request.urlopen(f"{url}/api/overview", timeout=5) as r:
+                    ov = json.loads(r.read())
+                assert ov["jaxjobs"] == 1 and ov["profiles"] == 1
+                with urllib.request.urlopen(f"{url}/api/jaxjobs", timeout=5) as r:
+                    jobs = json.loads(r.read())
+                assert jobs[0]["name"] == "dashjob" and "status" in jobs[0]
+                with urllib.request.urlopen(url, timeout=5) as r:
+                    page = r.read().decode()
+                assert "kubeflow-tpu dashboard" in page
+                assert "default/dashjob" in page and "default/team-b" in page
+                with urllib.request.urlopen(f"{url}/api/events", timeout=5) as r:
+                    events = json.loads(r.read())
+                assert any(e.get("reason") == "PodGroupCreated" for e in events)
+            finally:
+                kubelet.stop()
