@@ -1,0 +1,229 @@
+"""BERT-family bidirectional encoder, TPU-first.
+
+Capability target: baseline config 3 — "KServe BERT-base InferenceService
+(GPU/Triton) -> TPU ServingRuntime" [local: BASELINE.json configs].  The
+reference serves BERT from a Triton container; this is the native encoder
+the ``tpu`` runtime compiles with XLA instead (serving/runtimes.py
+``BertClassifierModel``), and it trains under the same trainer/mesh stack
+as the Llama family.
+
+TPU-first choices (mirroring models/llama.py):
+- bfloat16 activations / float32 params; LayerNorm in float32.
+- the same *logical* axis vocabulary (parallel/sharding.py LOGICAL_RULES):
+  ``vocab``/``embed`` on embeddings, ``heads``/``mlp`` on the ``model``
+  axis, activations on ``batch``/``act_seq`` — so DP/FSDP/TP/SP apply by
+  mesh choice with zero model-code changes.
+- optional ``nn.scan`` over layers + remat, same as Llama.
+- attention is bidirectional (padding mask only) — encoders have no causal
+  structure, so the whole [b, h, s, s] score tensor tiles the MXU densely.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from .llama import Einsum
+
+Dtype = Any
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: int = 3072
+    max_position: int = 512
+    type_vocab_size: int = 2
+    layer_norm_eps: float = 1e-12
+    num_classes: int = 2
+    dtype: Dtype = jnp.bfloat16
+    param_dtype: Dtype = jnp.float32
+    remat: bool = False
+    scan_layers: bool = True
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    def __post_init__(self):
+        if self.hidden_size % self.num_heads:
+            raise ValueError("hidden_size must divide by num_heads")
+
+
+def tiny(**kw) -> BertConfig:
+    """Test/smoke config: one CPU device, <1s."""
+    return BertConfig(**{**dict(
+        vocab_size=128, hidden_size=32, num_layers=2, num_heads=4,
+        intermediate_size=64, max_position=64, dtype=jnp.float32,
+        scan_layers=False,
+    ), **kw})
+
+
+def bert_base(**kw) -> BertConfig:
+    return BertConfig(**kw)
+
+
+def bert_large(**kw) -> BertConfig:
+    return BertConfig(**{**dict(
+        hidden_size=1024, num_layers=24, num_heads=16,
+        intermediate_size=4096,
+    ), **kw})
+
+
+PRESETS = {"tiny": tiny, "bert-base": bert_base, "bert-large": bert_large}
+
+
+class LayerNorm(nn.Module):
+    eps: float
+    dtype: Dtype
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        scale = self.param(
+            "scale", nn.with_logical_partitioning(nn.initializers.ones, ("norm",)),
+            (x.shape[-1],), jnp.float32)
+        bias = self.param(
+            "bias", nn.with_logical_partitioning(nn.initializers.zeros, ("norm",)),
+            (x.shape[-1],), jnp.float32)
+        x32 = x.astype(jnp.float32)
+        mu = jnp.mean(x32, axis=-1, keepdims=True)
+        var = jnp.mean(jnp.square(x32 - mu), axis=-1, keepdims=True)
+        y = (x32 - mu) * jax.lax.rsqrt(var + self.eps) * scale + bias
+        return y.astype(self.dtype)
+
+
+class SelfAttention(nn.Module):
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array, mask: jax.Array) -> jax.Array:
+        cfg = self.cfg
+        h, d = cfg.num_heads, cfg.head_dim
+        q = Einsum("bse,ehd->bshd", (cfg.hidden_size, h, d),
+                   ("embed", "heads", "head_dim"), cfg.dtype, cfg.param_dtype,
+                   name="q")(x)
+        k = Einsum("bse,ehd->bshd", (cfg.hidden_size, h, d),
+                   ("embed", "heads", "head_dim"), cfg.dtype, cfg.param_dtype,
+                   name="k")(x)
+        v = Einsum("bse,ehd->bshd", (cfg.hidden_size, h, d),
+                   ("embed", "heads", "head_dim"), cfg.dtype, cfg.param_dtype,
+                   name="v")(x)
+        q = nn.with_logical_constraint(q, ("batch", "act_seq", "act_heads", "head_dim"))
+        k = nn.with_logical_constraint(k, ("batch", "act_seq", "act_heads", "head_dim"))
+        v = nn.with_logical_constraint(v, ("batch", "act_seq", "act_heads", "head_dim"))
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
+        scores = scores / jnp.sqrt(d).astype(jnp.float32)
+        # padding mask: [b, 1, 1, k] additive
+        neg = jnp.finfo(jnp.float32).min
+        scores = jnp.where(mask[:, None, None, :], scores, neg)
+        probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
+        out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+        out = nn.with_logical_constraint(
+            out, ("batch", "act_seq", "act_heads", "head_dim"))
+        return Einsum("bshd,hde->bse", (h, d, cfg.hidden_size),
+                      ("heads", "head_dim", "embed"), cfg.dtype,
+                      cfg.param_dtype, in_axes=(0, 1), name="o")(out)
+
+
+class EncoderLayer(nn.Module):
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array, mask: jax.Array) -> jax.Array:
+        cfg = self.cfg
+        attn = SelfAttention(cfg, name="attention")(x, mask)
+        x = LayerNorm(cfg.layer_norm_eps, cfg.dtype, name="attn_norm")(x + attn)
+        h = Einsum("bse,em->bsm", (cfg.hidden_size, cfg.intermediate_size),
+                   ("embed", "mlp"), cfg.dtype, cfg.param_dtype, name="ffn_in")(x)
+        h = nn.gelu(h)
+        h = nn.with_logical_constraint(h, ("batch", "act_seq", "act_mlp"))
+        h = Einsum("bsm,me->bse", (cfg.intermediate_size, cfg.hidden_size),
+                   ("mlp", "embed"), cfg.dtype, cfg.param_dtype,
+                   name="ffn_out")(h)
+        x = LayerNorm(cfg.layer_norm_eps, cfg.dtype, name="ffn_norm")(x + h)
+        return nn.with_logical_constraint(x, ("batch", "act_seq", "act_embed"))
+
+
+class BertEncoder(nn.Module):
+    """Token ids -> (sequence_output [b,s,e], pooled [b,e])."""
+
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(
+        self,
+        input_ids: jax.Array,
+        attention_mask: Optional[jax.Array] = None,
+        token_type_ids: Optional[jax.Array] = None,
+    ) -> tuple[jax.Array, jax.Array]:
+        cfg = self.cfg
+        b, s = input_ids.shape
+        if attention_mask is None:
+            attention_mask = jnp.ones((b, s), jnp.bool_)
+        else:
+            attention_mask = attention_mask.astype(jnp.bool_)
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros((b, s), jnp.int32)
+
+        tok = self.param(
+            "token_embedding",
+            nn.with_logical_partitioning(
+                nn.initializers.normal(0.02), ("vocab", "embed")),
+            (cfg.vocab_size, cfg.hidden_size), cfg.param_dtype)
+        pos = self.param(
+            "position_embedding",
+            nn.with_logical_partitioning(
+                nn.initializers.normal(0.02), (None, "embed")),
+            (cfg.max_position, cfg.hidden_size), cfg.param_dtype)
+        seg = self.param(
+            "segment_embedding",
+            nn.with_logical_partitioning(
+                nn.initializers.normal(0.02), (None, "embed")),
+            (cfg.type_vocab_size, cfg.hidden_size), cfg.param_dtype)
+        x = (tok[input_ids] + pos[jnp.arange(s)][None, :, :]
+             + seg[token_type_ids]).astype(cfg.dtype)
+        x = LayerNorm(cfg.layer_norm_eps, cfg.dtype, name="embed_norm")(x)
+        x = nn.with_logical_constraint(x, ("batch", "act_seq", "act_embed"))
+
+        layer_cls = EncoderLayer
+        if cfg.remat:
+            layer_cls = nn.remat(
+                layer_cls, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+        if cfg.scan_layers:
+            x, _ = nn.scan(
+                lambda mdl, carry, _: (mdl(carry, attention_mask), None),
+                variable_axes={"params": 0},
+                split_rngs={"params": True},
+                length=cfg.num_layers,
+                metadata_params={nn.PARTITION_NAME: "layers"},
+            )(layer_cls(cfg, name="layers"), x, None)
+        else:
+            for i in range(cfg.num_layers):
+                x = layer_cls(cfg, name=f"layer_{i}")(x, attention_mask)
+
+        pooled = jnp.tanh(Einsum(
+            "be,ef->bf", (cfg.hidden_size, cfg.hidden_size),
+            ("embed", None), cfg.dtype, cfg.param_dtype,
+            name="pooler")(x[:, 0, :]))
+        return x, pooled
+
+
+class BertClassifier(nn.Module):
+    """Pooled [CLS] -> class logits (the sequence-classification head)."""
+
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, token_type_ids=None):
+        _, pooled = BertEncoder(self.cfg, name="encoder")(
+            input_ids, attention_mask, token_type_ids)
+        return Einsum("be,ec->bc", (self.cfg.hidden_size, self.cfg.num_classes),
+                      ("embed", None), self.cfg.dtype, self.cfg.param_dtype,
+                      name="classifier")(pooled.astype(self.cfg.dtype))

@@ -42,6 +42,26 @@ class TestLocalE2E:
         logs = client.get_job_logs("mnist-smoke")
         assert "loss=" in logs["mnist-smoke-worker-0"]
 
+    def test_two_worker_resnet_ddp(self, platform):
+        """Baseline config 2 literal: data-parallel ResNet, 2 replicas,
+        real multi-process rendezvous (XLA psum standing in for NCCL)."""
+        client = TrainingClient(platform)
+        job = client.train(
+            name="resnet-ddp",
+            entrypoint="kubeflow_tpu.models.resnet:train_main",
+            num_workers=2,
+            env={"KFT_STEPS": "3", "KFT_BATCH": "8", "KFT_RESNET": "tiny"},
+            timeout=180,
+        )
+        assert has_condition(job.status.conditions, JobConditionType.SUCCEEDED)
+        logs = client.get_job_logs("resnet-ddp")
+        # both ranks computed the same global model: identical loss lines
+        lines = {
+            name: [l for l in text.splitlines() if l.startswith("loss=")][-1]
+            for name, text in logs.items()
+        }
+        assert len(set(lines.values())) == 1 and len(lines) == 2
+
     def test_two_worker_distributed(self, platform):
         """Baseline config 2 analog: 2-process DDP-style data parallelism
         with a genuine jax.distributed rendezvous."""
