@@ -266,9 +266,122 @@ class BayesianOptimization(Suggester):
         return out
 
 
+class CmaEs(Suggester):
+    """(mu/mu_w, lambda)-CMA-ES [Hansen's standard strategy; reference
+    analog: Katib's goptuna/cmaes suggestion service].
+
+    Stateless like every suggester here: the evolution state (mean, step
+    size, covariance, evolution paths) is reconstructed by replaying the
+    observation history in generation-sized chunks, so the gRPC service can
+    restart mid-experiment and continue the same trajectory — the property
+    Katib gets by re-sending full history per GetSuggestions call.  The
+    controller feeds history in issue order including early-stopped trials'
+    observations; a trial that fails with NO observation shifts generation
+    boundaries, degrading adaptation gracefully (chunks still track the
+    recent selection mean) rather than crashing.
+
+    settings: population_size (default 4+floor(3 ln d)), sigma (initial
+    step size in unit space, default 0.3).
+    """
+
+    name = "cmaes"
+
+    def suggest(self, req: SuggestRequest) -> list[dict[str, object]]:
+        params = req.parameters
+        d = len(params)
+        lam = int(req.settings.get(
+            "population_size", 4 + int(3 * math.log(max(d, 1) + 1e-12))))
+        lam = max(lam, 2)
+        sigma0 = float(req.settings.get("sigma", 0.3))
+        seed = req.seed if req.seed is not None else 0
+
+        mu = lam // 2
+        w = np.log(lam / 2 + 0.5) - np.log(np.arange(1, mu + 1))
+        w = w / w.sum()
+        mu_eff = 1.0 / float(np.square(w).sum())
+        c_sigma = (mu_eff + 2) / (d + mu_eff + 5)
+        d_sigma = 1 + 2 * max(0.0, math.sqrt((mu_eff - 1) / (d + 1)) - 1) + c_sigma
+        c_c = (4 + mu_eff / d) / (d + 4 + 2 * mu_eff / d)
+        c_1 = 2 / ((d + 1.3) ** 2 + mu_eff)
+        c_mu = min(1 - c_1, 2 * (mu_eff - 2 + 1 / mu_eff) / ((d + 2) ** 2 + mu_eff))
+        chi_n = math.sqrt(d) * (1 - 1 / (4 * d) + 1 / (21 * d * d))
+
+        mean = np.full(d, 0.5)
+        sigma = sigma0
+        cov = np.eye(d)
+        p_sigma = np.zeros(d)
+        p_c = np.zeros(d)
+
+        # internal objective is MINIMIZED
+        flip = -1.0 if req.objective_type == ObjectiveType.MAXIMIZE else 1.0
+        hist = req.history
+        n_gens = len(hist) // lam
+        for g in range(n_gens):
+            gen = hist[g * lam : (g + 1) * lam]
+            xs = np.array([
+                [_to_unit(p, o.assignments[p.name]) for p in params]
+                for o in gen
+            ])
+            fs = np.array([flip * o.value for o in gen])
+            order = np.argsort(fs)  # best first
+            x_sel = xs[order[:mu]]
+            old_mean = mean
+            mean = w @ x_sel
+            # evolution paths in the whitened frame
+            c_inv_sqrt = _inv_sqrt(cov)
+            y = (mean - old_mean) / max(sigma, 1e-12)
+            p_sigma = (1 - c_sigma) * p_sigma + math.sqrt(
+                c_sigma * (2 - c_sigma) * mu_eff) * (c_inv_sqrt @ y)
+            h_sigma = float(
+                np.linalg.norm(p_sigma)
+                / math.sqrt(1 - (1 - c_sigma) ** (2 * (g + 1)))
+                < (1.4 + 2 / (d + 1)) * chi_n
+            )
+            p_c = (1 - c_c) * p_c + h_sigma * math.sqrt(
+                c_c * (2 - c_c) * mu_eff) * y
+            arts = (x_sel - old_mean) / max(sigma, 1e-12)
+            rank_mu = (w[:, None] * arts).T @ arts
+            cov = (
+                (1 - c_1 - c_mu) * cov
+                + c_1 * (np.outer(p_c, p_c)
+                         + (1 - h_sigma) * c_c * (2 - c_c) * cov)
+                + c_mu * rank_mu
+            )
+            sigma = sigma * math.exp(
+                (c_sigma / d_sigma) * (np.linalg.norm(p_sigma) / chi_n - 1))
+            sigma = float(min(max(sigma, 1e-8), 1.0))
+
+        # sample the current generation's candidates deterministically;
+        # the cursor past complete generations indexes into this stream so
+        # parallel suggest() calls hand out distinct members.  Like grid's
+        # cursor, it defends with len(history): a driver that never sets
+        # `issued` must still advance, not replay one point all generation.
+        rng = np.random.default_rng(seed + 7919 * n_gens)
+        issued_in_gen = max(max(req.issued, len(hist)) - n_gens * lam, 0)
+        n_draw = issued_in_gen + req.count
+        try:
+            chol = np.linalg.cholesky(
+                cov + 1e-12 * np.eye(d))
+        except np.linalg.LinAlgError:
+            chol = np.eye(d)
+        z = rng.standard_normal((n_draw, d))
+        points = mean[None, :] + sigma * (z @ chol.T)
+        out = []
+        for row in points[issued_in_gen:]:
+            out.append({
+                p.name: _from_unit(p, float(u)) for p, u in zip(params, row)})
+        return out
+
+
+def _inv_sqrt(mat: np.ndarray) -> np.ndarray:
+    vals, vecs = np.linalg.eigh(mat)
+    vals = np.maximum(vals, 1e-12)
+    return vecs @ np.diag(vals ** -0.5) @ vecs.T
+
+
 REGISTRY: dict[str, type[Suggester]] = {
     cls.name: cls
-    for cls in (RandomSearch, GridSearch, Tpe, BayesianOptimization)
+    for cls in (RandomSearch, GridSearch, Tpe, BayesianOptimization, CmaEs)
 }
 
 

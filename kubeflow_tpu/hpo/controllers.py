@@ -412,12 +412,16 @@ class SuggestionController(Controller):
         return None
 
     def _history(self, namespace: str, exp_name: str) -> list[algorithms.Observation]:
+        # EarlyStopped trials carry a real observation (their value at the
+        # cut) and feed the optimizer like Katib's early-stopped trials do;
+        # only observation-less Failed trials are invisible to it
+        observed = ("Succeeded", "EarlyStopped")
         seen: dict[str, algorithms.Observation] = {}
         for t in self.store.list(KIND_TRIAL, namespace):
             if (
                 isinstance(t, Trial)
                 and t.spec.experiment_name == exp_name
-                and t.status.phase == "Succeeded"
+                and t.status.phase in observed
                 and t.status.observation is not None
             ):
                 seen[t.metadata.name] = algorithms.Observation(
@@ -430,7 +434,7 @@ class SuggestionController(Controller):
             try:
                 for rec in self.db.get_observations(exp_name, namespace):
                     if (
-                        rec.get("phase") == "Succeeded"
+                        rec.get("phase") in observed
                         and rec.get("value") is not None
                         and rec["trial"] not in seen
                     ):
@@ -438,7 +442,10 @@ class SuggestionController(Controller):
                             assignments=rec["assignments"], value=rec["value"])
             except Exception:  # noqa: BLE001 — db unavailable: use live view
                 pass
-        return list(seen.values())
+        # issue order (trial names are zero-padded, so name order == issue
+        # order): generation-replay algorithms (cmaes) need history in the
+        # order assignments were handed out, restart or not
+        return [seen[k] for k in sorted(seen)]
 
     def _teardown(self, key: str) -> None:
         client = self._clients.pop(key, None)

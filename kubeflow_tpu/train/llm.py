@@ -9,6 +9,10 @@ controller injects, same channel the reference uses for MASTER_ADDR et al).
 Env knobs (all optional):
   KFT_MODEL_PRESET  llama preset name (default "tiny")
   KFT_STEPS, KFT_BATCH, KFT_SEQ_LEN, KFT_LR, KFT_CKPT_DIR, KFT_SAVE_EVERY
+  KFT_CORPUS_DIR    tokenized TokenCorpus directory -> train on real data
+                    through the native packing pipeline (train/native_data);
+                    unset = hermetic SyntheticLm stream
+  KFT_EOS_ID        EOS separator id for corpus packing (default 0)
 """
 
 from __future__ import annotations
@@ -40,6 +44,30 @@ def config_from_env(ctx: "bootstrap.PodContext") -> trainlib.TrainConfig:
     )
 
 
+def source_from_env(cfg: trainlib.TrainConfig):
+    """KFT_CORPUS_DIR -> PackedLmCorpus over the native loader; else None
+    (the trainer defaults to the hermetic synthetic stream)."""
+    corpus_dir = os.environ.get("KFT_CORPUS_DIR")
+    if not corpus_dir:
+        return None
+    from .native_data import PackedLmCorpus, TokenCorpus
+
+    corpus = TokenCorpus.open(corpus_dir)
+    if corpus.n_tokens and int(corpus.tokens.max()) >= cfg.model.vocab_size:
+        # fail fast: out-of-range ids would be silently clamped by the
+        # embedding gather and the job would "succeed" on garbage
+        raise ValueError(
+            f"corpus {corpus_dir} has token id {int(corpus.tokens.max())} "
+            f">= model vocab_size {cfg.model.vocab_size}; pick a larger "
+            "KFT_MODEL_PRESET or retokenize")
+    return PackedLmCorpus(
+        corpus,
+        cfg.global_batch,
+        cfg.seq_len,
+        eos=int(os.environ.get("KFT_EOS_ID", "0")),
+    )
+
+
 def train_main(ctx: "bootstrap.PodContext") -> None:
     """Runs on every worker; emits per-step metrics from the coordinator."""
     cfg = config_from_env(ctx)
@@ -56,7 +84,7 @@ def train_main(ctx: "bootstrap.PodContext") -> None:
                 ctx, "tokens_per_sec_per_chip", m.tokens_per_sec_per_chip,
                 step=m.step)
 
-    final = t.train(on_metrics=on_metrics)
+    final = t.train(source=source_from_env(cfg), on_metrics=on_metrics)
     if ctx.is_coordinator and final is not None:
         bootstrap.emit_metric(ctx, "final_loss", final.loss)
         bootstrap.emit_metric(ctx, "mfu", final.mfu)

@@ -81,7 +81,7 @@ class TestAlgorithms:
         req.history = [alg.Observation(assignments=a, value=0.0) for a in out]
         assert alg.GridSearch().suggest(req) == []
 
-    @pytest.mark.parametrize("name", ["tpe", "bayesianoptimization"])
+    @pytest.mark.parametrize("name", ["tpe", "bayesianoptimization", "cmaes"])
     def test_model_based_beats_random_closed_loop(self, name):
         """Sequential optimize-observe loop at equal budget: the model-based
         suggester's best observed value should beat random search's."""
@@ -97,6 +97,23 @@ class TestAlgorithms:
             return min(ob.value for ob in history)
 
         assert run(name) < run("random")
+
+    def test_cmaes_stateless_replay(self):
+        """Service-restart property: identical (history, seed, issued) must
+        reconstruct the identical evolution state and suggestions."""
+        history = []
+        s = alg.get_suggester("cmaes")
+        for i in range(16):
+            a = s.suggest(_req(history, count=1, seed=7))[0]
+            history.append(alg.Observation(assignments=a, value=_quadratic(a)))
+        again = alg.get_suggester("cmaes").suggest(_req(history, count=3, seed=7))
+        first = s.suggest(_req(history, count=3, seed=7))
+        assert again == first
+
+    def test_cmaes_parallel_suggestions_distinct(self):
+        req = _req([], count=4, seed=1)
+        out = alg.get_suggester("cmaes").suggest(req)
+        assert len({tuple(sorted(a.items())) for a in out}) == 4
 
     def test_unknown_algorithm_raises(self):
         with pytest.raises(ValueError, match="unknown algorithm"):

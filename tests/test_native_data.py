@@ -1,0 +1,160 @@
+"""Native data loader: C++/NumPy parity, packing semantics, corpus source."""
+
+import numpy as np
+import pytest
+
+from kubeflow_tpu.native import load_library
+from kubeflow_tpu.train.native_data import (
+    PackedLmCorpus,
+    TokenCorpus,
+    gather_batch,
+    pack_sequences,
+    shuffle_indices,
+)
+
+EOS = 99
+
+
+def _docs():
+    rng = np.random.default_rng(0)
+    return [rng.integers(1, 90, size=n).astype(np.int32)
+            for n in (5, 17, 3, 40, 11, 29, 8)]
+
+
+def _corpus(tmp_path):
+    return TokenCorpus.write(str(tmp_path / "corpus"), _docs())
+
+
+class TestNativeBuild:
+    def test_library_builds(self):
+        """g++ is part of this image; the native path must actually engage
+        (the fallback exists for hosts without a toolchain)."""
+        assert load_library() is not None
+
+
+class TestParity:
+    def test_shuffle_native_matches_fallback(self):
+        for n, seed in ((1, 0), (2, 1), (100, 7), (1000, 12345)):
+            a = shuffle_indices(n, seed)
+            b = shuffle_indices(n, seed, force_fallback=True)
+            np.testing.assert_array_equal(a, b)
+            assert sorted(a.tolist()) == list(range(n))
+
+    def test_shuffle_is_deterministic_and_seed_sensitive(self):
+        np.testing.assert_array_equal(
+            shuffle_indices(500, 3), shuffle_indices(500, 3))
+        assert not np.array_equal(shuffle_indices(500, 3), shuffle_indices(500, 4))
+
+    def test_pack_native_matches_fallback(self, tmp_path):
+        c = _corpus(tmp_path)
+        order = shuffle_indices(c.n_docs, 42)
+        for row0, n_seqs, seq_len in ((0, 4, 7), (2, 3, 7), (0, 64, 5), (10, 8, 3)):
+            a, rows_a = pack_sequences(
+                c.tokens, c.offsets, order, EOS, seq_len, row0, n_seqs)
+            b, rows_b = pack_sequences(
+                c.tokens, c.offsets, order, EOS, seq_len, row0, n_seqs,
+                force_fallback=True)
+            np.testing.assert_array_equal(a, b)
+            assert rows_a == rows_b
+
+    def test_gather_native_matches_fallback(self):
+        data = np.arange(60, dtype=np.int32).reshape(10, 6)
+        idx = np.array([3, 3, 0, 9, 5], dtype=np.uint64)
+        np.testing.assert_array_equal(
+            gather_batch(data, idx), gather_batch(data, idx, force_fallback=True))
+
+
+class TestPackingSemantics:
+    def test_stream_reconstruction(self, tmp_path):
+        """Unpacking the packed rows reproduces the shuffled EOS-separated
+        stream exactly — no token lost, duplicated, or reordered."""
+        c = _corpus(tmp_path)
+        docs = _docs()
+        order = shuffle_indices(c.n_docs, 1)
+        seq_len = 6
+        row = seq_len + 1
+        stream = np.concatenate(
+            [np.concatenate([docs[int(d)], [EOS]]) for d in order])
+        epoch_rows = (len(stream) + row - 1) // row
+        out, reported = pack_sequences(
+            c.tokens, c.offsets, order, EOS, seq_len, 0, epoch_rows)
+        assert reported == epoch_rows
+        flat = out.reshape(-1)
+        np.testing.assert_array_equal(flat[: len(stream)], stream)
+        assert (flat[len(stream):] == EOS).all()  # tail padding
+
+    def test_windowed_equals_full(self, tmp_path):
+        c = _corpus(tmp_path)
+        order = shuffle_indices(c.n_docs, 2)
+        full, rows = pack_sequences(c.tokens, c.offsets, order, EOS, 4, 0, 12)
+        for row0 in (0, 3, 7):
+            win, _ = pack_sequences(c.tokens, c.offsets, order, EOS, 4, row0, 3)
+            np.testing.assert_array_equal(win, full[row0: row0 + 3])
+
+
+class TestPackedLmCorpus:
+    def test_process_shards_are_disjoint_and_cover(self, tmp_path):
+        c = _corpus(tmp_path)
+        gb, seq = 4, 5
+        shards = [
+            PackedLmCorpus(c, gb, seq, eos=EOS, process_index=p,
+                           process_count=2, seed=9).local_batch(0)["tokens"]
+            for p in (0, 2 // 2)
+        ]
+        whole = PackedLmCorpus(
+            c, gb, seq, eos=EOS, process_index=0, process_count=1,
+            seed=9).local_batch(0)["tokens"]
+        np.testing.assert_array_equal(np.concatenate(shards), whole)
+
+    def test_resume_reproduces_batches(self, tmp_path):
+        c = _corpus(tmp_path)
+        src = PackedLmCorpus(c, 2, 5, eos=EOS, process_index=0,
+                             process_count=1, seed=5)
+        want = [src.local_batch(s)["tokens"] for s in range(6)]
+        fresh = PackedLmCorpus(c, 2, 5, eos=EOS, process_index=0,
+                               process_count=1, seed=5)
+        got = [fresh.local_batch(s)["tokens"] for s in range(6)]
+        for a, b in zip(want, got):
+            np.testing.assert_array_equal(a, b)
+
+    def test_epochs_reshuffle(self, tmp_path):
+        c = _corpus(tmp_path)
+        src = PackedLmCorpus(c, 2, 5, eos=EOS, process_index=0,
+                             process_count=1, seed=5)
+        e0 = np.concatenate(
+            [src.local_batch(s)["tokens"] for s in range(src.batches_per_epoch)])
+        e1 = np.concatenate(
+            [src.local_batch(src.batches_per_epoch + s)["tokens"]
+             for s in range(src.batches_per_epoch)])
+        assert not np.array_equal(e0, e1)
+        # same multiset of non-padding tokens both epochs
+        assert sorted(e0[e0 != EOS].tolist()) == sorted(e1[e1 != EOS].tolist())
+
+    def test_too_small_corpus_rejected(self, tmp_path):
+        c = TokenCorpus.write(
+            str(tmp_path / "small"), [np.array([1, 2, 3], np.int32)])
+        with pytest.raises(ValueError, match="smaller than one global batch"):
+            PackedLmCorpus(c, 64, 1024, process_index=0, process_count=1)
+
+
+class TestTrainerIntegration:
+    def test_llama_trains_on_packed_corpus(self, tmp_path):
+        """The real-corpus path end to end: TokenCorpus -> native packing ->
+        sharded trainer; loss drops on structured (repetitive) data."""
+        from kubeflow_tpu.models import llama
+        from kubeflow_tpu.train import trainer as trainlib
+
+        rng = np.random.default_rng(3)
+        # repetitive documents = learnable next-token structure
+        base = rng.integers(1, 250, size=64).astype(np.int32)
+        docs = [np.tile(base, 4) for _ in range(64)]
+        c = TokenCorpus.write(str(tmp_path / "c"), docs)
+        cfg = trainlib.TrainConfig(
+            model=llama.tiny(), mesh_axes={"data": 4, "model": 2},
+            global_batch=8, seq_len=32, steps=20, learning_rate=1e-2,
+            warmup_steps=2, log_every=2)
+        src = PackedLmCorpus(c, cfg.global_batch, cfg.seq_len, eos=0,
+                             process_index=0, process_count=1)
+        seen = []
+        trainlib.Trainer(cfg).train(source=src, on_metrics=seen.append)
+        assert seen[-1].loss < seen[0].loss
