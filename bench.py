@@ -35,7 +35,7 @@ def main() -> None:
     on_tpu = devices[0].platform == "tpu"
     if on_tpu:
         model = _bench_model()
-        batch, seq = 16, 1024
+        batch, seq = 14, 1024
     else:
         model = llamalib.tiny()
         batch, seq = 8, 128

@@ -92,11 +92,18 @@ class GraphStep(_Model):
     #: Sequence: what the step receives — "$response" (previous step's
     #: output, default) or "$request" (the original graph input)
     data: str = "$response"
+    #: Ensemble: key for this step's output in the merged response
+    #: (defaults to the service/node name); Splitter: ignored
+    name: Optional[str] = None
+    #: Splitter: relative traffic weight (defaults to 1)
+    weight: Optional[int] = None
 
 
 class GraphNode(_Model):
-    #: "Sequence" (steps chained in order) or "Switch" (first step whose
-    #: condition matches the request handles it)
+    #: "Sequence" (steps chained in order), "Switch" (first step whose
+    #: condition matches handles it), "Ensemble" (all steps run in
+    #: parallel on the same input; outputs merged under step names), or
+    #: "Splitter" (one step picked by traffic weight)
     router_type: str = "Sequence"
     steps: list[GraphStep] = Field(default_factory=list)
 

@@ -344,7 +344,7 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 
 def flash_attention(
     q: jax.Array, k: jax.Array, v: jax.Array,
-    *, q_per_kv: int = 1, block_q: int = 512, block_k: int = 512,
+    *, q_per_kv: int = 1, block_q: int = 1024, block_k: int = 1024,
 ) -> jax.Array:
     """Causal GQA flash attention; drop-in for the dense reference.
 

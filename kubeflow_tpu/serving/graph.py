@@ -2,19 +2,23 @@
 
 The kserve InferenceGraph capability [upstream: kserve ->
 pkg/apis/serving/v1alpha1 InferenceGraph, cmd/router]: a graph CRD whose
-router executes Sequence (chain steps, each seeing the previous response
-or the original request) and Switch (first matching condition wins) over
-live InferenceServices.  The router resolves target URLs from the store at
+router executes the full node set — Sequence (chain steps, each seeing the
+previous response or the original request), Switch (first matching
+condition wins), Ensemble (steps fan out in parallel, outputs merged under
+step names), Splitter (weighted traffic split) — over live
+InferenceServices.  The router resolves target URLs from the store at
 request time, so ISvc redeploys/scaling never require a graph update.
 """
 
 from __future__ import annotations
 
 import json
+import random
 import threading
 import time
 import urllib.error
 import urllib.request
+from concurrent import futures
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Optional
 
@@ -76,6 +80,10 @@ class GraphExecutor:
         self.graph = graph
         self.url_for = url_for
         self.timeout = timeout
+        # shared pool for Ensemble fan-out: the executor is long-lived (one
+        # per GraphRouter), so per-request pool churn is avoidable overhead
+        self._pool = futures.ThreadPoolExecutor(
+            max_workers=8, thread_name_prefix="graph-ensemble")
 
     def execute(self, payload: dict) -> dict:
         return self._run_node("root", payload, payload)
@@ -93,7 +101,42 @@ class GraphExecutor:
                 if step.condition is None or eval_condition(step.condition, payload):
                     return self._run_step(step, payload, original)
             raise GraphExecutionError(404, "no switch condition matched")
-        # Sequence
+        if node.router_type == "Ensemble":
+            # all steps see the same input concurrently; response maps step
+            # name -> output [upstream: kserve router Ensemble semantics]
+            keys = [
+                step.name or step.service_name or step.node_name or str(i)
+                for i, step in enumerate(node.steps)
+            ]
+            if len(set(keys)) != len(keys):
+                raise GraphExecutionError(
+                    500, "ensemble steps need distinct names (set step.name)")
+            pending = {
+                key: self._pool.submit(self._run_step, step, payload, original)
+                for key, step in zip(keys, node.steps)
+            }
+            return {k: f.result() for k, f in pending.items()}
+        if node.router_type == "Splitter":
+            weights = [1 if s.weight is None else s.weight for s in node.steps]
+            if any(w < 0 for w in weights):
+                raise GraphExecutionError(500, "splitter weights must be >= 0")
+            total = sum(weights)
+            if total <= 0 or not node.steps:
+                raise GraphExecutionError(500, "splitter has no weighted steps")
+            # strict < so an explicit weight=0 step can never win (kserve
+            # semantics: zero weight = drained, no traffic)
+            pick = random.random() * total
+            acc = 0.0
+            for step, w in zip(node.steps, weights):
+                acc += w
+                if pick < acc:
+                    return self._run_step(step, payload, original)
+            return self._run_step(
+                max(zip(node.steps, weights), key=lambda sw: sw[1])[0],
+                payload, original)
+        if node.router_type != "Sequence":
+            raise GraphExecutionError(
+                500, f"unknown router_type {node.router_type!r}")
         out = payload
         for step in node.steps:
             data = original if step.data == "$request" else out
@@ -181,6 +224,7 @@ class GraphRouter:
         self._httpd.shutdown()
         self._httpd.server_close()
         self._thread.join(timeout=2)
+        self.executor._pool.shutdown(wait=False, cancel_futures=True)
 
 
 class InferenceGraphController(Controller):

@@ -50,6 +50,8 @@ class TrainConfig:
     grad_clip_norm: float = 1.0
     b1: float = 0.9
     b2: float = 0.95
+    #: dtype of AdamW's first moment (HBM-bandwidth lever; None = f32)
+    mu_dtype: Optional[Any] = jnp.bfloat16
     checkpoint_dir: Optional[str] = None
     save_interval_steps: int = 100
     log_every: int = 10
@@ -96,6 +98,12 @@ class Trainer:
                     0.0, cfg.learning_rate, cfg.warmup_steps,
                     max(cfg.steps, cfg.warmup_steps + 1)),
                 b1=cfg.b1, b2=cfg.b2, weight_decay=cfg.weight_decay,
+                # bf16 first moment: halves mu's HBM read+write per step
+                # (the optimizer update is pure bandwidth); nu stays f32 —
+                # second moments span a wide dynamic range and bf16 there
+                # measurably hurts convergence, bf16 mu does not (standard
+                # large-scale practice)
+                mu_dtype=cfg.mu_dtype,
             ),
         )
         self.batch_sharding = meshlib.batch_sharding(self.mesh)

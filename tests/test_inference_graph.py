@@ -191,3 +191,43 @@ class TestGraphE2E:
         # request through the router while not ready -> 503
         code, out = _post(g.status.url, {"instances": [1]})
         assert code == 503
+
+
+class TestEnsembleAndSplitter:
+    def test_ensemble_merges_parallel_outputs(self, graph_cluster):
+        graph_cluster.store.create(InferenceGraph(
+            metadata=ObjectMeta(name="ens"),
+            spec=InferenceGraphSpec(nodes={
+                "root": GraphNode(router_type="Ensemble", steps=[
+                    GraphStep(service_name="inc"),
+                    GraphStep(service_name="dbl"),
+                ])})))
+        g = _wait_phase(graph_cluster, "InferenceGraph", "ens")
+        code, out = _post(g.status.url, {"instances": [3, 4]})
+        assert code == 200
+        assert out["inc"]["predictions"] == [4, 5]
+        assert out["dbl"]["predictions"] == [6, 8]
+
+    def test_splitter_routes_by_weight(self, graph_cluster):
+        # all weight on "dbl": deterministic despite the random draw
+        graph_cluster.store.create(InferenceGraph(
+            metadata=ObjectMeta(name="split"),
+            spec=InferenceGraphSpec(nodes={
+                "root": GraphNode(router_type="Splitter", steps=[
+                    GraphStep(service_name="inc", weight=0),
+                    GraphStep(service_name="dbl", weight=100),
+                ])})))
+        g = _wait_phase(graph_cluster, "InferenceGraph", "split")
+        for _ in range(5):
+            code, out = _post(g.status.url, {"instances": [3]})
+            assert code == 200 and out["predictions"] == [6]
+
+    def test_unknown_router_type_500(self, graph_cluster):
+        graph_cluster.store.create(InferenceGraph(
+            metadata=ObjectMeta(name="bad"),
+            spec=InferenceGraphSpec(nodes={
+                "root": GraphNode(router_type="Mystery", steps=[
+                    GraphStep(service_name="inc")])})))
+        g = _wait_phase(graph_cluster, "InferenceGraph", "bad")
+        code, out = _post(g.status.url, {"instances": [1]})
+        assert code == 500 and "Mystery" in out["error"]
