@@ -68,6 +68,11 @@ class LlamaConfig:
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
     moe_normalize_topk: bool = True
+    #: token-embedding lookup: False = gather from an explicitly
+    #: replicated table (default; one ICI all-gather per step); True =
+    #: one-hot matmul, no table gather (prefer under heavy vocab/TP
+    #: sharding where replicating the table is the bottleneck)
+    embed_one_hot: bool = False
 
     @property
     def q_per_kv(self) -> int:
@@ -359,7 +364,25 @@ class Embedder(nn.Module):
         )
 
     def __call__(self, tokens: jax.Array) -> jax.Array:
-        x = self.embedding.astype(self.cfg.dtype)[tokens]
+        table = self.embedding.astype(self.cfg.dtype)
+        # deliberate, mode-independent OOB semantics: clamp like the
+        # pre-r3 `table[tokens]` gather did (jnp.take would NaN-fill,
+        # one-hot would zero-fill — two silent divergences otherwise)
+        tokens = jnp.clip(tokens, 0, self.cfg.vocab_size - 1)
+        if self.cfg.embed_one_hot:
+            # one-hot matmul: contraction over the sharded vocab dim turns
+            # into a clean psum — no table gather at all.  Costs b*s*v*e
+            # MACs on the MXU; right when vocab-sharding is heavy (big TP)
+            oh = jax.nn.one_hot(tokens, self.cfg.vocab_size, dtype=self.cfg.dtype)
+            x = jnp.einsum("bsv,ve->bse", oh, table)
+        else:
+            # explicitly replicate the table before the lookup: SPMD would
+            # otherwise do the same replication "involuntarily" per its
+            # last-resort warning, but through an inefficient reshard of
+            # the gather result.  64MB bf16 at 32k vocab — an ICI
+            # all-gather, amortized across the whole batch's lookups.
+            table = nn.with_logical_constraint(table, (None, None))
+            x = jnp.take(table, tokens, axis=0, mode="clip")
         return nn.with_logical_constraint(x, ("batch", "act_seq", "act_embed"))
 
     def table(self) -> jax.Array:

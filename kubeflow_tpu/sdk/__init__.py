@@ -1,5 +1,25 @@
-"""Python SDK."""
+"""Python SDK — the three reference clients rebuilt over this platform:
+TrainingClient (kubeflow-training), KatibClient (kubeflow-katib),
+KServeClient (kserve)."""
 
 from .client import JobTimeoutError, TrainingClient
+from .katib import (
+    ExperimentTimeoutError,
+    KatibClient,
+    search_categorical,
+    search_double,
+    search_int,
+)
+from .kserve import IsvcTimeoutError, KServeClient
 
-__all__ = ["JobTimeoutError", "TrainingClient"]
+__all__ = [
+    "ExperimentTimeoutError",
+    "IsvcTimeoutError",
+    "JobTimeoutError",
+    "KServeClient",
+    "KatibClient",
+    "TrainingClient",
+    "search_categorical",
+    "search_double",
+    "search_int",
+]

@@ -149,3 +149,26 @@ def test_decode_cache_matches_full_forward(tiny_setup):
         outs.append(np.asarray(logits[:, 0]))
     decoded = np.stack(outs, axis=1)
     np.testing.assert_allclose(decoded, full, atol=2e-3, rtol=2e-3)
+
+
+@pytest.mark.parametrize("axes", [None, {"data": 4, "model": 2}])
+def test_one_hot_embed_matches_gather(tiny_setup, axes):
+    """embed_one_hot (the heavy-TP lookup) computes the identical forward —
+    on one device and compiled under the sharded mesh it exists for.
+    Varied token ids (incl. one out-of-bounds, which both paths clamp)."""
+    cfg, model, _, params = tiny_setup
+    rng = np.random.default_rng(0)
+    toks_np = rng.integers(0, cfg.vocab_size, size=(8, 32)).astype(np.int32)
+    toks_np[0, 0] = cfg.vocab_size + 7  # OOB: clamped identically by both
+    toks = jnp.asarray(toks_np)
+    oh_model = llama.Llama(llama.tiny(embed_one_hot=True))
+    a = np.asarray(model.apply(params, toks))
+    if axes is None:
+        b = np.asarray(oh_model.apply(params, toks))
+    else:
+        mesh = meshlib.build_mesh(axes)
+        p = jax.device_put(params, shardlib.param_shardings(params, mesh))
+        t = jax.device_put(toks, meshlib.batch_sharding(mesh))
+        with shardlib.shard_context(mesh):
+            b = np.asarray(jax.jit(oh_model.apply)(p, t))
+    np.testing.assert_allclose(a, b, atol=2e-4, rtol=2e-4)

@@ -1,0 +1,79 @@
+"""SDK clients: KatibClient.tune() over real trial processes and
+KServeClient CRUD + data plane (the reference's three Python clients)."""
+
+import pytest
+
+from kubeflow_tpu.api.common import ObjectMeta
+from kubeflow_tpu.api.inference import (
+    ComponentSpec,
+    InferenceService,
+    InferenceServiceSpec,
+)
+from kubeflow_tpu.controlplane.cluster import Cluster
+from kubeflow_tpu.runtime.platform import LocalPlatform
+from kubeflow_tpu.sdk import KatibClient, KServeClient, search_double
+
+
+@pytest.mark.e2e
+class TestKatibClient:
+    def test_tune_one_call(self, tmp_path):
+        with LocalPlatform(num_hosts=2, chips_per_host=4,
+                           root_dir=str(tmp_path)) as p:
+            client = KatibClient(p)
+            exp = client.tune(
+                name="lr-sweep",
+                entrypoint="tests.hpo_objective:objective_main",
+                parameters={"lr": search_double(0.001, 0.1)},
+                objective_metric="score",
+                algorithm="tpe",
+                max_trials=4,
+                parallel_trials=2,
+                timeout=300,
+            )
+            assert exp.status.completed
+            assert exp.status.trials_succeeded == 4
+            best = client.get_optimal_hyperparameters("lr-sweep")
+            assert best["value"] is not None
+            assert 0.001 <= best["assignments"]["lr"] <= 0.1
+            trials = client.list_trials("lr-sweep")
+            assert len(trials) == 4
+            assert all(t.status.phase == "Succeeded" for t in trials)
+
+
+class TestKServeClient:
+    def test_crud_wait_predict_explain(self):
+        cluster = Cluster()
+        cluster.add_tpu_slice("s0", 1, 4)
+        cluster.enable_serving()
+        with cluster:
+            client = KServeClient(cluster)
+            client.create(InferenceService(
+                metadata=ObjectMeta(name="svc"),
+                spec=InferenceServiceSpec(
+                    predictor=ComponentSpec(
+                        handler="tests.test_serving:FirstTwoSum"),
+                    explainer=ComponentSpec(
+                        handler="kubeflow_tpu.serving.explainer:OcclusionExplainer",
+                        config={"num_segments": 4}),
+                )))
+            isvc = client.wait_isvc_ready("svc")
+            assert isvc.status.url
+            assert client.predict("svc", [[1.0, 2.0, 5.0, 5.0]]) == [3.0]
+            exp = client.explain("svc", [[3.0, 5.0, 1.0, 2.0]])
+            assert exp[0]["attributions"] == [3.0, 5.0, 0.0, 0.0]
+            client.delete("svc")
+            assert client.get("svc") is None
+
+    def test_wait_surfaces_failure(self):
+        cluster = Cluster()
+        cluster.add_tpu_slice("s0", 1, 4)
+        cluster.enable_serving()
+        with cluster:
+            client = KServeClient(cluster)
+            client.create({
+                "kind": "InferenceService",
+                "metadata": {"name": "bad"},
+                "spec": {"predictor": {"modelFormat": {"name": "mystery"}}},
+            })
+            with pytest.raises(RuntimeError, match="mystery"):
+                client.wait_isvc_ready("bad", timeout=20)
