@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import math
 import random as pyrandom
+import re
 from dataclasses import dataclass, field
 from typing import Optional, Sequence
 
@@ -37,6 +38,9 @@ class Observation:
 
     assignments: dict[str, object]
     value: float
+    #: trial name (issue-ordered); population-based algorithms use it to
+    #: name checkpoint-fork parents
+    trial: Optional[str] = None
 
 
 @dataclass
@@ -379,9 +383,119 @@ def _inv_sqrt(mat: np.ndarray) -> np.ndarray:
     return vecs @ np.diag(vals ** -0.5) @ vecs.T
 
 
+#: reserved assignment key PBT emits: name of the trial whose checkpoint
+#: the new trial forks from ("" = fresh start).  Trial templates map it to
+#: the runtime's resume env (e.g. KFT_RESUME_FROM).
+PBT_PARENT_KEY = "__parent"
+
+
+class Pbt(Suggester):
+    """Population Based Training [Jade+ 2017; reference analog: Katib's PBT
+    suggestion service, pkg/suggestion/v1beta1/pbt].
+
+    Trials form generations of ``population_size``.  Each member of
+    generation g+1 continues SOME generation-g member's training from its
+    checkpoint: survivors (top 1-truncation by objective) continue
+    themselves with unchanged hyperparameters; the bottom ``truncation``
+    fraction is replaced by exploit+explore — fork a random top member's
+    checkpoint and perturb its hyperparameters (continuous: x1.2 / /1.2;
+    categorical: resampled with ``resample_prob``).
+
+    The fork edge travels as the reserved ``__parent`` assignment
+    (PBT_PARENT_KEY): the trial template maps it into the trainer's
+    resume-from env, and the trainer copies the parent's checkpoint before
+    training (train/llm.py KFT_PBT_ROOT contract).  Stateless like every
+    suggester: generations are reconstructed from issue-ordered history.
+
+    settings: population_size (default 4), truncation (default 0.25),
+    perturb_factor (default 1.2), resample_prob (default 0.25).
+    """
+
+    name = "pbt"
+
+    def suggest(self, req: SuggestRequest) -> list[dict[str, object]]:
+        pop = max(2, int(req.settings.get("population_size", "4")))
+        truncation = float(req.settings.get("truncation", "0.25"))
+        factor = float(req.settings.get("perturb_factor", "1.2"))
+        resample_prob = float(req.settings.get("resample_prob", "0.25"))
+        seed = req.seed if req.seed is not None else 0
+        cursor = max(req.issued, len(req.history))
+
+        # slot-index observations by the trial name's issue index, so a
+        # Failed trial (absent from history) is just a hole in its
+        # generation rather than a permanent misalignment of every chunk
+        by_index: dict[int, Observation] = {}
+        for pos, ob in enumerate(req.history):
+            m = re.search(r"(\d+)$", ob.trial or "")
+            by_index[int(m.group(1)) if m else pos] = ob
+
+        out: list[dict[str, object]] = []
+        sign = -1.0 if req.objective_type == ObjectiveType.MINIMIZE else 1.0
+        for i in range(req.count):
+            slot_index = cursor + i
+            gen, slot = divmod(slot_index, pop)
+            rng = pyrandom.Random(seed * 1_000_003 + slot_index)
+            prev = {
+                j: by_index.get((gen - 1) * pop + j) for j in range(pop)
+            } if gen > 0 else {}
+            present = [j for j, ob in prev.items() if ob is not None]
+            if gen == 0 or len(present) < 2:
+                # first generation, or too few survivors to rank: fresh
+                a = {p.name: _sample_one(p, rng) for p in req.parameters}
+                a[PBT_PARENT_KEY] = ""
+                out.append(a)
+                continue
+            ranked = sorted(
+                present, key=lambda j: sign * prev[j].value, reverse=True)
+            n_cut = max(1, int(round(len(present) * truncation)))
+            rank_of = {j: r for r, j in enumerate(ranked)}
+            member = prev.get(slot)
+            if member is None or rank_of[slot] >= len(present) - n_cut:
+                # exploit (slot's lineage failed, or ranked in the bottom
+                # truncation): fork a random top member + explore
+                donor = prev[rng.choice(ranked[:n_cut])]
+                a = self._explore(
+                    donor.assignments, req.parameters, rng, factor,
+                    resample_prob)
+                a[PBT_PARENT_KEY] = donor.trial or ""
+            else:
+                # survivor: continue own lineage unchanged
+                a = {
+                    p.name: member.assignments[p.name] for p in req.parameters
+                }
+                a[PBT_PARENT_KEY] = member.trial or ""
+            out.append(a)
+        return out
+
+    def _explore(
+        self,
+        assignments: dict[str, object],
+        parameters: list[ParameterSpec],
+        rng: pyrandom.Random,
+        factor: float,
+        resample_prob: float,
+    ) -> dict[str, object]:
+        out: dict[str, object] = {}
+        for p in parameters:
+            v = assignments[p.name]
+            fs = p.feasible_space
+            if p.parameter_type == ParameterType.DOUBLE:
+                f = factor if rng.random() < 0.5 else 1.0 / factor
+                out[p.name] = min(max(float(v) * f, fs.min), fs.max)
+            elif p.parameter_type == ParameterType.INT:
+                f = factor if rng.random() < 0.5 else 1.0 / factor
+                out[p.name] = int(min(max(round(int(v) * f), fs.min), fs.max))
+            else:
+                out[p.name] = (
+                    rng.choice(list(fs.list_))
+                    if rng.random() < resample_prob else v
+                )
+        return out
+
+
 REGISTRY: dict[str, type[Suggester]] = {
     cls.name: cls
-    for cls in (RandomSearch, GridSearch, Tpe, BayesianOptimization, CmaEs)
+    for cls in (RandomSearch, GridSearch, Tpe, BayesianOptimization, CmaEs, Pbt)
 }
 
 

@@ -427,6 +427,7 @@ class SuggestionController(Controller):
                 seen[t.metadata.name] = algorithms.Observation(
                     assignments={a.name: a.value for a in t.spec.assignments},
                     value=t.status.observation,
+                    trial=t.metadata.name,
                 )
         # fold in the durable store (keyed by trial name, live objects win):
         # after a restart the algorithm keeps its full optimization history
@@ -439,7 +440,8 @@ class SuggestionController(Controller):
                         and rec["trial"] not in seen
                     ):
                         seen[rec["trial"]] = algorithms.Observation(
-                            assignments=rec["assignments"], value=rec["value"])
+                            assignments=rec["assignments"], value=rec["value"],
+                            trial=rec["trial"])
             except Exception:  # noqa: BLE001 — db unavailable: use live view
                 pass
         # issue order (trial names are zero-padded, so name order == issue

@@ -109,7 +109,9 @@ class SuggestionClient:
                 "parameters": [p.model_dump(mode="json") for p in parameters],
                 "objective_type": objective_type.value,
                 "history": [
-                    {"assignments": ob.assignments, "value": ob.value} for ob in history
+                    {"assignments": ob.assignments, "value": ob.value,
+                     "trial": ob.trial}
+                    for ob in history
                 ],
                 "count": count,
                 "settings": settings or {},

@@ -13,6 +13,12 @@ Env knobs (all optional):
                     through the native packing pipeline (train/native_data);
                     unset = hermetic SyntheticLm stream
   KFT_EOS_ID        EOS separator id for corpus packing (default 0)
+  KFT_PBT_ROOT      population-based-training checkpoint root: this job
+                    checkpoints under <root>/<job_name>, and when
+                    KFT_RESUME_FROM names a sibling trial (the PBT
+                    suggester's __parent assignment), its checkpoint is
+                    forked before training — the exploit step
+  KFT_RESUME_FROM   parent trial name to fork from ("" = fresh)
 """
 
 from __future__ import annotations
@@ -26,19 +32,83 @@ from ..runtime import bootstrap
 from . import trainer as trainlib
 
 
+PBT_BASE_STEP_FILE = "pbt_base_step"
+
+
+def _latest_step_on_disk(ckpt_dir: str) -> int:
+    """Largest completed step directory (orbax layout: int-named subdirs);
+    no CheckpointManager instantiation, so it is cheap and side-effect-free."""
+    try:
+        steps = [int(n) for n in os.listdir(ckpt_dir) if n.isdigit()]
+    except OSError:
+        return 0
+    return max(steps, default=0)
+
+
+def _pbt_checkpoint_dir(ctx: "bootstrap.PodContext") -> "str | None":
+    """PBT checkpoint-fork contract: own dir under KFT_PBT_ROOT; exploit =
+    copy the parent trial's checkpoints before first save/restore.  Only
+    the coordinator forks; every rank then syncs before restoring.  The
+    fork baseline step is recorded ONCE (``pbt_base_step``) so a gang
+    restart mid-trial keeps the original training horizon instead of
+    re-deriving it from the live checkpoint dir."""
+    import shutil
+
+    root = os.environ.get("KFT_PBT_ROOT")
+    if not root:
+        return None
+    own = os.path.join(root, ctx.job_name)
+    parent = os.environ.get("KFT_RESUME_FROM", "").strip()
+    if ctx.is_coordinator and not os.path.isdir(own):
+        if parent:
+            parent_dir = os.path.join(root, parent)
+            if not os.path.isdir(parent_dir):
+                # a fork of nothing must fail, not silently train from
+                # scratch while ranked against continued lineages
+                raise RuntimeError(
+                    f"PBT fork parent {parent!r} has no checkpoint dir "
+                    f"under {root}; refusing to start from scratch")
+            shutil.copytree(parent_dir, own)
+        else:
+            os.makedirs(own, exist_ok=True)
+        # overwrite any marker copied from the parent: OUR baseline is the
+        # parent's latest step, not the parent's own fork baseline
+        with open(os.path.join(own, PBT_BASE_STEP_FILE), "w") as f:
+            f.write(str(_latest_step_on_disk(own)))
+    if ctx.num_processes > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(f"{ctx.job_name}-pbt-fork")
+    return own
+
+
+def _pbt_base_step(ckpt_dir: str) -> int:
+    try:
+        with open(os.path.join(ckpt_dir, PBT_BASE_STEP_FILE)) as f:
+            return int(f.read().strip())
+    except (OSError, ValueError):
+        return 0
+
+
 def config_from_env(ctx: "bootstrap.PodContext") -> trainlib.TrainConfig:
     e = os.environ
     preset = e.get("KFT_MODEL_PRESET", "tiny")
     model = llamalib.PRESETS[preset]()
+    ckpt_dir = _pbt_checkpoint_dir(ctx) or e.get("KFT_CKPT_DIR") or None
+    steps = int(e.get("KFT_STEPS", "10"))
+    if e.get("KFT_PBT_ROOT") and ckpt_dir:
+        # PBT semantics: KFT_STEPS means "this many MORE steps" past the
+        # fork baseline recorded at fork time — stable across gang restarts
+        steps += _pbt_base_step(ckpt_dir)
     return trainlib.TrainConfig(
         model=model,
         mesh_axes=dict(ctx.mesh_axes),
         global_batch=int(e.get("KFT_BATCH", "8")),
         seq_len=int(e.get("KFT_SEQ_LEN", "64")),
-        steps=int(e.get("KFT_STEPS", "10")),
+        steps=steps,
         learning_rate=float(e.get("KFT_LR", "3e-4")),
         warmup_steps=int(e.get("KFT_WARMUP", "5")),
-        checkpoint_dir=e.get("KFT_CKPT_DIR") or None,
+        checkpoint_dir=ckpt_dir,
         save_interval_steps=int(e.get("KFT_SAVE_EVERY", "100")),
         log_every=int(e.get("KFT_LOG_EVERY", "5")),
     )

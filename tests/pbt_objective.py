@@ -1,0 +1,31 @@
+"""PBT e2e trial entrypoint: file-state 'training' that accumulates across
+checkpoint forks.
+
+theta lives in <KFT_PBT_ROOT>/<trial>/theta; a forked trial (KFT_RESUME_FROM)
+starts from its parent's theta — exactly the exploit step's contract.  Each
+generation adds 1 - (lr - 0.03)^2 * 100 (maximized at lr=0.03, max 1.0), so
+any score > 1.0 proves a fork actually carried state forward.
+"""
+
+import os
+
+from kubeflow_tpu.runtime import bootstrap
+
+
+def objective_main(ctx) -> None:
+    root = os.environ["KFT_PBT_ROOT"]
+    own = os.path.join(root, ctx.job_name)
+    parent = os.environ.get("KFT_RESUME_FROM", "").strip()
+    theta = 0.0
+    if parent:
+        try:
+            with open(os.path.join(root, parent, "theta")) as f:
+                theta = float(f.read())
+        except OSError:
+            pass
+    lr = float(os.environ.get("KFT_LR", "0.1"))
+    theta += 1.0 - (lr - 0.03) ** 2 * 100.0
+    os.makedirs(own, exist_ok=True)
+    with open(os.path.join(own, "theta"), "w") as f:
+        f.write(str(theta))
+    bootstrap.emit_metric(ctx, "score", theta)

@@ -225,3 +225,25 @@ spec:
         assert final.spec.mesh == {"data": 2}
         final_steps = [d for d in os.listdir(ckpt_dir) if d.isdigit()]
         assert max(int(s) for s in final_steps) == 40
+
+    def test_pbt_fork_resumes_parent_checkpoint(self, platform, tmp_path):
+        """PBT contract in the real trainer: a fork starts at the parent's
+        step and KFT_STEPS means 'this many MORE steps'."""
+        client = TrainingClient(platform)
+        root = str(tmp_path / "pbt")
+        common = {"KFT_PBT_ROOT": root, "KFT_BATCH": "8",
+                  "KFT_SEQ_LEN": "32", "KFT_STEPS": "4",
+                  "KFT_SAVE_EVERY": "2", "KFT_LOG_EVERY": "2"}
+        client.train(name="pbt-a", entrypoint="kubeflow_tpu.train.llm:train_main",
+                     num_workers=1, env=dict(common), timeout=240)
+        client.train(name="pbt-b", entrypoint="kubeflow_tpu.train.llm:train_main",
+                     num_workers=1,
+                     env={**common, "KFT_RESUME_FROM": "pbt-a"}, timeout=240)
+        logs = client.get_job_logs("pbt-b")["pbt-b-worker-0"]
+        resume = [l for l in logs.splitlines() if l.startswith("resume_step=")]
+        assert resume and float(resume[0].split("=")[1]) == 4.0
+        # fork baseline marker survives and the horizon extended to 8
+        import os
+        assert open(os.path.join(root, "pbt-b", "pbt_base_step")).read() == "4"
+        steps = [l for l in logs.splitlines() if l.startswith("loss=")]
+        assert steps  # trained past the fork

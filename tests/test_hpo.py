@@ -559,3 +559,112 @@ def test_hpo_e2e_real_processes():
         assert exp is not None and exp.status.completed, exp.status if exp else None
         assert exp.status.trials_succeeded == 4
         assert exp.status.current_optimal_value is not None
+
+
+class TestPbt:
+    def _req(self, history, count=1, issued=None, pop=4):
+        return alg.SuggestRequest(
+            parameters=[DOUBLE_LR],
+            objective_type=ObjectiveType.MAXIMIZE,
+            history=history,
+            count=count,
+            settings={"population_size": str(pop), "truncation": "0.25"},
+            seed=3,
+            issued=len(history) if issued is None else issued,
+        )
+
+    def test_generation_zero_is_fresh(self):
+        out = alg.get_suggester("pbt").suggest(self._req([], count=4))
+        assert len(out) == 4
+        for a in out:
+            assert a[alg.PBT_PARENT_KEY] == ""
+            assert 0.001 <= a["lr"] <= 0.1
+
+    def test_survivors_continue_losers_fork_top(self):
+        gen0 = [
+            alg.Observation({"lr": 0.03}, value=1.0, trial="e-t0000"),
+            alg.Observation({"lr": 0.05}, value=0.9, trial="e-t0001"),
+            alg.Observation({"lr": 0.08}, value=0.5, trial="e-t0002"),
+            alg.Observation({"lr": 0.10}, value=0.1, trial="e-t0003"),
+        ]
+        out = alg.get_suggester("pbt").suggest(self._req(gen0, count=4))
+        # truncation 0.25 of pop 4 -> exactly the worst member is replaced
+        for slot in (0, 1, 2):
+            assert out[slot][alg.PBT_PARENT_KEY] == gen0[slot].trial
+            assert out[slot]["lr"] == gen0[slot].assignments["lr"]
+        loser = out[3]
+        assert loser[alg.PBT_PARENT_KEY] == "e-t0000"  # forked the best
+        # explored: perturbed off the donor's value, clamped to the space
+        assert loser["lr"] != 0.03
+        assert 0.001 <= loser["lr"] <= 0.1
+
+
+    def test_failed_trial_leaves_a_hole_not_misalignment(self):
+        """A Failed trial (absent from history) must not degrade PBT to
+        random sampling — remaining members still rank and fork."""
+        gen0 = [
+            alg.Observation({"lr": 0.03}, value=1.0, trial="e-t0000"),
+            # e-t0001 failed: no observation
+            alg.Observation({"lr": 0.08}, value=0.5, trial="e-t0002"),
+            alg.Observation({"lr": 0.10}, value=0.1, trial="e-t0003"),
+        ]
+        out = alg.get_suggester("pbt").suggest(
+            self._req(gen0, count=4, issued=4))
+        # survivors continue; the failed slot and the worst slot fork a top
+        assert out[0][alg.PBT_PARENT_KEY] == "e-t0000"
+        assert out[1][alg.PBT_PARENT_KEY] == "e-t0000"  # hole -> exploit
+        assert out[2][alg.PBT_PARENT_KEY] == "e-t0002"
+        assert out[3][alg.PBT_PARENT_KEY] == "e-t0000"  # worst -> exploit
+
+    def test_stateless_replay(self):
+        gen0 = [
+            alg.Observation({"lr": v}, value=s, trial=f"e-t{i:04d}")
+            for i, (v, s) in enumerate(
+                [(0.03, 1.0), (0.05, 0.9), (0.08, 0.5), (0.1, 0.1)])
+        ]
+        a = alg.get_suggester("pbt").suggest(self._req(gen0, count=4))
+        b = alg.get_suggester("pbt").suggest(self._req(gen0, count=4))
+        assert a == b
+
+
+@pytest.mark.e2e
+class TestPbtE2E:
+    def test_forked_lineage_beats_single_generation(self, tmp_path):
+        """Closed loop over real trial processes: scores > 1.0 are only
+        reachable by continuing a parent's state, so the optimum proves the
+        checkpoint-fork contract end to end."""
+        from kubeflow_tpu.runtime.platform import LocalPlatform
+        from kubeflow_tpu.sdk import KatibClient, search_double
+
+        pbt_root = str(tmp_path / "pbt")
+        with LocalPlatform(num_hosts=2, chips_per_host=4,
+                           root_dir=str(tmp_path / "plat")) as p:
+            client = KatibClient(p)
+            exp = client.tune(
+                name="pbt-loop",
+                entrypoint="tests.pbt_objective:objective_main",
+                parameters={"lr": search_double(0.001, 0.1)},
+                objective_metric="score",
+                algorithm="pbt",
+                algorithm_settings={
+                    "population_size": "3", "truncation": "0.34"},
+                max_trials=9,
+                parallel_trials=3,
+                base_env={
+                    "KFT_PBT_ROOT": pbt_root,
+                    "KFT_RESUME_FROM": "${trialParameters.__parent}",
+                },
+                timeout=400,
+            )
+            assert exp.status.completed
+            assert exp.status.trials_succeeded == 9
+            best = client.get_optimal_hyperparameters("pbt-loop")
+            assert best["value"] > 1.0, best  # impossible without forking
+            # at least one later-generation trial carries a fork edge
+            parents = [
+                a.value
+                for t in client.list_trials("pbt-loop")
+                for a in t.spec.assignments
+                if a.name == alg.PBT_PARENT_KEY
+            ]
+            assert any(parents[3:]), parents
