@@ -165,6 +165,17 @@ class ModelServer:
         self.metrics = ServerMetrics()
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
+        self._grpc = None
+
+    def enable_grpc(self, port: Optional[int] = None) -> str:
+        """Serve the V2 protocol over gRPC too (kserve's grpc_port analog);
+        both wire formats share this repository + micro-batcher.  Returns
+        the gRPC address."""
+        from .grpc_server import GrpcInferenceServer
+
+        if self._grpc is None:
+            self._grpc = GrpcInferenceServer(self, port=port).start()
+        return self._grpc.address
 
     @property
     def url(self) -> str:
@@ -224,6 +235,9 @@ class ModelServer:
         return self
 
     def stop(self) -> None:
+        if self._grpc is not None:
+            self._grpc.stop()
+            self._grpc = None
         for name in list(self._models):
             self.unregister(name)
         if self._httpd:
@@ -341,30 +355,38 @@ class ModelServer:
             self.metrics.observe(name, time.perf_counter() - t0, error=True)
             h._send(500, {"error": f"{type(e).__name__}: {e}"})
 
+    @staticmethod
+    def v2_to_instances(payload: dict) -> list:
+        """V2 request tensors -> row-major instances of the first input
+        (shared by the HTTP and gRPC wire formats)."""
+        first = payload["inputs"][0]
+        data, shape = first["data"], first.get("shape", [len(first["data"])])
+        batch = shape[0] if shape else len(data)
+        per = max(1, len(data) // max(batch, 1))
+        return [
+            data[i * per : (i + 1) * per] if per > 1 else data[i]
+            for i in range(batch)
+        ]
+
+    @staticmethod
+    def v2_response(name: str, out: list) -> dict:
+        return {
+            "model_name": name,
+            "outputs": [{
+                "name": "output0",
+                "shape": [len(out)],
+                "datatype": "FP32",
+                "data": out,
+            }],
+        }
+
     def _predict_v2(self, h, name: str, payload: dict) -> None:
         t0 = time.perf_counter()
         try:
-            inputs = payload["inputs"]
-            # V2 tensors -> row-major instances of the first input
-            first = inputs[0]
-            data, shape = first["data"], first.get("shape", [len(first["data"])])
-            batch = shape[0] if shape else len(data)
-            per = max(1, len(data) // max(batch, 1))
-            instances = [
-                data[i * per : (i + 1) * per] if per > 1 else data[i]
-                for i in range(batch)
-            ]
+            instances = self.v2_to_instances(payload)
             out = self._dispatch(name, instances)
             self.metrics.observe(name, time.perf_counter() - t0, error=False)
-            h._send(200, {
-                "model_name": name,
-                "outputs": [{
-                    "name": "output0",
-                    "shape": [len(out)],
-                    "datatype": "FP32",
-                    "data": out,
-                }],
-            })
+            h._send(200, self.v2_response(name, out))
         except KeyError as e:
             self.metrics.observe(name, time.perf_counter() - t0, error=True)
             h._send(404 if str(e).strip("'") == name else 400, {"error": str(e)})

@@ -1,0 +1,75 @@
+"""Long-context attention benchmark: Pallas flash vs XLA dense, fwd+bwd.
+
+Substantiates the long-context claim (SURVEY §5 long-context row) with
+measured numbers: per-step attention grad time over sequence lengths at a
+fixed token budget (batch shrinks as seq grows, so each row does the same
+non-attention work).  Prints one JSON line per (impl, seq).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, ".")
+
+from kubeflow_tpu.ops.flash_attention import flash_attention  # noqa: E402
+
+TOKEN_BUDGET = 16384  # batch * seq held constant
+HEADS, HEAD_DIM = 8, 128
+REPS = 10
+
+
+def dense_ref(q, k, v):
+    _, s, _, _ = q.shape
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
+    scores = scores / jnp.sqrt(q.shape[-1]).astype(jnp.float32)
+    mask = jnp.tril(jnp.ones((s, s), bool))
+    scores = jnp.where(mask[None, None], scores, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def bench(fn, q, k, v) -> float:
+    f = jax.jit(jax.grad(
+        lambda q, k, v: fn(q, k, v).astype(jnp.float32).sum(),
+        argnums=(0, 1, 2)))
+    out = f(q, k, v)
+    jax.device_get(out[0][0, 0, 0, 0])  # sync (axon-safe)
+    t0 = time.perf_counter()
+    for _ in range(REPS):
+        out = f(q, k, v)
+    jax.device_get(out[0][0, 0, 0, 0])
+    return (time.perf_counter() - t0) / REPS
+
+
+def main() -> None:
+    for seq in (1024, 2048, 4096, 8192):
+        b = max(1, TOKEN_BUDGET // seq)
+        ks = [jax.random.normal(jax.random.PRNGKey(i), (b, seq, HEADS, HEAD_DIM),
+                                jnp.bfloat16) for i in range(3)]
+        rows = {}
+        for name, fn in (("dense", dense_ref), ("flash", flash_attention)):
+            try:
+                rows[name] = bench(fn, *ks)
+            except Exception as e:  # noqa: BLE001 — e.g. dense OOM at long seq
+                rows[name] = None
+                rows[f"{name}_error"] = f"{type(e).__name__}"
+        speedup = (rows["dense"] / rows["flash"]
+                   if rows.get("dense") and rows.get("flash") else None)
+        print(json.dumps({
+            "seq": seq, "batch": b,
+            "dense_ms": round(rows["dense"] * 1e3, 2) if rows.get("dense") else None,
+            "flash_ms": round(rows["flash"] * 1e3, 2) if rows.get("flash") else None,
+            "flash_speedup": round(speedup, 2) if speedup else None,
+            **{k: v for k, v in rows.items() if k.endswith("_error")},
+        }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -358,3 +358,28 @@ class TestExplainer:
         code, out = _post(f"{isvc.status.url}/v1/models/exp:predict",
                           {"instances": [[1.0, 2.0, 9.0, 9.0]]})
         assert code == 200 and out["predictions"] == [3.0]
+
+
+class TestGrpcV2:
+    def test_v2_grpc_round_trip(self):
+        """The V2 protocol's second wire format: gRPC ModelInfer through the
+        same model repository + micro-batcher as HTTP."""
+        import grpc
+
+        from kubeflow_tpu.serving.grpc_server import GrpcInferenceClient
+
+        server = ModelServer()
+        server.register(Doubler("double"))
+        server.start()
+        addr = server.enable_grpc()  # kserve's grpc_port analog
+        try:
+            client = GrpcInferenceClient(addr)
+            assert client.server_live()
+            assert client.model_ready("double")
+            assert client.model_metadata("double")["platform"] == "kubeflow-tpu-jax"
+            assert client.infer("double", [1, 2, 3]) == [2.0, 4.0, 6.0]
+            with pytest.raises(grpc.RpcError):
+                client.infer("nope", [1])
+            client.close()
+        finally:
+            server.stop()  # stops the gRPC front too
