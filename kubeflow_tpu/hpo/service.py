@@ -11,26 +11,20 @@ the same request/response content as Katib's proto.
 
 from __future__ import annotations
 
-import json
 from concurrent import futures
 from typing import Optional
 
 import grpc
 
 from ..api.experiment import ObjectiveType, ParameterSpec
+from ..utils.grpcjson import bind_insecure
+from ..utils.grpcjson import deserialize as _deserialize
+from ..utils.grpcjson import serialize as _serialize
 from ..utils.net import allocate_port
 from . import algorithms
 
 SERVICE = "kubeflow_tpu.hpo.Suggestion"
 METHOD = f"/{SERVICE}/GetSuggestions"
-
-
-def _serialize(payload: dict) -> bytes:
-    return json.dumps(payload).encode()
-
-
-def _deserialize(data: bytes) -> dict:
-    return json.loads(data.decode())
 
 
 class _Handler(grpc.GenericRpcHandler):
@@ -72,7 +66,7 @@ class SuggestionServer:
         self.port = port or allocate_port()
         self._server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
         self._server.add_generic_rpc_handlers((_Handler(),))
-        self._server.add_insecure_port(f"127.0.0.1:{self.port}")
+        bind_insecure(self._server, "127.0.0.1", self.port)
 
     @property
     def address(self) -> str:

@@ -11,24 +11,16 @@ exact V2 message content.
 
 from __future__ import annotations
 
-import json
 from concurrent import futures
 from typing import Optional
 
 import grpc
 
+from ..utils.grpcjson import bind_insecure, deserialize as _de, serialize as _ser
 from ..utils.net import allocate_port
 from .server import ModelServer
 
 SERVICE = "inference.GRPCInferenceService"
-
-
-def _ser(payload: dict) -> bytes:
-    return json.dumps(payload).encode()
-
-
-def _de(data: bytes) -> dict:
-    return json.loads(data.decode())
 
 
 class _Handler(grpc.GenericRpcHandler):
@@ -75,13 +67,15 @@ class _Handler(grpc.GenericRpcHandler):
         import time
 
         name = request.get("model_name", "")
+        t0 = time.perf_counter()
         if name not in self.server.models():
+            self.server.metrics.observe(name, time.perf_counter() - t0, error=True)
             context.abort(grpc.StatusCode.NOT_FOUND,
                           f"model {name!r} not found")
-        t0 = time.perf_counter()
         try:
             instances = ModelServer.v2_to_instances(request)
         except (KeyError, IndexError, TypeError) as e:
+            self.server.metrics.observe(name, time.perf_counter() - t0, error=True)
             context.abort(grpc.StatusCode.INVALID_ARGUMENT,
                           f"malformed V2 request: {e}")
         try:
@@ -114,9 +108,7 @@ class GrpcInferenceServer:
         self._server = grpc.server(
             futures.ThreadPoolExecutor(max_workers=max_workers))
         self._server.add_generic_rpc_handlers((_Handler(model_server),))
-        bound = self._server.add_insecure_port(f"127.0.0.1:{self.port}")
-        if bound == 0:  # grpc signals bind failure by returning port 0
-            raise OSError(f"could not bind gRPC port {self.port}")
+        bind_insecure(self._server, "127.0.0.1", self.port)
 
     @property
     def address(self) -> str:
@@ -135,11 +127,15 @@ class GrpcInferenceClient:
 
     def __init__(self, address: str):
         self._channel = grpc.insecure_channel(address)
+        self._calls: dict = {}
 
     def _call(self, method: str, payload: dict, timeout: float = 30.0) -> dict:
-        fn = self._channel.unary_unary(
-            f"/{SERVICE}/{method}", request_serializer=_ser,
-            response_deserializer=_de)
+        fn = self._calls.get(method)
+        if fn is None:  # one multicallable per method, built once
+            fn = self._channel.unary_unary(
+                f"/{SERVICE}/{method}", request_serializer=_ser,
+                response_deserializer=_de)
+            self._calls[method] = fn
         return fn(payload, timeout=timeout)
 
     def server_live(self) -> bool:

@@ -175,6 +175,10 @@ class ModelServer:
 
         if self._grpc is None:
             self._grpc = GrpcInferenceServer(self, port=port).start()
+        elif port and self._grpc.port != port:
+            raise RuntimeError(
+                f"gRPC already serving on port {self._grpc.port}; "
+                f"cannot rebind to {port}")
         return self._grpc.address
 
     @property

@@ -1,0 +1,87 @@
+"""Serving-plane benchmark: KV-cache decode throughput + BERT classify
+latency on the local chip.
+
+Covers BASELINE config 3's serving side with measured numbers: the
+LlamaGenerator runtime's per-token decode rate (the TPU serving split:
+prefill + jitted single-token steps) and BertClassifierModel's padded-
+batch classify latency.  Prints one JSON line per row.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, ".")
+
+from __graft_entry__ import _bench_model  # noqa: E402
+from kubeflow_tpu.models import bert as bertlib  # noqa: E402
+from kubeflow_tpu.models import llama as llamalib  # noqa: E402
+from kubeflow_tpu.serving.runtimes import (  # noqa: E402
+    BertClassifierModel,
+    LlamaGenerator,
+)
+from kubeflow_tpu.serving.storage import register_mem  # noqa: E402
+
+
+def bench_decode(batch: int, prompt_len: int, new_tokens: int) -> dict:
+    cfg = _bench_model()
+    model = llamalib.Llama(cfg)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32))["params"]
+    ref = register_mem("bench-llama", (cfg, params))
+    g = LlamaGenerator("gen", {"params_ref": ref, "max_new_tokens": new_tokens})
+    g.start()
+    prompts = np.random.default_rng(0).integers(
+        1, cfg.vocab_size, size=(batch, prompt_len)).tolist()
+    g.predict_batch(prompts)  # compile prefill + decode
+    t0 = time.perf_counter()
+    out = g.predict_batch(prompts)
+    dt = time.perf_counter() - t0
+    assert len(out) == batch and all(len(o) == new_tokens for o in out)
+    return {
+        "metric": "llama_decode_tokens_per_sec",
+        "model": "271M", "batch": batch, "prompt_len": prompt_len,
+        "new_tokens": new_tokens,
+        "value": round(batch * new_tokens / dt, 1),
+        "ms_per_token": round(dt / new_tokens * 1e3, 2),
+    }
+
+
+def bench_bert(batch: int, seq: int) -> dict:
+    cfg = bertlib.bert_base(num_classes=2)
+    model = bertlib.BertClassifier(cfg)
+    params = model.init(jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32))
+    ref = register_mem("bench-bert", (cfg, params))
+    m = BertClassifierModel(
+        "bert", {"params_ref": ref, "buckets": (batch,), "seq_buckets": (seq,)})
+    m.start()
+    rows = np.random.default_rng(0).integers(
+        1, cfg.vocab_size, size=(batch, seq)).tolist()
+    m.predict_batch(rows)  # compile
+    reps = 10
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        m.predict_batch(rows)
+    dt = (time.perf_counter() - t0) / reps
+    return {
+        "metric": "bert_base_classify",
+        "batch": batch, "seq": seq,
+        "ms_per_batch": round(dt * 1e3, 2),
+        "sequences_per_sec": round(batch / dt, 1),
+    }
+
+
+def main() -> None:
+    print(json.dumps(bench_decode(batch=8, prompt_len=128, new_tokens=64)),
+          flush=True)
+    print(json.dumps(bench_bert(batch=8, seq=128)), flush=True)
+
+
+if __name__ == "__main__":
+    main()
