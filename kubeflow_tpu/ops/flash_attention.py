@@ -70,7 +70,7 @@ def _pad_to_tileable(s: int, want: int) -> int:
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
-                *, scale: float, block_q: int, block_k: int):
+                *, scale: float, block_q: int, block_k: int, causal: bool):
     qi, ki = pl.program_id(1), pl.program_id(2)
     nk = pl.num_programs(2)
 
@@ -80,20 +80,19 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    # causal block skip: compute only if some k position <= some q position
-    @pl.when(ki * block_k <= qi * block_q + block_q - 1)
-    def _compute():
+    def _body():
         q = q_ref[0].astype(jnp.float32) * scale          # [bq, d]
         k = k_ref[0].astype(jnp.float32)                  # [bk, d]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)           # [bq, bk]
-        # global causal mask; only bites on diagonal-straddling blocks
-        q_pos = qi * block_q + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 0)
-        k_pos = ki * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 1)
-        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        if causal:
+            # global causal mask; only bites on diagonal-straddling blocks
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
 
         m_prev, l_prev = m_scr[:], l_scr[:]
         m_new = jnp.maximum(m_prev, s.max(axis=1))
@@ -107,6 +106,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         acc_scr[:] = acc_scr[:] * corr[:, None] + pv
         m_scr[:] = m_new
 
+    if causal:
+        # causal block skip: compute only if some k pos <= some q pos
+        pl.when(ki * block_k <= qi * block_q + block_q - 1)(_body)
+    else:
+        _body()
+
     @pl.when(ki == nk - 1)
     def _finalize():
         l = jnp.maximum(l_scr[:], 1e-30)
@@ -118,7 +123,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
 
 
 def _fwd(q3, k3, v3, *, h: int, kv: int, scale: float,
-         block_q: int, block_k: int):
+         block_q: int, block_k: int, causal: bool = True):
     """q3: [b*h, s, d]; k3/v3: [b*kv, s, d] -> (o [b*h, s, d], lse [b*h, s])."""
     bh, s, d = q3.shape
     g = h // kv
@@ -128,7 +133,8 @@ def _fwd(q3, k3, v3, *, h: int, kv: int, scale: float,
         return ((bhi // h) * kv + (bhi % h) // g, ki, 0)
 
     kernel = functools.partial(
-        _fwd_kernel, scale=scale, block_q=block_q, block_k=block_k)
+        _fwd_kernel, scale=scale, block_q=block_q, block_k=block_k,
+        causal=causal)
     return pl.pallas_call(
         kernel,
         grid=(bh, nq, nk),
@@ -161,7 +167,7 @@ def _fwd(q3, k3, v3, *, h: int, kv: int, scale: float,
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, dk_scr, dv_scr,
-                    *, scale: float, block_q: int, block_k: int):
+                    *, scale: float, block_q: int, block_k: int, causal: bool):
     ki, qi = pl.program_id(1), pl.program_id(2)
     nq = pl.num_programs(2)
 
@@ -170,18 +176,18 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
-    @pl.when(qi * block_q + block_q - 1 >= ki * block_k)
-    def _compute():
+    def _body():
         q = q_ref[0].astype(jnp.float32) * scale         # [bq, d]
         k = k_ref[0].astype(jnp.float32)                 # [bk, d]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)          # [bq, bk]
-        q_pos = qi * block_q + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 0)
-        k_pos = ki * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 1)
-        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
         p = jnp.exp(s - lse_ref[0, :, 0][:, None])             # [bq, bk]
         do = do_ref[0].astype(jnp.float32)               # [bq, d]
         dv_scr[:] += jax.lax.dot_general(
@@ -196,6 +202,11 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32) / scale  # q was pre-scaled
 
+    if causal:
+        pl.when(qi * block_q + block_q - 1 >= ki * block_k)(_body)
+    else:
+        _body()
+
     @pl.when(qi == nq - 1)
     def _finalize():
         dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
@@ -204,7 +215,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                    dq_ref, dq_scr,
-                   *, scale: float, block_q: int, block_k: int):
+                   *, scale: float, block_q: int, block_k: int, causal: bool):
     qi, ki = pl.program_id(1), pl.program_id(2)
     nk = pl.num_programs(2)
 
@@ -212,18 +223,18 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     def _init():
         dq_scr[:] = jnp.zeros_like(dq_scr)
 
-    @pl.when(ki * block_k <= qi * block_q + block_q - 1)
-    def _compute():
+    def _body():
         q = q_ref[0].astype(jnp.float32) * scale
         k = k_ref[0].astype(jnp.float32)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
-        q_pos = qi * block_q + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 0)
-        k_pos = ki * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 1)
-        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
         p = jnp.exp(s - lse_ref[0, :, 0][:, None])
         do = do_ref[0].astype(jnp.float32)
         v = v_ref[0].astype(jnp.float32)
@@ -235,12 +246,18 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             ds, k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
+    if causal:
+        pl.when(ki * block_k <= qi * block_q + block_q - 1)(_body)
+    else:
+        _body()
+
     @pl.when(ki == nk - 1)
     def _finalize():
         dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
 
 
-def _bwd(h, kv, scale, block_q, block_k, residuals, do4):
+def _bwd(h, kv, scale, block_q, block_k, residuals, do4,
+         dlse2=None, causal=True):
     q3, k3, v3, o3, lse = residuals
     bh, s, d = q3.shape
     bkv = k3.shape[0]
@@ -248,6 +265,10 @@ def _bwd(h, kv, scale, block_q, block_k, residuals, do4):
     do3 = do4
     delta2 = jnp.sum(
         do3.astype(jnp.float32) * o3.astype(jnp.float32), axis=-1)  # [bh, s]
+    if dlse2 is not None:
+        # lse cotangent folds into the same kernels: d(lse)/d(s) = p, so
+        # ds = p*(dp - delta + dlse) — i.e. replace delta with delta - dlse
+        delta2 = delta2 - dlse2.astype(jnp.float32)
     delta = jnp.broadcast_to(delta2[:, :, None], (*delta2.shape, 8))
 
     def kv_index_k_outer(bhi, ki, qi):
@@ -256,7 +277,8 @@ def _bwd(h, kv, scale, block_q, block_k, residuals, do4):
     nq, nk = s // block_q, s // block_k
     # dK/dV: one pass per query head; shared KV heads summed afterwards
     dkv_kernel = functools.partial(
-        _bwd_dkv_kernel, scale=scale, block_q=block_q, block_k=block_k)
+        _bwd_dkv_kernel, scale=scale, block_q=block_q, block_k=block_k,
+        causal=causal)
     dk_per_h, dv_per_h = pl.pallas_call(
         dkv_kernel,
         grid=(bh, nk, nq),
@@ -291,7 +313,8 @@ def _bwd(h, kv, scale, block_q, block_k, residuals, do4):
         return ((bhi // h) * kv + (bhi % h) // g, ki, 0)
 
     dq_kernel = functools.partial(
-        _bwd_dq_kernel, scale=scale, block_q=block_q, block_k=block_k)
+        _bwd_dq_kernel, scale=scale, block_q=block_q, block_k=block_k,
+        causal=causal)
     dq3 = pl.pallas_call(
         dq_kernel,
         grid=(bh, nq, nk),
@@ -340,6 +363,75 @@ def _flash_bwd(heads, block, residuals, g):
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash_lse(q3, k3, v3, heads, block, causal):
+    h, kv = heads
+    scale = 1.0 / math.sqrt(q3.shape[-1])
+    o, lse = _fwd(q3, k3, v3, h=h, kv=kv, scale=scale,
+                  block_q=block[0], block_k=block[1], causal=causal)
+    return o, lse[:, :, 0]
+
+
+def _flash_lse_fwd(q3, k3, v3, heads, block, causal):
+    h, kv = heads
+    scale = 1.0 / math.sqrt(q3.shape[-1])
+    o, lse = _fwd(q3, k3, v3, h=h, kv=kv, scale=scale,
+                  block_q=block[0], block_k=block[1], causal=causal)
+    return (o, lse[:, :, 0]), (q3, k3, v3, o, lse)
+
+
+def _flash_lse_bwd(heads, block, causal, residuals, cts):
+    h, kv = heads
+    do, dlse = cts
+    scale = 1.0 / math.sqrt(residuals[0].shape[-1])
+    return _bwd(h, kv, scale, block[0], block[1], residuals, do,
+                dlse2=dlse, causal=causal)
+
+
+_flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
+
+
+def flash_attention_lse(
+    q: jax.Array, k: jax.Array, v: jax.Array,
+    *, q_per_kv: int = 1, block_q: int = 1024, block_k: int = 1024,
+    causal: bool = True,
+) -> tuple[jax.Array, jax.Array]:
+    """(out [b,s,h,d], lse [b,h,s]) — the block-combinable form.
+
+    ``causal=False`` computes full (bidirectional) attention over the K/V
+    block — what a ring-attention device needs for K/V blocks that sit
+    entirely before its queries.  Partial results from multiple K/V blocks
+    combine exactly via their logsumexps (parallel/ring_attention.py); the
+    lse output is differentiable (its cotangent folds into the same bwd
+    kernels through the delta rows).
+
+    Requires MXU-tileable sequence lengths (no pad-and-slice here: padded
+    keys would be ATTENDED under causal=False, so padding is unsound).
+    """
+    b, s, h, d = q.shape
+    kv = k.shape[2]
+    sk = k.shape[1]
+    if h != kv * q_per_kv:
+        raise ValueError(
+            f"q_per_kv={q_per_kv} inconsistent with heads {h}, kv {kv}")
+    if sk != s:
+        raise ValueError(
+            f"flash_attention_lse needs equal q/k lengths (got {s} vs {sk}); "
+            "ring blocks are same-sized by construction")
+    bq = _block(s, block_q)
+    bk = _block(sk, block_k)
+    if not _interpret() and (bq % 8 or bk % 8):
+        raise ValueError(
+            f"flash_attention_lse needs tileable seq lengths; got q={s}, "
+            f"k={sk} (blocks {bq}x{bk})")
+    q3 = q.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+    k3 = k.transpose(0, 2, 1, 3).reshape(b * kv, sk, d)
+    v3 = v.transpose(0, 2, 1, 3).reshape(b * kv, sk, d)
+    o3, lse3 = _flash_lse(q3, k3, v3, (h, kv), (bq, bk), causal)
+    return (o3.reshape(b, h, s, d).transpose(0, 2, 1, 3),
+            lse3.reshape(b, h, s))
 
 
 def flash_attention(

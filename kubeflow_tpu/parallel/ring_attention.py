@@ -48,12 +48,20 @@ def ring_attention(
     axis_name: str = "seq",
     q_per_kv: int = 1,
     mesh: Optional[Mesh] = None,
+    block_impl: str = "auto",
 ) -> jax.Array:
     """Causal GQA attention with sequence sharded on ``axis_name``.
 
     q: [b, s, h, d]; k, v: [b, s, kv, d] (global shapes; sharding constraints
     put the s dim on the ``seq`` mesh axis).  Falls back to dense attention
     when no seq axis is active, so models can enable it unconditionally.
+
+    ``block_impl``: what computes each visiting K/V block —
+    - "flash": the Pallas flash kernel per block (fully-masked blocks are
+      skipped with lax.switch, earlier blocks run unmasked, the diagonal
+      runs causal), folded across the ring by logsumexp;
+    - "einsum": the plain XLA online-softmax fold;
+    - "auto": flash when the per-device sequence is MXU-tileable.
     """
     mesh = mesh or current_mesh()
     if (
@@ -65,12 +73,27 @@ def ring_attention(
 
         return _causal_attention(q, k, v, q_per_kv)
 
+    ring = mesh.shape[axis_name]
+    per_dev_seq = q.shape[1] // ring
+    if block_impl not in ("auto", "flash", "einsum"):
+        raise ValueError(f"unknown block_impl {block_impl!r}")
+    if block_impl == "auto":
+        # flash blocks engage on real TPU with MXU-tileable shards; the CPU
+        # stand-in keeps the einsum fold (pallas interpret mode is
+        # correctness-only and slow — tests opt into "flash" explicitly)
+        block_impl = (
+            "flash"
+            if jax.default_backend() == "tpu" and per_dev_seq % 128 == 0
+            else "einsum"
+        )
+    body = _ring_forward_flash if block_impl == "flash" else _ring_forward
+
     q_spec, kv_spec = _specs(mesh, axis_name)
     fn = jax.shard_map(
         partial(
-            _ring_forward,
+            body,
             axis_name=axis_name,
-            ring_size=mesh.shape[axis_name],
+            ring_size=ring,
             q_per_kv=q_per_kv,
         ),
         mesh=mesh,
@@ -129,6 +152,66 @@ def _ring_forward(q, k, v, *, axis_name: str, ring_size: int, q_per_kv: int):
         step, (k, v, (m0, l0, o0)), jnp.arange(ring_size))
     out = o / l[..., None]
     return out.reshape(b, sq, h, d).astype(q.dtype)
+
+
+def _ring_forward_flash(q, k, v, *, axis_name: str, ring_size: int,
+                        q_per_kv: int):
+    """Per-shard body with the Pallas flash kernel computing each block.
+
+    Each visiting K/V block is one of three cases by ring position:
+    entirely-after my queries (fully masked — SKIPPED, no FLOPs at all),
+    entirely-before (full unmasked attention), or the diagonal (causal).
+    Normalized block outputs combine exactly through their logsumexps
+    (``flash_attention_lse``); the combine is differentiable end to end,
+    closing the r1 gap where ring attention's block math was plain einsum
+    while the single-chip path had the kernel.
+    """
+    from ..ops.flash_attention import flash_attention_lse
+
+    b, sq, h, d = q.shape
+    my = lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % ring_size) for i in range(ring_size)]
+
+    def step(carry, t):
+        k_blk, v_blk, o_num, l_run, m_run = carry
+        src = (my - t) % ring_size  # whose block we hold at step t
+
+        def diag(_):
+            return flash_attention_lse(q, k_blk, v_blk, q_per_kv=q_per_kv,
+                                       causal=True)
+
+        def full(_):
+            return flash_attention_lse(q, k_blk, v_blk, q_per_kv=q_per_kv,
+                                       causal=False)
+
+        def skip(_):
+            return (jnp.zeros((b, sq, h, d), q.dtype),
+                    jnp.full((b, h, sq), _NEG_INF, jnp.float32))
+
+        # 0 = src after me (skip), 1 = before me (full), 2 = diagonal
+        case = jnp.where(src == my, 2, jnp.where(src < my, 1, 0))
+        o_t, lse_t = lax.switch(case, [skip, full, diag], None)
+
+        # exact combine via logsumexp weights, unnormalized accumulator
+        # (one division after the scan); the _NEG_INF sentinel keeps empty
+        # partials weightless once any real block lands (exp(-1e30-m) == 0)
+        m_new = jnp.maximum(m_run, lse_t)
+        corr = jnp.exp(m_run - m_new)
+        w_t = jnp.exp(lse_t - m_new)
+        o_new = (o_num * corr.transpose(0, 2, 1)[..., None]
+                 + o_t.astype(jnp.float32) * w_t.transpose(0, 2, 1)[..., None])
+        l_new = l_run * corr + w_t
+        k_nxt = lax.ppermute(k_blk, axis_name, perm)
+        v_nxt = lax.ppermute(v_blk, axis_name, perm)
+        return (k_nxt, v_nxt, o_new, l_new, m_new), None
+
+    o0 = jnp.zeros((b, sq, h, d), jnp.float32)
+    l0 = jnp.zeros((b, h, sq), jnp.float32)
+    m0 = jnp.full((b, h, sq), _NEG_INF, jnp.float32)
+    (_, _, o_num, l_run, _), _ = lax.scan(
+        step, (k, v, o0, l0, m0), jnp.arange(ring_size))
+    out = o_num / jnp.maximum(l_run, 1e-30).transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
 
 
 def ulysses_attention(

@@ -72,3 +72,58 @@ def test_ulysses_rejects_indivisible_heads(qkv):
     mesh = meshlib.build_mesh({"seq": 8})  # kv=4 not divisible by 8
     with pytest.raises(ValueError, match="divisible"):
         ringlib.ulysses_attention(q, k, v, q_per_kv=2, mesh=mesh)
+
+
+@pytest.mark.parametrize("axes", [{"seq": 4}, {"data": 2, "seq": 2}])
+def test_ring_flash_blocks_match_dense(qkv, axes):
+    """The Pallas-kernel block path (r1 weak #3 closure): per-block flash
+    with logsumexp folding across the ring == dense reference."""
+    q, k, v = qkv
+    ref = np.asarray(_causal_attention(q, k, v, 2))
+    mesh = meshlib.build_mesh(
+        axes, devices=jax.devices()[: np.prod(list(axes.values()))])
+    out = jax.jit(lambda q, k, v: ringlib.ring_attention(
+        q, k, v, q_per_kv=2, mesh=mesh, block_impl="flash"))(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), ref, atol=2e-5, rtol=2e-5)
+
+
+def test_ring_flash_gradients_match_dense(qkv):
+    """Differentiability through the lse combine: the dlse cotangent rides
+    the same bwd kernels via the delta rows."""
+    q, k, v = qkv
+    mesh = meshlib.build_mesh({"seq": 4}, devices=jax.devices()[:4])
+
+    def ring_loss(q, k, v):
+        return (ringlib.ring_attention(
+            q, k, v, q_per_kv=2, mesh=mesh, block_impl="flash"
+        ).astype(jnp.float32) ** 2).sum()
+
+    def dense_loss(q, k, v):
+        return (_causal_attention(q, k, v, 2).astype(jnp.float32) ** 2).sum()
+
+    g_ring = jax.grad(ring_loss, argnums=(0, 1, 2))(q, k, v)
+    g_dense = jax.grad(dense_loss, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_ring, g_dense):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-5, rtol=5e-5)
+
+
+def test_flash_lse_matches_dense_logsumexp(qkv):
+    """flash_attention_lse's lse output is the true per-row logsumexp of
+    the scaled (masked) logits, causal and full."""
+    from kubeflow_tpu.ops.flash_attention import flash_attention_lse
+
+    q, k, v = qkv
+    b, s, h, d = q.shape
+    kvh = k.shape[2]
+    qh = q.reshape(b, s, kvh, 2, d)
+    logits = jnp.einsum("bqkgd,bskd->bkgqs", qh, k) / jnp.sqrt(d)
+    logits = logits.reshape(b, kvh * 2, s, s)
+    for causal in (True, False):
+        masked = (
+            jnp.where(jnp.tril(jnp.ones((s, s), bool))[None, None], logits, -1e30)
+            if causal else logits)
+        want = jax.nn.logsumexp(masked, axis=-1)  # [b, h, s]
+        _, lse = flash_attention_lse(q, k, v, q_per_kv=2, causal=causal)
+        np.testing.assert_allclose(np.asarray(lse), np.asarray(want),
+                                   atol=1e-4, rtol=1e-4)
