@@ -226,7 +226,7 @@ class Attention(nn.Module):
         v = nn.with_logical_constraint(v, ("batch", "act_seq", "act_kv_heads", "head_dim"))
 
         if self.decode:
-            out = self._decode_attend(q, k, v)
+            out = self._decode_attend(q, k, v, positions)
         elif cfg.attention_impl == "ring":
             out = ringlib.ring_attention(
                 q, k, v, axis_name="seq", q_per_kv=cfg.q_per_kv
@@ -242,14 +242,20 @@ class Attention(nn.Module):
             "bshd,hde->bse", (cfg.num_heads, cfg.head_dim, h_dim),
             ("heads", "head_dim", "embed"), in_axes=(0, 1), name="wo")(out)
 
-    def _decode_attend(self, q, k, v):
-        """Single-token decode against a mutable KV cache (serving path).
+    def _decode_attend(self, q, k, v, positions):
+        """Decode against a mutable KV cache with PER-ROW positions.
 
-        Flax 'cache' collection: cached_key/value are [batch, max_seq, kv, hd];
-        cache_index is the write cursor.  q is [batch, 1, heads, hd].
+        Flax 'cache' collection: cached_key/value are [batch, max_seq, kv,
+        hd].  ``positions`` [batch, sc] gives each incoming token's global
+        position per row, so slot index == global position: writes scatter
+        per row (one-hot matmul — MXU-friendly, no serialized scatters) and
+        query row at position p attends exactly slots <= p.  This is what
+        makes RAGGED batches sound: rows pad to a shared bucket, pad-slot
+        junk sits at positions greater than the row's live front, where the
+        mask hides it until a real decode write overwrites it.
         """
         cfg = self.cfg
-        batch = q.shape[0]
+        batch, sc = q.shape[0], q.shape[1]
         cached_k = self.variable(
             "cache", "cached_key",
             jnp.zeros, (batch, cfg.max_seq_len, cfg.num_kv_heads, cfg.head_dim), cfg.dtype)
@@ -257,24 +263,27 @@ class Attention(nn.Module):
             "cache", "cached_value",
             jnp.zeros, (batch, cfg.max_seq_len, cfg.num_kv_heads, cfg.head_dim), cfg.dtype)
         idx = self.variable("cache", "cache_index", lambda: jnp.zeros((), jnp.int32))
-        cur = idx.value
-        cached_k.value = jax.lax.dynamic_update_slice(
-            cached_k.value, k.astype(cfg.dtype), (0, cur, 0, 0))
-        cached_v.value = jax.lax.dynamic_update_slice(
-            cached_v.value, v.astype(cfg.dtype), (0, cur, 0, 0))
-        idx.value = cur + q.shape[1]
+        positions = jnp.broadcast_to(positions, (batch, sc))
+        # per-row scatter write: touches only the written slots (a one-hot
+        # matmul alternative rewrites the entire cache every step — O(S)
+        # HBM traffic per decoded token)
+        rows = jnp.arange(batch, dtype=jnp.int32)[:, None]
+        cached_k.value = cached_k.value.at[rows, positions].set(
+            k.astype(cfg.dtype), mode="drop")
+        cached_v.value = cached_v.value.at[rows, positions].set(
+            v.astype(cfg.dtype), mode="drop")
+        idx.value = idx.value + sc  # legacy cursor, informational only
         kf, vf = cached_k.value, cached_v.value
-        qh = q.reshape(batch, q.shape[1], cfg.num_kv_heads, cfg.q_per_kv, cfg.head_dim)
+        qh = q.reshape(batch, sc, cfg.num_kv_heads, cfg.q_per_kv, cfg.head_dim)
         logits = jnp.einsum("bqkgh,bskh->bkgqs", qh.astype(jnp.float32), kf.astype(jnp.float32))
         logits = logits / jnp.sqrt(cfg.head_dim).astype(jnp.float32)
-        # query i of this chunk sits at global position cur+i and may attend
-        # to cache slots <= cur+i (per-query mask, so chunked prefill works)
-        q_pos = cur + jnp.arange(q.shape[1])
-        valid = jnp.arange(cfg.max_seq_len)[None, :] <= q_pos[:, None]  # [q, s]
-        logits = jnp.where(valid[None, None, None, :, :], logits, -1e30)
+        # per-row per-query causal mask over cache slots
+        valid = (jnp.arange(cfg.max_seq_len)[None, None, :]
+                 <= positions[:, :, None])  # [b, q, s]
+        logits = jnp.where(valid[:, None, None, :, :], logits, -1e30)
         probs = jax.nn.softmax(logits, axis=-1)
         out = jnp.einsum("bkgqs,bskh->bqkgh", probs, vf.astype(jnp.float32))
-        return out.reshape(batch, q.shape[1], cfg.num_heads, cfg.head_dim).astype(cfg.dtype)
+        return out.reshape(batch, sc, cfg.num_heads, cfg.head_dim).astype(cfg.dtype)
 
 
 def _causal_attention(q, k, v, q_per_kv: int) -> jax.Array:

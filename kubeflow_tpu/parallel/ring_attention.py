@@ -254,6 +254,12 @@ def ulysses_attention(
             f"kv={k.shape[2]}/{tp}) divisible by seq axis size {ring}")
     q_spec, kv_spec = _specs(mesh, axis_name)
 
+    # after the all-to-all the core is ordinary full-sequence causal
+    # attention — run it through the Pallas kernel on real TPU (the CPU
+    # stand-in keeps the dense einsum; interpret mode is correctness-only)
+    full_seq = q.shape[1]
+    use_flash = jax.default_backend() == "tpu" and full_seq % 128 == 0
+
     def body(q, k, v):
         # [b, s/r, h, d] -> all_to_all -> [b, s, h/r, d]
         def gather_seq(x):
@@ -264,10 +270,16 @@ def ulysses_attention(
             return lax.all_to_all(
                 x, axis_name, split_axis=1, concat_axis=2, tiled=True)
 
-        from ..models.llama import _causal_attention
+        if use_flash:
+            from ..ops.flash_attention import flash_attention as attend
 
-        out = _causal_attention(
-            gather_seq(q), gather_seq(k), gather_seq(v), q_per_kv)
+            out = attend(gather_seq(q), gather_seq(k), gather_seq(v),
+                         q_per_kv=q_per_kv)
+        else:
+            from ..models.llama import _causal_attention
+
+            out = _causal_attention(
+                gather_seq(q), gather_seq(k), gather_seq(v), q_per_kv)
         return scatter_seq(out)
 
     return jax.shard_map(

@@ -29,6 +29,15 @@ from .storage import download, fetch_mem
 DEFAULT_BUCKETS = (1, 2, 4, 8)
 
 
+def pad_to_bucket(n: int, buckets) -> int:
+    """Smallest bucket >= n; clamps to the largest (callers that must
+    reject oversize inputs check against buckets[-1] themselves)."""
+    for b in buckets:
+        if n <= b:
+            return b
+    return buckets[-1]
+
+
 class EchoModel(Model):
     def predict_batch(self, instances):
         return instances
@@ -58,11 +67,6 @@ class JaxFunctionModel(Model):
         self._jitted = jax.jit(self.fn)
         self.ready = True
 
-    def _bucket(self, n: int) -> int:
-        for b in self.buckets:
-            if n <= b:
-                return b
-        return self.buckets[-1]
 
     def predict_batch(self, instances):
         x = np.asarray(instances, dtype=np.float32)
@@ -71,7 +75,7 @@ class JaxFunctionModel(Model):
         cap = self.buckets[-1]
         for i in range(0, len(x), cap):
             chunk = x[i : i + cap]
-            b = self._bucket(len(chunk))
+            b = pad_to_bucket(len(chunk), self.buckets)
             padded = np.zeros((b, *chunk.shape[1:]), dtype=chunk.dtype)
             padded[: len(chunk)] = chunk
             y = np.asarray(jax.device_get(self._jitted(self.params, jnp.asarray(padded))))
@@ -87,13 +91,11 @@ class LlamaGenerator(Model):
       max_new_tokens (default 16), temperature (default 0 = greedy)
 
     Instances are token-id lists; predictions are continuation token lists.
-    Prefill is one chunked decode=True forward (specialized per distinct
-    prompt length — a plain forward, so the per-length compile is small);
-    the sampling scan compiles ONCE per batch size and is reused across
-    all prompt lengths.  Padding prompts into shared-length buckets is not
-    possible with the single shared cache cursor (pad rows would enter the
-    cache); per-row cursors (paged caches) are the known next step if
-    ragged production traffic makes per-length prefill compiles matter.
+    Ragged prompts batch together: the KV cache tracks PER-ROW positions
+    (models/llama.py _decode_attend), so a mixed-length micro-batch pads
+    to a shared seq bucket and runs as ONE prefill forward + ONE sampling
+    scan — XLA only ever compiles bucket shapes, and pad junk is masked
+    out of attention per row until real decode writes overwrite it.
     """
 
     def __init__(self, name: str, config: Optional[dict[str, Any]] = None):
@@ -109,51 +111,69 @@ class LlamaGenerator(Model):
         temperature = self.temperature
         n_new = self.max_new_tokens
 
-        def decode_step(params, cache, tok, pos):
+        def forward(params, cache, tok, positions):
             logits, mutated = self.model.apply(
-                {"params": params, "cache": cache}, tok, pos,
+                {"params": params, "cache": cache}, tok, positions,
                 decode=True, mutable=["cache"])
-            return logits[:, -1, :], mutated["cache"]
+            return logits, mutated["cache"]
 
-        def prefill(params, cache, prompt):
-            """Chunked prefill: the WHOLE prompt in one decode=True forward
-            (the cache's per-query mask makes multi-token writes correct).
-            This is the only prompt-length-specialized program, and it is a
-            plain forward — no per-token loop, no per-length scan."""
+        def prefill(params, cache, prompt, lengths):
+            """Chunked prefill of a RAGGED batch padded to one bucket: the
+            whole padded prompt in one decode=True forward.  The cache's
+            per-row position mask makes pad junk invisible; each row's next
+            -token logits are gathered at its true last token."""
             b, length = prompt.shape
             positions = jnp.broadcast_to(
                 jnp.arange(length, dtype=jnp.int32)[None, :], (b, length))
-            return decode_step(params, cache, prompt, positions)
+            logits_all, cache = forward(params, cache, prompt, positions)
+            last = jnp.take_along_axis(
+                logits_all, (lengths - 1)[:, None, None], axis=1)[:, 0]
+            return last, cache
 
-        def sample(params, cache, logits, start_pos):
+        def sample(params, cache, logits, lengths, key):
             """n_new single-token decode steps as one lax.scan — compiled
-            ONCE per batch size, independent of prompt length (start_pos is
-            a traced scalar).  One dispatch + one host fetch per generate;
-            a per-token Python loop with per-element int() fetches paid
-            ~one host round trip per token (~100ms each on the
-            remote-dispatch tunnel: the r3 serving-bench finding)."""
-            b = logits.shape[0]
+            per (batch, bucket)-shape, reused across requests.  Per-row
+            positions start at each row's true length, so ragged rows
+            decode in lockstep without poisoning each other's cache.  One
+            dispatch + one host fetch per generate; a per-token Python
+            loop with per-element int() fetches paid ~one host round trip
+            per token (~100ms each on the remote-dispatch tunnel: the r3
+            serving-bench finding)."""
 
             def step(carry, key):
-                cache, logits, pos = carry
+                cache, logits, pos = carry  # pos: [b] per-row positions
                 if temperature > 0:
                     tok = jax.random.categorical(
                         key, logits.astype(jnp.float32) / temperature, axis=-1)
                 else:
                     tok = jnp.argmax(logits, axis=-1)
                 tok = tok.astype(jnp.int32)
-                l, cache = decode_step(
-                    params, cache, tok[:, None],
-                    jnp.broadcast_to(pos[None, None], (b, 1)))
-                return (cache, l, pos + 1), tok
+                l, cache = forward(params, cache, tok[:, None], pos[:, None])
+                return (cache, l[:, -1, :], pos + 1), tok
 
-            keys = jax.random.split(jax.random.PRNGKey(0), n_new)
+            keys = jax.random.split(key, n_new)
             (_, _, _), toks = jax.lax.scan(
-                step, (cache, logits, start_pos), keys)
+                step, (cache, logits, lengths), keys)
             return toks.T  # [b, n_new]
 
         self._prefill = jax.jit(prefill)
         self._sample = jax.jit(sample)
+        cap = self.cfg.max_seq_len - n_new
+        if cap < 1:
+            raise ValueError(
+                f"max_new_tokens {n_new} leaves no room in max_seq_len "
+                f"{self.cfg.max_seq_len}")
+        default_buckets = [
+            s for s in (32, 64, 128, 256, 512, 1024, 2048, 4096) if s < cap
+        ] + [cap]
+        raw = self.config.get("seq_buckets", default_buckets)
+        # user buckets: sorted, deduped, clamped to what the cache can hold
+        # (an oversized bucket would silently drop KV writes past max_seq)
+        valid = sorted({int(b) for b in raw if 1 <= int(b) <= cap})
+        if not valid:
+            raise ValueError(
+                f"no usable seq bucket <= {cap} in {raw!r}")
+        self.seq_buckets = tuple(valid)
         self.ready = True
 
     def _init_cache(self, batch: int):
@@ -175,27 +195,32 @@ class LlamaGenerator(Model):
         return proto
 
     def predict_batch(self, instances):
-        """The decode cache cursor is shared across a batch, so only
-        equal-length prompts batch together; mixed lengths (normal under
-        the micro-batcher) are grouped by length and each group runs
-        batched — never padded, which would poison the KV cache."""
-        prompts = [list(map(int, inst)) for inst in instances]
-        by_len: dict[int, list[int]] = {}
-        for i, p in enumerate(prompts):
-            by_len.setdefault(len(p), []).append(i)
-        outs: list[Optional[list[int]]] = [None] * len(prompts)
-        for length, idxs in by_len.items():
-            group = [prompts[i] for i in idxs]
-            for i, o in zip(idxs, self._generate_group(group, length)):
-                outs[i] = o
-        return outs
-
-    def _generate_group(self, prompts: list[list[int]], length: int) -> list[list[int]]:
+        """Ragged prompts batch together: pad to a shared seq bucket (the
+        cache's per-row positions keep pad junk out of attention), so one
+        micro-batch is ONE prefill + ONE sampling scan regardless of the
+        length mix, and XLA only ever sees bucket shapes."""
+        cap = self.seq_buckets[-1]
+        # left-truncate over-long prompts (keep the tail — it conditions
+        # the next token) instead of raising: one client's oversize prompt
+        # must not fail the co-batched requests of others
+        prompts = [list(map(int, inst))[-cap:] for inst in instances]
+        if any(len(p) < 1 for p in prompts):
+            raise ValueError("empty prompt")
+        lengths = np.array([len(p) for p in prompts], np.int32)
+        bucket = pad_to_bucket(int(lengths.max()), self.seq_buckets)
         batch = len(prompts)
+        toks = np.zeros((batch, bucket), np.int32)
+        for i, p in enumerate(prompts):
+            toks[i, : len(p)] = p
         cache = self._init_cache(batch)
-        toks = jnp.asarray(np.asarray(prompts, dtype=np.int32))
-        logits, cache = self._prefill(self.params, cache, toks)
-        out = self._sample(self.params, cache, logits, jnp.int32(length))
+        logits, cache = self._prefill(
+            self.params, cache, jnp.asarray(toks), jnp.asarray(lengths))
+        # per-request sampling key: temperature>0 must differ across
+        # requests (a fixed key made every "random" continuation identical)
+        self._req_counter = getattr(self, "_req_counter", 0) + 1
+        out = self._sample(
+            self.params, cache, logits, jnp.asarray(lengths),
+            jax.random.PRNGKey(self._req_counter))
         return np.asarray(jax.device_get(out)).tolist()
 
 
@@ -242,19 +267,14 @@ class BertClassifierModel(Model):
         self._forward = jax.jit(forward)
         self.ready = True
 
-    def _pad_to(self, n: int, buckets: tuple) -> int:
-        for b in buckets:
-            if n <= b:
-                return b
-        return buckets[-1]
 
     def predict_batch(self, instances):
         out: list = []
         cap = self.batch_buckets[-1]
         for i in range(0, len(instances), cap):
             chunk = instances[i : i + cap]
-            b = self._pad_to(len(chunk), self.batch_buckets)
-            s = self._pad_to(max(len(x) for x in chunk), self.seq_buckets)
+            b = pad_to_bucket(len(chunk), self.batch_buckets)
+            s = pad_to_bucket(max(len(x) for x in chunk), self.seq_buckets)
             ids = np.zeros((b, s), np.int32)
             mask = np.zeros((b, s), np.bool_)
             for j, toks in enumerate(chunk):

@@ -383,3 +383,40 @@ class TestGrpcV2:
             client.close()
         finally:
             server.stop()  # stops the gRPC front too
+
+
+class TestLlamaGeneratorRagged:
+    def _gen(self, **cfg_kw):
+        cfg = llamalib.tiny()
+        model = llamalib.Llama(cfg)
+        params = model.init(jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32))
+        ref = register_mem(f"tinyllama-ragged-{len(cfg_kw)}", (cfg, params["params"]))
+        from kubeflow_tpu.serving.runtimes import LlamaGenerator
+
+        g = LlamaGenerator("gen", {"params_ref": ref, "max_new_tokens": 3, **cfg_kw})
+        g.start()
+        return g, cfg
+
+    def test_overlong_prompt_truncates_not_raises(self):
+        """One client's oversize prompt must not 500 the co-batched
+        requests: left-truncation keeps the conditioning tail."""
+        g, cfg = self._gen()
+        cap = g.seq_buckets[-1]
+        long_prompt = list(range(1, cap + 40))
+        out = g.predict_batch([long_prompt, [5, 6, 7]])
+        assert len(out) == 2 and all(len(o) == 3 for o in out)
+        # truncated prompt behaves exactly like its tail
+        solo = g.predict_batch([long_prompt[-cap:]])[0]
+        assert out[0] == solo
+
+    def test_temperature_varies_across_requests(self):
+        g, _ = self._gen(temperature=1.5)
+        a = g.predict_batch([[1, 2, 3]])[0]
+        outs = {tuple(g.predict_batch([[1, 2, 3]])[0]) for _ in range(6)}
+        assert len(outs) > 1  # a fixed key made every continuation identical
+
+    def test_bad_bucket_config_rejected(self):
+        import pytest as _pytest
+
+        with _pytest.raises(ValueError, match="no usable seq bucket"):
+            self._gen(seq_buckets=(100000,))
