@@ -248,8 +248,8 @@ class Attention(nn.Module):
         Flax 'cache' collection: cached_key/value are [batch, max_seq, kv,
         hd].  ``positions`` [batch, sc] gives each incoming token's global
         position per row, so slot index == global position: writes scatter
-        per row (one-hot matmul — MXU-friendly, no serialized scatters) and
-        query row at position p attends exactly slots <= p.  This is what
+        per row (touching only the written slots) and a query at row
+        position p attends exactly slots <= p.  This is what
         makes RAGGED batches sound: rows pad to a shared bucket, pad-slot
         junk sits at positions greater than the row's live front, where the
         mask hides it until a real decode write overwrites it.

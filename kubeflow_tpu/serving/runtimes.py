@@ -108,6 +108,16 @@ class LlamaGenerator(Model):
         ref = self.config["params_ref"]
         self.cfg, self.params = fetch_mem(ref[len("mem://"):])
         self.model = llamalib.Llama(self.cfg)
+        # decode is HBM-bound on weight reads (every parameter streams per
+        # token); serving in bf16 halves that traffic.  Opt-in: training
+        # checkpoints are f32 and greedy ties can flip under the cast.
+        wd = self.config.get("weights_dtype")
+        if wd:
+            target = jnp.dtype(wd)
+            self.params = jax.tree.map(
+                lambda x: x.astype(target)
+                if jnp.issubdtype(x.dtype, jnp.floating) else x,
+                self.params)
         temperature = self.temperature
         n_new = self.max_new_tokens
 
@@ -174,6 +184,10 @@ class LlamaGenerator(Model):
             raise ValueError(
                 f"no usable seq bucket <= {cap} in {raw!r}")
         self.seq_buckets = tuple(valid)
+        import os as _os
+
+        self._base_key = jax.random.PRNGKey(
+            int.from_bytes(_os.urandom(4), "little"))
         self.ready = True
 
     def _init_cache(self, batch: int):
@@ -204,8 +218,10 @@ class LlamaGenerator(Model):
         # the next token) instead of raising: one client's oversize prompt
         # must not fail the co-batched requests of others
         prompts = [list(map(int, inst))[-cap:] for inst in instances]
-        if any(len(p) < 1 for p in prompts):
-            raise ValueError("empty prompt")
+        # an empty prompt conditions on a single pad token instead of
+        # raising: like the over-long case, one client's bad request must
+        # not fail the co-batched requests of others
+        prompts = [p if p else [0] for p in prompts]
         lengths = np.array([len(p) for p in prompts], np.int32)
         bucket = pad_to_bucket(int(lengths.max()), self.seq_buckets)
         batch = len(prompts)
@@ -216,11 +232,13 @@ class LlamaGenerator(Model):
         logits, cache = self._prefill(
             self.params, cache, jnp.asarray(toks), jnp.asarray(lengths))
         # per-request sampling key: temperature>0 must differ across
-        # requests (a fixed key made every "random" continuation identical)
+        # requests AND across replicas/restarts (a fixed key made every
+        # "random" continuation identical; a bare counter would replay the
+        # same sequence on every replica)
         self._req_counter = getattr(self, "_req_counter", 0) + 1
         out = self._sample(
             self.params, cache, logits, jnp.asarray(lengths),
-            jax.random.PRNGKey(self._req_counter))
+            jax.random.fold_in(self._base_key, self._req_counter))
         return np.asarray(jax.device_get(out)).tolist()
 
 

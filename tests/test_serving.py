@@ -420,3 +420,13 @@ class TestLlamaGeneratorRagged:
 
         with _pytest.raises(ValueError, match="no usable seq bucket"):
             self._gen(seq_buckets=(100000,))
+
+    def test_weights_dtype_serving_cast(self):
+        """Opt-in bf16 serving weights (decode is HBM-bound on weight
+        reads); outputs stay valid token ids of the right shape."""
+        g, cfg = self._gen(weights_dtype="bfloat16")
+        leaf = jax.tree_util.tree_leaves(g.params)[0]
+        assert leaf.dtype == jnp.bfloat16
+        out = g.predict_batch([[1, 2, 3], [4, 5]])
+        assert all(len(o) == 3 for o in out)
+        assert all(0 <= t < cfg.vocab_size for o in out for t in o)
