@@ -222,6 +222,7 @@ def ulysses_attention(
     axis_name: str = "seq",
     q_per_kv: int = 1,
     mesh: Optional[Mesh] = None,
+    use_flash: Optional[bool] = None,
 ) -> jax.Array:
     """Ulysses-style SP: all-to-all heads<->sequence swap around dense attention.
 
@@ -256,9 +257,11 @@ def ulysses_attention(
 
     # after the all-to-all the core is ordinary full-sequence causal
     # attention — run it through the Pallas kernel on real TPU (the CPU
-    # stand-in keeps the dense einsum; interpret mode is correctness-only)
+    # stand-in keeps the dense einsum; interpret mode is correctness-only,
+    # and tests force use_flash=True to cover the kernel path there)
     full_seq = q.shape[1]
-    use_flash = jax.default_backend() == "tpu" and full_seq % 128 == 0
+    if use_flash is None:
+        use_flash = jax.default_backend() == "tpu" and full_seq % 128 == 0
 
     def body(q, k, v):
         # [b, s/r, h, d] -> all_to_all -> [b, s, h/r, d]

@@ -15,6 +15,7 @@ the Triton/GPU path".  These are those predictors:
 
 from __future__ import annotations
 
+import os
 from typing import Any, Optional
 
 import jax
@@ -184,10 +185,8 @@ class LlamaGenerator(Model):
             raise ValueError(
                 f"no usable seq bucket <= {cap} in {raw!r}")
         self.seq_buckets = tuple(valid)
-        import os as _os
-
         self._base_key = jax.random.PRNGKey(
-            int.from_bytes(_os.urandom(4), "little"))
+            int.from_bytes(os.urandom(4), "little"))
         self.ready = True
 
     def _init_cache(self, batch: int):
@@ -218,9 +217,11 @@ class LlamaGenerator(Model):
         # the next token) instead of raising: one client's oversize prompt
         # must not fail the co-batched requests of others
         prompts = [list(map(int, inst))[-cap:] for inst in instances]
-        # an empty prompt conditions on a single pad token instead of
-        # raising: like the over-long case, one client's bad request must
-        # not fail the co-batched requests of others
+        # empty prompts get an EMPTY continuation: raising would fail the
+        # co-batched requests of other clients, and fabricating output
+        # conditioned on an arbitrary token would be indistinguishable
+        # from a real answer.  They ride the batch as placeholder rows.
+        empty = [i for i, p in enumerate(prompts) if not p]
         prompts = [p if p else [0] for p in prompts]
         lengths = np.array([len(p) for p in prompts], np.int32)
         bucket = pad_to_bucket(int(lengths.max()), self.seq_buckets)
@@ -239,7 +240,10 @@ class LlamaGenerator(Model):
         out = self._sample(
             self.params, cache, logits, jnp.asarray(lengths),
             jax.random.fold_in(self._base_key, self._req_counter))
-        return np.asarray(jax.device_get(out)).tolist()
+        rows = np.asarray(jax.device_get(out)).tolist()
+        for i in empty:
+            rows[i] = []
+        return rows
 
 
 #: server_class registry for ServingRuntime.spec.server_class resolution

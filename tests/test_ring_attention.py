@@ -127,3 +127,14 @@ def test_flash_lse_matches_dense_logsumexp(qkv):
         _, lse = flash_attention_lse(q, k, v, q_per_kv=2, causal=causal)
         np.testing.assert_allclose(np.asarray(lse), np.asarray(want),
                                    atol=1e-4, rtol=1e-4)
+
+
+def test_ulysses_flash_core_matches_dense(qkv):
+    """The TPU ulysses path (flash kernel after the all-to-all), forced on
+    the CPU stand-in via interpret mode."""
+    q, k, v = qkv
+    ref = np.asarray(_causal_attention(q, k, v, 2))
+    mesh = meshlib.build_mesh({"seq": 4}, devices=jax.devices()[:4])
+    out = jax.jit(lambda q, k, v: ringlib.ulysses_attention(
+        q, k, v, q_per_kv=2, mesh=mesh, use_flash=True))(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), ref, atol=2e-5, rtol=2e-5)

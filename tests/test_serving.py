@@ -430,3 +430,13 @@ class TestLlamaGeneratorRagged:
         out = g.predict_batch([[1, 2, 3], [4, 5]])
         assert all(len(o) == 3 for o in out)
         assert all(0 <= t < cfg.vocab_size for o in out for t in o)
+
+    def test_empty_prompt_isolated_and_empty_output(self):
+        """An empty prompt neither fails the co-batched requests nor
+        fabricates a continuation: it returns []."""
+        g, _ = self._gen()
+        out = g.predict_batch([[], [5, 6, 7]])
+        assert out[0] == []
+        assert len(out[1]) == 3
+        solo = g.predict_batch([[5, 6, 7]])[0]
+        assert out[1] == solo
