@@ -222,13 +222,16 @@ def ulysses_attention(
     axis_name: str = "seq",
     q_per_kv: int = 1,
     mesh: Optional[Mesh] = None,
-    use_flash: Optional[bool] = None,
+    block_impl: str = "auto",
 ) -> jax.Array:
     """Ulysses-style SP: all-to-all heads<->sequence swap around dense attention.
 
     Each device trades its sequence shard of all heads for the full sequence
     of heads/ring_size heads, runs ordinary causal attention, and swaps back.
     Two all-to-alls per call; requires num_kv_heads % ring_size == 0.
+    ``block_impl`` follows ring_attention's convention: "flash" runs the
+    post-all-to-all core through the Pallas kernel, "einsum" the dense
+    reference, "auto" = flash on real TPU with MXU-tileable sequences.
     """
     mesh = mesh or current_mesh()
     if (
@@ -258,10 +261,17 @@ def ulysses_attention(
     # after the all-to-all the core is ordinary full-sequence causal
     # attention — run it through the Pallas kernel on real TPU (the CPU
     # stand-in keeps the dense einsum; interpret mode is correctness-only,
-    # and tests force use_flash=True to cover the kernel path there)
+    # and tests force block_impl="flash" to cover the kernel path there)
+    if block_impl not in ("auto", "flash", "einsum"):
+        raise ValueError(f"unknown block_impl {block_impl!r}")
     full_seq = q.shape[1]
-    if use_flash is None:
-        use_flash = jax.default_backend() == "tpu" and full_seq % 128 == 0
+    if block_impl == "auto":
+        block_impl = (
+            "flash"
+            if jax.default_backend() == "tpu" and full_seq % 128 == 0
+            else "einsum"
+        )
+    use_flash = block_impl == "flash"
 
     def body(q, k, v):
         # [b, s/r, h, d] -> all_to_all -> [b, s, h/r, d]

@@ -222,6 +222,8 @@ class LlamaGenerator(Model):
         # conditioned on an arbitrary token would be indistinguishable
         # from a real answer.  They ride the batch as placeholder rows.
         empty = [i for i, p in enumerate(prompts) if not p]
+        if len(empty) == len(prompts):
+            return [[] for _ in prompts]  # nothing to decode: skip dispatch
         prompts = [p if p else [0] for p in prompts]
         lengths = np.array([len(p) for p in prompts], np.int32)
         bucket = pad_to_bucket(int(lengths.max()), self.seq_buckets)

@@ -668,3 +668,44 @@ class TestPbtE2E:
                 if a.name == alg.PBT_PARENT_KEY
             ]
             assert any(parents[3:]), parents
+
+
+class TestArchitectureSearch:
+    """NAS capability (SURVEY §2.3 suggestion zoo): architecture search is
+    HPO over model-shape parameters — the search space is layers/heads/
+    width ints and categoricals, driven by the same suggesters."""
+
+    ARCH_SPACE = [
+        ParameterSpec(name="layers", parameter_type=ParameterType.INT,
+                      feasible_space=FeasibleSpace(min=2, max=12)),
+        ParameterSpec(name="heads", parameter_type=ParameterType.INT,
+                      feasible_space=FeasibleSpace(min=2, max=16)),
+        ParameterSpec(name="ffn_mult", parameter_type=ParameterType.CATEGORICAL,
+                      feasible_space=FeasibleSpace(**{"list": [2.0, 2.667, 4.0]})),
+    ]
+
+    @staticmethod
+    def _quality(a) -> float:
+        # analytic proxy: quality peaks at layers=8, heads=8, ffn_mult=2.667
+        return -(
+            (a["layers"] - 8) ** 2 / 36
+            + (a["heads"] - 8) ** 2 / 49
+            + (0.0 if a["ffn_mult"] == 2.667 else 0.3)
+        )
+
+    @pytest.mark.parametrize("algorithm", ["tpe", "cmaes"])
+    def test_search_finds_good_architectures(self, algorithm):
+        history = []
+        s = alg.get_suggester(algorithm)
+        for i in range(24):
+            req = alg.SuggestRequest(
+                parameters=self.ARCH_SPACE,
+                objective_type=ObjectiveType.MAXIMIZE,
+                history=history, count=1, seed=i,
+                issued=len(history))
+            a = s.suggest(req)[0]
+            history.append(alg.Observation(
+                assignments=a, value=self._quality(a), trial=f"n-t{i:04d}"))
+        best = max(history, key=lambda ob: ob.value)
+        assert best.value > -0.35, best  # near the optimum shape
+        assert 5 <= best.assignments["layers"] <= 11

@@ -136,5 +136,5 @@ def test_ulysses_flash_core_matches_dense(qkv):
     ref = np.asarray(_causal_attention(q, k, v, 2))
     mesh = meshlib.build_mesh({"seq": 4}, devices=jax.devices()[:4])
     out = jax.jit(lambda q, k, v: ringlib.ulysses_attention(
-        q, k, v, q_per_kv=2, mesh=mesh, use_flash=True))(q, k, v)
+        q, k, v, q_per_kv=2, mesh=mesh, block_impl="flash"))(q, k, v)
     np.testing.assert_allclose(np.asarray(out), ref, atol=2e-5, rtol=2e-5)

@@ -440,3 +440,5 @@ class TestLlamaGeneratorRagged:
         assert len(out[1]) == 3
         solo = g.predict_batch([[5, 6, 7]])[0]
         assert out[1] == solo
+        # all-empty batch short-circuits without any device dispatch
+        assert g.predict_batch([[], []]) == [[], []]
