@@ -462,7 +462,9 @@ class Llama(nn.Module):
                 )
             x, _ = nn.scan(
                 scan_cls,
-                variable_axes={"params": 0, "cache": 0},
+                # intermediates: per-layer sown values (e.g. moe_aux_loss)
+                # stack along a leading layer axis
+                variable_axes={"params": 0, "cache": 0, "intermediates": 0},
                 split_rngs={"params": True},
                 in_axes=nn.broadcast,
                 length=cfg.num_layers,

@@ -100,7 +100,12 @@ class MoeMlp(nn.Module):
             "bske,bskc,bsk->bsec", dispatch_k, cap_onehot, gate_vals)
 
         # -- load-balance aux loss (Switch) -------------------------------
-        frac_tokens = dispatch_k.sum(axis=(1, 2)).mean(axis=0) / s  # [e]
+        # fraction of ASSIGNMENTS per expert, pre-capacity (expert_mask,
+        # not dispatch_k): counting only kept tokens would make dropping
+        # lower the loss — the optimizer then prefers collapse-with-drops
+        # over balance.  Normalized by s*k so fractions sum to 1; uniform
+        # routing gives aux = 1, full collapse ~ e.
+        frac_tokens = expert_mask.sum(axis=(1, 2)).mean(axis=0) / (s * k)
         mean_prob = probs.mean(axis=(0, 1))                         # [e]
         aux = e * jnp.sum(frac_tokens * mean_prob)
         self.sow("intermediates", "moe_aux_loss", aux)

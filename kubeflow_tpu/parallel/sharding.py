@@ -132,3 +132,17 @@ def param_shardings(abstract_params: Any, mesh: Mesh, overrides=None) -> Any:
     """
     logical_spec = nn.get_partition_spec(abstract_params)
     return nn.logical_to_mesh_sharding(logical_spec, mesh, rules_for_mesh(mesh, overrides))
+
+
+def constrain_microbatches(
+    micro: jax.Array, mesh: Mesh, batch_sharding: NamedSharding
+) -> jax.Array:
+    """Sharding constraint for a [accum, batch/accum, ...] microbatch stack:
+    the microbatch dim is replicated (lax.scan iterates it), the per-micro
+    batch dim keeps the global batch sharding.  Used by gradient
+    accumulation so each microbatch spans the full mesh instead of being
+    gathered onto a fraction of it."""
+    spec = PartitionSpec(
+        None, *batch_sharding.spec, *([None] * (micro.ndim - 2)))
+    return jax.lax.with_sharding_constraint(
+        micro, NamedSharding(mesh, spec))

@@ -36,6 +36,24 @@ from . import data as datalib
 PEAK_TFLOPS = {"tpu v5 lite": 197.0, "tpu v5": 197.0, "cpu": 0.0}
 
 
+def _sum_aux_losses(intermediates: dict) -> tuple[jax.Array, int]:
+    """(sum, element count) of every ``moe_aux_loss`` sown in the tree —
+    scan-stacked layers contribute one array of shape [num_layers], unrolled
+    layers one scalar each; the mean over elements is the mean over layers."""
+    from flax import traverse_util
+
+    leaves = [
+        leaf
+        for path, val in traverse_util.flatten_dict(intermediates).items()
+        if "moe_aux_loss" in path
+        for leaf in jax.tree.leaves(val)
+    ]
+    total = sum(
+        (jnp.sum(leaf.astype(jnp.float32)) for leaf in leaves),
+        start=jnp.zeros((), jnp.float32))
+    return total, sum(leaf.size for leaf in leaves)
+
+
 @dataclasses.dataclass
 class TrainConfig:
     model: llamalib.LlamaConfig = dataclasses.field(default_factory=llamalib.tiny)
@@ -52,6 +70,14 @@ class TrainConfig:
     b2: float = 0.95
     #: dtype of AdamW's first moment (HBM-bandwidth lever; None = f32)
     mu_dtype: Optional[Any] = jnp.bfloat16
+    #: weight on the MoE load-balancing auxiliary loss (Switch-style; only
+    #: active when the model routes through MoeMlp).  0 disables collection.
+    aux_loss_coef: float = 0.01
+    #: gradient accumulation: split each global batch into this many
+    #: microbatches, run them through a lax.scan, and average grads — the
+    #: effective batch stays global_batch while per-step activation memory
+    #: drops ~accum_steps-fold.  global_batch must be divisible by it.
+    accum_steps: int = 1
     checkpoint_dir: Optional[str] = None
     save_interval_steps: int = 100
     log_every: int = 10
@@ -165,21 +191,83 @@ class Trainer:
 
     def _loss_fn(self, params, tokens: jax.Array):
         inputs, targets = tokens[:, :-1], tokens[:, 1:]
+        aux = None
         if self.mesh.shape.get("pipeline", 1) > 1:
+            if self.cfg.model.moe_experts > 0 and self.cfg.aux_loss_coef > 0:
+                raise NotImplementedError(
+                    "MoE aux-loss collection is not plumbed through the "
+                    "pipelined executor; set aux_loss_coef=0 explicitly to "
+                    "train MoE under pipeline parallelism without balancing")
             logits = llamalib.pipelined_apply(
                 self.cfg.model, params, inputs,
                 mesh=self.mesh,
                 num_microbatches=self.cfg.num_microbatches,
             )
+        elif self.cfg.model.moe_experts > 0 and self.cfg.aux_loss_coef > 0.0:
+            # collect the sown Switch load-balancing loss — without this the
+            # router has no balancing gradient and can collapse onto one
+            # expert while the capacity factor silently drops the rest
+            logits, mut = self.model.apply(
+                {"params": params}, inputs, mutable=["intermediates"])
+            total, count = _sum_aux_losses(mut["intermediates"])
+            aux = total / jnp.maximum(count, 1)
         else:
             logits = self.model.apply({"params": params}, inputs)
         loss = optax.softmax_cross_entropy_with_integer_labels(
             logits.astype(jnp.float32), targets).mean()
+        if aux is not None:
+            loss = loss + self.cfg.aux_loss_coef * aux
         return loss
 
+    def _grads_fn(self, params, tokens: jax.Array):
+        """(loss, grads) for one global batch, microbatched when
+        ``accum_steps > 1``.  Microbatches are strided slices of the batch
+        dim (rows i, accum+i, ...) so each one stays evenly spread over the
+        mesh's batch axes; grads accumulate in f32 regardless of param
+        dtype and are averaged back to the param dtype at the end."""
+        accum = self.cfg.accum_steps
+        if accum <= 1:
+            return jax.value_and_grad(self._loss_fn)(params, tokens)
+        b = tokens.shape[0]
+        if b % accum:
+            raise ValueError(
+                f"global_batch {b} not divisible by accum_steps {accum}")
+        # each microbatch must still tile the mesh's batch shards exactly:
+        # indivisible microbatches force XLA into its padded replicate-then-
+        # repartition fallback, whose gather-gradient scatter is observed to
+        # produce wrong embedding grads on the CPU SPMD backend — and it
+        # would be a terrible layout on TPU anyway
+        spec0 = self.batch_sharding.spec[0]
+        axes = (spec0,) if isinstance(spec0, str) else (spec0 or ())
+        n_shards = 1
+        for a in axes:
+            n_shards *= self.mesh.shape[a]
+        if (b // accum) % n_shards:
+            raise ValueError(
+                f"microbatch {b // accum} (global_batch {b} / accum_steps "
+                f"{accum}) not divisible by the mesh's {n_shards} batch shards")
+        micro = tokens.reshape(b // accum, accum, -1).swapaxes(0, 1)
+        micro = shardlib.constrain_microbatches(
+            micro, self.mesh, self.batch_sharding)
+        grad_fn = jax.value_and_grad(self._loss_fn)
+
+        def body(carry, mb):
+            acc_loss, acc = carry
+            loss, grads = grad_fn(params, mb)
+            acc = jax.tree.map(
+                lambda a, g: a + g.astype(jnp.float32), acc, grads)
+            return (acc_loss + loss, acc), None
+
+        zeros = jax.tree.map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), params)
+        (loss_sum, grad_sum), _ = jax.lax.scan(
+            body, (jnp.zeros((), jnp.float32), zeros), micro)
+        grads = jax.tree.map(
+            lambda g, p: (g / accum).astype(p.dtype), grad_sum, params)
+        return loss_sum / accum, grads
+
     def _train_step(self, state, batch):
-        loss, grads = jax.value_and_grad(self._loss_fn)(
-            state["params"], batch["tokens"])
+        loss, grads = self._grads_fn(state["params"], batch["tokens"])
         grad_norm = optax.global_norm(grads)
         updates, opt_state = self.tx.update(
             grads, state["opt_state"], state["params"])

@@ -107,3 +107,97 @@ def test_reshape_restore_across_meshes(tmp_ckpt_dir):
     )
     out = t2.train()
     assert out.step == 5
+
+
+class TestGradAccumulation:
+    """accum_steps splits the batch into scanned microbatches; grads and
+    loss must match the unaccumulated step at equal effective batch."""
+
+    def test_loss_and_grads_match_unaccumulated(self):
+        t1 = trainlib.Trainer(_cfg(accum_steps=1, global_batch=16))
+        t4 = trainlib.Trainer(_cfg(accum_steps=4, global_batch=16))
+        state = t1.init_state(seed=0)
+        batch = datalib.SyntheticLm(16, 32, 256).local_batch(0)
+        tokens = jax.device_put(batch["tokens"], t1.batch_sharding)
+        loss1, g1 = jax.jit(t1._grads_fn)(state["params"], tokens)
+        loss4, g4 = jax.jit(t4._grads_fn)(state["params"], tokens)
+        np.testing.assert_allclose(float(loss1), float(loss4), rtol=1e-5)
+        flat1 = jax.tree.leaves(g1)
+        flat4 = jax.tree.leaves(g4)
+        for a, b in zip(flat1, flat4):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=1e-5, rtol=1e-4)
+
+    def test_indivisible_accum_rejected(self):
+        t = trainlib.Trainer(_cfg(accum_steps=3))
+        state = t.init_state(seed=0)
+        batch = datalib.SyntheticLm(8, 32, 256).local_batch(0)
+        with pytest.raises(ValueError, match="not divisible"):
+            jax.jit(t._grads_fn)(state["params"], batch["tokens"])
+
+    def test_microbatch_must_tile_batch_shards(self):
+        # batch 8 over 4 batch shards: accum 4 -> 2-row microbatches, which
+        # cannot tile the shards; must be rejected, not silently mis-sharded
+        t = trainlib.Trainer(_cfg(accum_steps=4))
+        state = t.init_state(seed=0)
+        batch = datalib.SyntheticLm(8, 32, 256).local_batch(0)
+        with pytest.raises(ValueError, match="batch shards"):
+            jax.jit(t._grads_fn)(state["params"], batch["tokens"])
+
+    def test_training_descends_with_accumulation(self):
+        t = trainlib.Trainer(_cfg(steps=20, learning_rate=1e-2, accum_steps=2))
+        seen = []
+        t.train(on_metrics=lambda m: seen.append(m))
+        assert seen[-1].loss < seen[0].loss
+
+
+class TestMoeAuxLoss:
+    """The Switch load-balancing loss must reach the objective (round-2
+    verdict weak #1: sown but never consumed = balancing no-op)."""
+
+    def _moe_trainer(self, coef):
+        return trainlib.Trainer(_cfg(
+            model=llama.tiny(moe_experts=4, moe_top_k=1,
+                             moe_capacity_factor=2.0),
+            mesh_axes={"data": 8},
+            steps=40, learning_rate=5e-3, aux_loss_coef=coef))
+
+    def _eval_aux(self, t, state, tokens):
+        _, mut = t.model.apply(
+            {"params": state["params"]}, tokens, mutable=["intermediates"])
+        total, count = trainlib._sum_aux_losses(mut["intermediates"])
+        return float(total) / count
+
+    def test_aux_loss_added_to_objective(self):
+        t = self._moe_trainer(coef=1.0)
+        t0 = self._moe_trainer(coef=0.0)
+        state = t.init_state(seed=0)
+        tokens = datalib.SyntheticLm(8, 32, 256).local_batch(0)["tokens"]
+        with_aux = float(jax.jit(t._loss_fn)(state["params"], tokens))
+        without = float(jax.jit(t0._loss_fn)(state["params"], tokens))
+        aux = self._eval_aux(t, state, tokens[:, :-1])
+        np.testing.assert_allclose(with_aux - without, aux, rtol=1e-3)
+
+    def test_training_moves_expert_balance(self):
+        """On a narrow-vocab corpus (8 distinct tokens -> 8 fixed embedding
+        vectors) routing is structurally imbalanced at init; training with
+        aux_loss_coef>0 drives the Switch aux metric to ~1 (balance), while
+        coef=0 leaves the imbalance in place."""
+        def batch(i):
+            r = np.random.RandomState(1000 + i)
+            return jax.numpy.asarray(r.randint(0, 8, size=(8, 33)), "int32")
+
+        eval_tokens = batch(999)[:, :32]
+
+        def train(coef):
+            t = self._moe_trainer(coef)
+            state = t.init_state(seed=0)
+            step_fn = t.compiled_step()
+            for i in range(t.cfg.steps):
+                state, _ = step_fn(state, {"tokens": batch(i)})
+            return self._eval_aux(t, state, eval_tokens)
+
+        aux_balanced = train(coef=1.0)
+        aux_free = train(coef=0.0)
+        assert aux_balanced < 1.08          # ~1.0 == uniform routing
+        assert aux_free > aux_balanced + 0.1
