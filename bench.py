@@ -1,6 +1,15 @@
 """Benchmark: Llama training throughput, tokens/sec/chip (BASELINE metric).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Line 1 (the driver's row, schema frozen): the 271M flagship at seq 1024 —
+{"metric", "value", "unit", "vs_baseline"}.
+
+Additional TPU-only rows (same schema, one JSON line each) keep the
+long-context and billion-scale claims under the driver's eye every round
+(round-2 verdict weak #7):
+  line 2 — the same flagship at seq 4096 (flash attention's regime;
+           full-recompute remat to fit HBM);
+  line 3 — the 1.19B single-chip config (largest that fits 16 GiB:
+           Adafactor + grad accumulation + full recompute; PERF.md).
 
 BASELINE.json ships no published numbers ("published": {}), so the
 comparison point is the roofline: value / (tokens/sec/chip at 40% MFU on
@@ -12,7 +21,9 @@ CPU reference constant so the number is still comparable run-to-run.
 
 from __future__ import annotations
 
+import dataclasses
 import json
+import statistics
 import time
 
 import jax
@@ -30,24 +41,21 @@ TARGET_MFU = 0.40
 CPU_REFERENCE_TPS = 2000.0  # fixed constant for CPU-only comparability
 
 
-def main() -> None:
+def measure(model, batch, seq, *, windows=WINDOWS, steps=MEASURED_STEPS,
+            **train_kw) -> float:
+    """Median-window tokens/sec/chip for one config (async dispatch, one
+    host sync per window — per-step syncs are ~100ms each on the
+    remote-dispatch PJRT backend and measure the tunnel, not the chip)."""
     devices = jax.devices()
-    on_tpu = devices[0].platform == "tpu"
-    if on_tpu:
-        model = _bench_model()
-        batch, seq = 14, 1024
-    else:
-        model = llamalib.tiny()
-        batch, seq = 8, 128
-
     cfg = trainlib.TrainConfig(
         model=model,
         mesh_axes={"data": len(devices)} if len(devices) > 1 else {},
         global_batch=batch,
         seq_len=seq,
-        steps=WARMUP_STEPS + WINDOWS * MEASURED_STEPS,
+        steps=WARMUP_STEPS + windows * steps,
         warmup_steps=2,
         log_every=10_000,  # quiet
+        **train_kw,
     )
     t = trainlib.Trainer(cfg, devices=devices)
     source = datalib.SyntheticLm(
@@ -63,11 +71,6 @@ def main() -> None:
             for k, v in source.local_batch(step).items()
         }
 
-    # Steady-state protocol: steps are enqueued asynchronously and the host
-    # blocks once per measured window (matching Trainer.train's metering).
-    # Synchronizing on the loss every step would serialize a full host
-    # round-trip into each step — on a remote-dispatch PJRT backend that is
-    # ~100ms/step of pure dispatch latency, not training throughput.
     window_times = []
     step = 0
     with shardlib.shard_context(t.mesh):
@@ -77,35 +80,64 @@ def main() -> None:
         # device_get, not block_until_ready: some PJRT backends (axon
         # tunnel) report ready before remote execution completes
         float(jax.device_get(out["loss"]))
-        for _ in range(WINDOWS):
+        for _ in range(windows):
             t0 = time.perf_counter()
-            for _ in range(MEASURED_STEPS):
+            for _ in range(steps):
                 state, out = step_fn(state, put(step))
                 step += 1
             float(jax.device_get(out["loss"]))
-            window_times.append((time.perf_counter() - t0) / MEASURED_STEPS)
+            window_times.append((time.perf_counter() - t0) / steps)
 
-    window_times.sort()
-    median = window_times[len(window_times) // 2]
-    n_chips = len(devices)
-    tps_chip = batch * seq / median / n_chips
+    # true median (even window counts average the middle two — picking
+    # index len//2 would report the worse window, a different statistic
+    # than the odd-window rows)
+    return batch * seq / statistics.median(window_times) / len(jax.devices())
 
+
+def report(metric: str, model, batch, seq, tps_chip: float) -> None:
     flops_tok = llamalib.flops_per_token(model, seq)
-    kind = getattr(devices[0], "device_kind", "cpu").lower()
+    kind = getattr(jax.devices()[0], "device_kind", "cpu").lower()
     peak = trainlib.PEAK_TFLOPS.get(kind, 0.0)
     if peak:
         target_tps = TARGET_MFU * peak * 1e12 / flops_tok
         vs_baseline = tps_chip / target_tps
     else:
         vs_baseline = tps_chip / CPU_REFERENCE_TPS
-
     print(json.dumps({
-        "metric": "llama_train_tokens_per_sec_per_chip",
+        "metric": metric,
         "value": round(tps_chip, 2),
         "unit": f"tokens/s/chip (model={llamalib.num_params(model)/1e6:.0f}M, "
                 f"seq={seq}, {kind})",
         "vs_baseline": round(vs_baseline, 4),
-    }))
+    }), flush=True)
+
+
+def main() -> None:
+    on_tpu = jax.devices()[0].platform == "tpu"
+
+    # -- line 1: the frozen driver row ----------------------------------
+    if on_tpu:
+        model, batch, seq = _bench_model(), 14, 1024
+    else:
+        model, batch, seq = llamalib.tiny(), 8, 128
+    tps = measure(model, batch, seq)
+    report("llama_train_tokens_per_sec_per_chip", model, batch, seq, tps)
+
+    if not on_tpu:
+        return
+
+    # -- line 2: long-context row (seq 4096, flash + full recompute) ----
+    model4k = dataclasses.replace(
+        _bench_model(), max_seq_len=4096, remat_policy="nothing")
+    tps = measure(model4k, 12, 4096, windows=2, steps=5)
+    report("llama_train_tokens_per_sec_per_chip_seq4096",
+           model4k, 12, 4096, tps)
+
+    # -- line 3: billion-scale single-chip row --------------------------
+    model1b = llamalib.llama_1b()
+    tps = measure(model1b, 16, 2048, windows=2, steps=5,
+                  accum_steps=8, optimizer="adafactor")
+    report("llama1b_train_tokens_per_sec_per_chip", model1b, 16, 2048, tps)
 
 
 if __name__ == "__main__":

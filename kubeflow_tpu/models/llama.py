@@ -52,6 +52,12 @@ class LlamaConfig:
     dtype: Dtype = jnp.bfloat16
     param_dtype: Dtype = jnp.float32
     remat: bool = True
+    #: what the rematerializer keeps across the backward pass:
+    #: "dots" saves every matmul output (fastest recompute; ~2.7 GB/chip of
+    #: saved ffn activations at 7B/seq-4096 — fine when HBM is ample);
+    #: "nothing" saves only the per-layer carry (full recompute, the
+    #: standard large-model setting — what lets 7B fit v5e's 16 GiB).
+    remat_policy: str = "dots"
     scan_layers: bool = True
     #: "dense" = full causal attention (XLA-fused; fastest <= ~2k seq);
     #: "flash" = our Pallas flash kernel (wins at long seq: measured 1.4x
@@ -83,6 +89,8 @@ class LlamaConfig:
             raise ValueError("num_heads must be a multiple of num_kv_heads")
         if self.attention_impl not in ("dense", "flash", "ring"):
             raise ValueError(f"unknown attention_impl {self.attention_impl!r}")
+        if self.remat_policy not in ("dots", "nothing"):
+            raise ValueError(f"unknown remat_policy {self.remat_policy!r}")
 
 
 # -- presets ----------------------------------------------------------------
@@ -105,6 +113,19 @@ def tiny(**kw) -> LlamaConfig:
 
 def llama2_7b(**kw) -> LlamaConfig:
     return LlamaConfig(**kw)
+
+
+def llama_1b(**kw) -> LlamaConfig:
+    """~1.19B — the largest config that trains on ONE 16 GiB v5e chip with
+    an f32-param Adafactor setup (full-recompute remat + flash attention +
+    gradient accumulation; see PERF.md).  Shape follows the 7B recipe at
+    half width: 21L / 2048h / 16 heads / 5504 ffn."""
+    return _preset(
+        dict(hidden_size=2048, intermediate_size=5504, num_layers=21,
+             num_heads=16, num_kv_heads=16, max_seq_len=2048,
+             remat_policy="nothing", attention_impl="flash"),
+        kw,
+    )
 
 
 def llama2_13b(**kw) -> LlamaConfig:
@@ -324,6 +345,16 @@ class Mlp(nn.Module):
             ("mlp", "embed"), name="w_down")(h)
 
 
+def remat_policy(cfg: LlamaConfig):
+    """Checkpoint policy object for ``cfg.remat_policy`` (None = save
+    nothing: jax.checkpoint's default full-recompute behavior)."""
+    if cfg.remat_policy == "dots":
+        return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    if cfg.remat_policy == "nothing":
+        return None
+    raise ValueError(f"unknown remat_policy {cfg.remat_policy!r}")
+
+
 class Block(nn.Module):
     cfg: LlamaConfig
     decode: bool = False
@@ -448,18 +479,12 @@ class Llama(nn.Module):
         block_cls = Block
         if cfg.remat:
             block_cls = nn.remat(
-                Block,
-                policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
-                prevent_cse=False,
-            )
+                Block, policy=remat_policy(cfg), prevent_cse=False)
         if cfg.scan_layers:
             scan_cls = _ScanBlock
             if cfg.remat:
                 scan_cls = nn.remat(
-                    _ScanBlock,
-                    policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
-                    prevent_cse=False,
-                )
+                    _ScanBlock, policy=remat_policy(cfg), prevent_cse=False)
             x, _ = nn.scan(
                 scan_cls,
                 # intermediates: per-layer sown values (e.g. moe_aux_loss)

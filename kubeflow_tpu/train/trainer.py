@@ -68,6 +68,11 @@ class TrainConfig:
     grad_clip_norm: float = 1.0
     b1: float = 0.9
     b2: float = 0.95
+    #: "adamw" (default) or "adafactor".  Adafactor's factored second
+    #: moments + no first moment cut optimizer state from ~6 bytes/param
+    #: to ~0 — the classic TPU big-model recipe (T5/PaLM) and what lets a
+    #: >1B model train on a single 16 GiB v5e chip.
+    optimizer: str = "adamw"
     #: dtype of AdamW's first moment (HBM-bandwidth lever; None = f32)
     mu_dtype: Optional[Any] = jnp.bfloat16
     #: weight on the MoE load-balancing auxiliary loss (Switch-style; only
@@ -117,12 +122,12 @@ class Trainer:
         axes = dict(cfg.mesh_axes) or {"data": len(devices)}
         self.mesh = meshlib.build_mesh(axes, devices=devices, num_slices=cfg.num_slices)
         self.model = llamalib.Llama(cfg.model)
-        self.tx = optax.chain(
-            optax.clip_by_global_norm(cfg.grad_clip_norm),
-            optax.adamw(
-                optax.warmup_cosine_decay_schedule(
-                    0.0, cfg.learning_rate, cfg.warmup_steps,
-                    max(cfg.steps, cfg.warmup_steps + 1)),
+        schedule = optax.warmup_cosine_decay_schedule(
+            0.0, cfg.learning_rate, cfg.warmup_steps,
+            max(cfg.steps, cfg.warmup_steps + 1))
+        if cfg.optimizer == "adamw":
+            opt = optax.adamw(
+                schedule,
                 b1=cfg.b1, b2=cfg.b2, weight_decay=cfg.weight_decay,
                 # bf16 first moment: halves mu's HBM read+write per step
                 # (the optimizer update is pure bandwidth); nu stays f32 —
@@ -130,8 +135,18 @@ class Trainer:
                 # measurably hurts convergence, bf16 mu does not (standard
                 # large-scale practice)
                 mu_dtype=cfg.mu_dtype,
-            ),
-        )
+            )
+        elif cfg.optimizer == "adafactor":
+            # no decoupled weight decay here: optax applies
+            # weight_decay_rate per-step UNSCALED by the learning rate
+            # (it chains add_decayed_weights after scale_by_learning_rate),
+            # so AdamW's 0.1 convention would shrink params ~10%/step.
+            # T5/PaLM-style Adafactor training runs without it.
+            opt = optax.adafactor(schedule, min_dim_size_to_factor=128)
+        else:
+            raise ValueError(f"unknown optimizer {cfg.optimizer!r}")
+        self.tx = optax.chain(
+            optax.clip_by_global_norm(cfg.grad_clip_norm), opt)
         self.batch_sharding = meshlib.batch_sharding(self.mesh)
         self._step_fn = None
         self._abstract_state = None
@@ -150,10 +165,18 @@ class Trainer:
         dummy = jnp.ones((self.cfg.global_batch, self.cfg.seq_len), jnp.int32)
         variables = self.model.init(rng, dummy)
         params = variables["params"]
+        # AdamW moments mirror the param shapes, so initializing from the
+        # BOXED params propagates each param's logical sharding onto its
+        # moments (FSDP shards them too).  Adafactor's factored state has
+        # different ranks than the params — the copied 2-axis metadata
+        # would be invalid on its rank-1 rows/cols, and the state is small
+        # enough that replication (no metadata) is the right layout.
+        opt_params = (
+            params if self.cfg.optimizer == "adamw" else nn.meta.unbox(params))
         return {
             "step": jnp.zeros((), jnp.int32),
             "params": params,
-            "opt_state": self.tx.init(params),
+            "opt_state": self.tx.init(opt_params),
         }
 
     def abstract_state(self) -> Any:

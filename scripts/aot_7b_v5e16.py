@@ -1,0 +1,169 @@
+"""AOT-compile the Llama-7B train step for a v5e-16 topology — no hardware.
+
+The BASELINE headline metric is "JAXJob Llama-7B tokens/sec/chip on v5e-16"
+(SURVEY.md §6), but multi-chip hardware cannot be attached to this machine.
+JAX's topology AOT path closes the gap: ``jax.experimental.topologies`` hands
+back 16 abstract v5e devices, the sharded train step lowers and compiles
+against them exactly as it would on the real slice, and the compiled
+executable reports XLA's per-chip memory breakdown and FLOP count.  That is
+the strongest multi-chip evidence available without chips:
+
+- the full FSDP/TP-sharded 7B step *compiles* for the real target (every
+  collective, layout, and remat decision is the real one);
+- XLA's memory analysis proves the step *fits* v5e HBM (16 GiB/chip);
+- the FLOP count + the MFU measured on the one real chip at 271M/1.1B scale
+  give a defensible tokens/sec/chip projection.
+
+Usage:  python scripts/aot_7b_v5e16.py [--fast]
+Writes: artifacts/aot_7b_v5e16.json (one entry per mesh candidate).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")  # host side traces on CPU
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+from jax.experimental import topologies  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from kubeflow_tpu.models import llama  # noqa: E402
+from kubeflow_tpu.parallel import sharding as shardlib  # noqa: E402
+from kubeflow_tpu.train import trainer as trainlib  # noqa: E402
+
+V5E_HBM_BYTES = 16 * 1024**3          # 16 GiB per v5e chip
+V5E_PEAK_FLOPS = 197e12               # bf16
+
+
+def compile_candidate(devs, mesh_axes, *, global_batch, seq_len, accum_steps,
+                      model_cfg):
+    cfg = trainlib.TrainConfig(
+        model=model_cfg,
+        mesh_axes=mesh_axes,
+        global_batch=global_batch,
+        seq_len=seq_len,
+        accum_steps=accum_steps,
+    )
+    t = trainlib.Trainer(cfg, devices=devs)
+    state = t.abstract_state()
+    batch = {"tokens": jax.ShapeDtypeStruct(
+        (global_batch, seq_len + 1), np.int32, sharding=t.batch_sharding)}
+    t0 = time.perf_counter()
+    with shardlib.shard_context(t.mesh):
+        compiled = t.compiled_step().lower(state, batch).compile()
+    compile_s = time.perf_counter() - t0
+
+    mem = compiled.memory_analysis()
+    cost = compiled.cost_analysis()
+    n_chips = len(devs)
+    # donated state aliases its output, so the live set per chip is
+    # arguments (state + batch) + temps; outputs reuse the state's bytes
+    peak_bytes = mem.argument_size_in_bytes + mem.temp_size_in_bytes
+    # analytic FLOPs, not XLA's cost_analysis: XLA counts each while-loop
+    # body ONCE, so the scanned layer stack (and the grad-accum scan)
+    # under-report by ~num_layers x.  6N + attention-quadratic per token,
+    # x3 for fwd+bwd is already folded into flops_per_token's factor.
+    flops_per_step_chip = (
+        llama.flops_per_token(model_cfg, seq_len)
+        * global_batch * seq_len / n_chips)
+    tokens_per_step = global_batch * seq_len
+    # projection: chip-seconds per step at an MFU, tokens/s/chip = tokens /
+    # (n_chips * step_time); collective overlap and host gaps land inside
+    # the assumed MFU, which is why we quote the measured single-chip MFU
+    proj = {}
+    for mfu in (0.4, 0.5, 0.56):
+        step_s = flops_per_step_chip / (V5E_PEAK_FLOPS * mfu)
+        proj[f"tokens_per_sec_per_chip@mfu{mfu}"] = round(
+            tokens_per_step / (n_chips * step_s), 1)
+    return {
+        "mesh_axes": mesh_axes,
+        "global_batch": global_batch,
+        "seq_len": seq_len,
+        "accum_steps": accum_steps,
+        "compile_seconds": round(compile_s, 1),
+        "argument_bytes_per_chip": mem.argument_size_in_bytes,
+        "temp_bytes_per_chip": mem.temp_size_in_bytes,
+        "output_bytes_per_chip": mem.output_size_in_bytes,
+        "peak_live_bytes_per_chip": peak_bytes,
+        "hbm_bytes": V5E_HBM_BYTES,
+        "fits_hbm": bool(peak_bytes <= V5E_HBM_BYTES),
+        "hbm_utilization": round(peak_bytes / V5E_HBM_BYTES, 3),
+        "flops_per_step_per_chip": flops_per_step_chip,
+        "xla_reported_flops": float(cost.get("flops", 0.0)),
+        "projection": proj,
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--fast", action="store_true",
+                    help="compile only the primary candidate")
+    ap.add_argument("--topology", default="v5e:4x4")
+    args = ap.parse_args()
+
+    topo = topologies.get_topology_desc(args.topology, platform="tpu")
+    devs = list(topo.devices)
+    # 32L / 4096h / 32 heads / 11008 ffn.  Full-recompute remat (only the
+    # per-layer carry survives the forward scan) + the Pallas flash kernel
+    # (no materialized 4096^2 score matrix) are what fit 7B training into
+    # v5e's 16 GiB; the "dots" policy alone holds ~2.7 GB of saved ffn
+    # activations per chip and OOMs by ~1.5 GB.
+    model_cfg = llama.llama2_7b(remat_policy="nothing", attention_impl="flash")
+    n_params = llama.num_params(model_cfg)
+    print(f"topology {args.topology}: {len(devs)} x {devs[0].device_kind}; "
+          f"model params {n_params/1e9:.2f}B", file=sys.stderr)
+
+    candidates = [
+        # primary: FSDP over all 16 chips, grad-accum for effective batch
+        dict(mesh_axes={"fsdp": 16}, global_batch=16, seq_len=4096,
+             accum_steps=1),
+        dict(mesh_axes={"fsdp": 8, "model": 2}, global_batch=16, seq_len=4096,
+             accum_steps=2),
+        dict(mesh_axes={"fsdp": 4, "model": 4}, global_batch=16, seq_len=4096,
+             accum_steps=4),
+    ]
+    if args.fast:
+        candidates = candidates[:1]
+
+    results = []
+    for cand in candidates:
+        print(f"compiling {cand} ...", file=sys.stderr)
+        try:
+            r = compile_candidate(devs, model_cfg=model_cfg, **cand)
+        except Exception as e:  # keep the sweep going; record the failure
+            r = {**cand, "error": f"{type(e).__name__}: {e}"}
+        results.append(r)
+        print(json.dumps(r), file=sys.stderr)
+
+    out = {
+        "topology": args.topology,
+        "n_chips": len(devs),
+        "model": "llama2_7b",
+        "n_params": n_params,
+        "results": results,
+    }
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "artifacts", "aot_7b_v5e16.json")
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps({
+        "metric": "aot_7b_v5e16_fits_hbm",
+        "value": sum(1 for r in results if r.get("fits_hbm")),
+        "unit": f"of {len(results)} shardings",
+    }))
+
+
+if __name__ == "__main__":
+    main()

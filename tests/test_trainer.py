@@ -201,3 +201,41 @@ class TestMoeAuxLoss:
         aux_free = train(coef=0.0)
         assert aux_balanced < 1.08          # ~1.0 == uniform routing
         assert aux_free > aux_balanced + 0.1
+
+
+class TestTrainerKnobs:
+    """Optimizer choice + remat policy (the levers behind the 1B single-chip
+    and 7B AOT configs; PERF.md / BASELINE.md)."""
+
+    def test_adafactor_trains(self):
+        t = trainlib.Trainer(_cfg(steps=20, learning_rate=1e-2,
+                                  optimizer="adafactor"))
+        seen = []
+        t.train(on_metrics=lambda m: seen.append(m))
+        assert seen[-1].loss < seen[0].loss
+
+    def test_unknown_optimizer_rejected(self):
+        with pytest.raises(ValueError, match="unknown optimizer"):
+            trainlib.Trainer(_cfg(optimizer="sgd"))
+
+    def test_remat_policy_nothing_matches_dots(self):
+        """Remat policy changes memory, never math: losses identical."""
+        model_a = llama.tiny(remat=True, remat_policy="dots")
+        model_b = llama.tiny(remat=True, remat_policy="nothing")
+        ta = trainlib.Trainer(_cfg(model=model_a))
+        tb = trainlib.Trainer(_cfg(model=model_b))
+        state = ta.init_state(seed=0)
+        tokens = datalib.SyntheticLm(8, 32, 256).local_batch(0)["tokens"]
+        la, ga = jax.jit(ta._grads_fn)(state["params"], tokens)
+        lb, gb = jax.jit(tb._grads_fn)(state["params"], tokens)
+        np.testing.assert_allclose(float(la), float(lb), rtol=1e-6)
+        for a, b in zip(jax.tree.leaves(ga), jax.tree.leaves(gb)):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=1e-5, rtol=1e-4)
+
+    def test_llama_1b_preset_shape(self):
+        cfg = llama.llama_1b()
+        n = llama.num_params(cfg)
+        assert 1.15e9 < n < 1.25e9
+        assert cfg.remat_policy == "nothing"
+        assert cfg.attention_impl == "flash"
