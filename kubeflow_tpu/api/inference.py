@@ -52,6 +52,12 @@ class InferenceServiceSpec(_Model):
     predictor: ComponentSpec = Field(default_factory=ComponentSpec)
     transformer: Optional[ComponentSpec] = None
     explainer: Optional[ComponentSpec] = None
+    #: KServe canary rollout [upstream: kserve ->
+    #: pkg/apis/serving/v1beta1/inference_service.go CanaryTrafficPercent]:
+    #: when set and the spec changes, the previous revision keeps serving
+    #: (100 - p)% of traffic while the new revision gets p%.  100 (or
+    #: None) rolls the change out fully; reverting the spec rolls back.
+    canary_traffic_percent: Optional[int] = Field(default=None, ge=0, le=100)
 
 
 class InferenceServicePhase(str, enum.Enum):
@@ -66,6 +72,15 @@ class InferenceServiceStatus(_Model):
     url: Optional[str] = None
     active_replicas: int = 0
     message: str = ""
+    #: revision bookkeeping (KServe's latestRolledOutRevision /
+    #: latestCreatedRevision analog): monotonically increasing ints
+    stable_revision: int = 0
+    canary_revision: Optional[int] = None
+    #: live traffic share of the canary revision (0 when no canary)
+    canary_traffic: int = 0
+    #: the stable revision's spec (minus traffic split) — what the SDK's
+    #: ``rollback`` verb restores
+    stable_spec: Optional[dict] = None
 
 
 class InferenceService(TypedObject):

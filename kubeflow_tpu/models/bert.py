@@ -227,3 +227,44 @@ class BertClassifier(nn.Module):
         return Einsum("be,ec->bc", (self.cfg.hidden_size, self.cfg.num_classes),
                       ("embed", None), self.cfg.dtype, self.cfg.param_dtype,
                       name="classifier")(pooled.astype(self.cfg.dtype))
+
+
+# -- pretrained snapshot IO (HF-layout directories) -------------------------
+
+
+def save_pretrained(path: str, cfg: BertConfig, params: Any) -> None:
+    """Write an HF-layout snapshot: ``config.json`` + ``weights.msgpack``
+    (flax serialization).  What ``hf://`` snapshots under $KFT_HF_HOME
+    contain, and what ``load_pretrained`` reads back."""
+    import json
+    import os
+
+    from flax import serialization
+
+    os.makedirs(path, exist_ok=True)
+    d = dataclasses.asdict(cfg)
+    d["dtype"] = jnp.dtype(cfg.dtype).name
+    d["param_dtype"] = jnp.dtype(cfg.param_dtype).name
+    with open(os.path.join(path, "config.json"), "w") as f:
+        json.dump(d, f, indent=1)
+    with open(os.path.join(path, "weights.msgpack"), "wb") as f:
+        f.write(serialization.msgpack_serialize(
+            jax.tree.map(lambda x: jax.device_get(x), nn.meta.unbox(params))))
+
+
+def load_pretrained(path: str) -> tuple[BertConfig, Any]:
+    """Read a snapshot written by ``save_pretrained`` (or any directory in
+    that layout) into (config, params)."""
+    import json
+    import os
+
+    from flax import serialization
+
+    with open(os.path.join(path, "config.json")) as f:
+        d = json.load(f)
+    d["dtype"] = jnp.dtype(d["dtype"])
+    d["param_dtype"] = jnp.dtype(d["param_dtype"])
+    cfg = BertConfig(**d)
+    with open(os.path.join(path, "weights.msgpack"), "rb") as f:
+        params = serialization.msgpack_restore(f.read())
+    return cfg, params

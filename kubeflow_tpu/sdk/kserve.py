@@ -80,6 +80,63 @@ class KServeClient:
             f"InferenceService {name}: not Ready within {timeout}s "
             f"(last: {isvc.status if isvc else None})")
 
+    # -- canary rollout (KServe canaryTrafficPercent verbs) -------------------
+
+    def _update_spec(self, name: str, namespace: str, mut) -> InferenceService:
+        from ..api.inference import KIND_INFERENCE_SERVICE as KIND
+
+        def apply(o):
+            assert isinstance(o, InferenceService)
+            mut(o)
+
+        out = self.cluster.store.update_with_retry(KIND, name, namespace, apply)
+        assert isinstance(out, InferenceService)
+        return out
+
+    def rollout(
+        self, name: str, spec_update: dict, traffic_percent: int,
+        namespace: str = "default",
+    ) -> InferenceService:
+        """Deploy a spec change as a canary at ``traffic_percent``%; the
+        current revision keeps serving the rest.  ``spec_update`` is a
+        partial spec dict merged over the current one (e.g.
+        ``{"predictor": {...}}`` replaces the predictor)."""
+        from ..api.inference import InferenceServiceSpec
+
+        def mut(o: InferenceService) -> None:
+            merged = o.spec.model_dump(mode="json")
+            merged.update(spec_update)
+            merged["canary_traffic_percent"] = traffic_percent
+            o.spec = InferenceServiceSpec.model_validate(merged)
+
+        return self._update_spec(name, namespace, mut)
+
+    def promote(self, name: str, namespace: str = "default") -> InferenceService:
+        """Roll the canary revision out fully (it becomes the stable
+        revision; the old one drains)."""
+        def mut(o: InferenceService) -> None:
+            o.spec.canary_traffic_percent = None
+
+        return self._update_spec(name, namespace, mut)
+
+    def rollback(self, name: str, namespace: str = "default") -> InferenceService:
+        """Abandon the canary: restore the stable revision's spec (recorded
+        in status.stable_spec by the controller)."""
+        from ..api.inference import InferenceServiceSpec
+
+        isvc = self.get(name, namespace)
+        if isvc is None:
+            raise RuntimeError(f"InferenceService {name} not found")
+        if not isvc.status.stable_spec:
+            raise RuntimeError(f"InferenceService {name} has no recorded stable spec")
+        restored = InferenceServiceSpec.model_validate(isvc.status.stable_spec)
+
+        def mut(o: InferenceService) -> None:
+            o.spec = restored.model_copy(deep=True)
+            o.spec.canary_traffic_percent = None
+
+        return self._update_spec(name, namespace, mut)
+
     # -- data plane (V1 protocol) ---------------------------------------------
 
     def _post(self, url: str, payload: dict, timeout: float) -> dict:

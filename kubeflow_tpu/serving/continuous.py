@@ -1,0 +1,554 @@
+"""Continuous batching for LLM serving: cross-request decode scheduling.
+
+[upstream: kserve/kserve -> python/huggingfaceserver (vLLM backend)] — the
+reference's LLM runtime delegates to vLLM, whose defining capability is
+*continuous batching*: requests join and leave the running decode batch at
+token boundaries instead of waiting for the current batch to finish
+(SURVEY.md §2.2 per-framework runtimes row).  ``LlamaGenerator``
+(runtimes.py) decodes each micro-batch to completion — a request arriving
+one token after a 64-token batch started waits ~64 token-steps for its
+first token.  This module removes that wait.
+
+TPU-first design (vs vLLM's CUDA paged-attention pool):
+
+- **Slot pool, not pages.**  A fixed-shape KV cache of ``num_slots`` rows
+  (the per-row-position cache from models/llama.py `_decode_attend`):
+  XLA wants static shapes, so the pool is compiled once and requests map
+  onto *slots*.  A retired slot is reused without clearing — the per-row
+  causal mask makes stale KV past a row's live front invisible, exactly
+  the ragged-batch argument LlamaGenerator already relies on.
+- **Prefill as a batch-1 bucketed program, merged by scatter.**  Prompt
+  prefill runs on a [1, bucket] shape (cost ∝ prompt, not ∝ pool) and a
+  separate jitted merge scatters the row cache into the pool at the slot
+  index.  One compile per bucket, one for the merge.
+- **Decode as a chunked scan over the whole pool.**  Each dispatch runs
+  ``decode_chunk`` sampling steps for ALL slots in one ``lax.scan``
+  program; inactive slots ride along with their cache writes dropped
+  (position pinned past ``max_seq_len``).  Chunking amortizes the
+  host round trip that dominates per-token latency on a remote-dispatch
+  backend (PERF.md: 16.8 ms/token floor through the tunnel); admission
+  happens between chunks, so ``decode_chunk=1`` gives strict
+  token-boundary admission and larger chunks trade admission latency for
+  dispatch amortization.
+
+All buffers are donated across dispatches, so the pool cache exists in
+HBM exactly once.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models import llama as llamalib
+from .model import Model
+from .storage import fetch_mem
+
+
+@dataclass
+class Request:
+    """One generation request tracked through the engine."""
+
+    prompt: list[int]
+    max_new_tokens: int
+    submitted_at: float = field(default_factory=time.perf_counter)
+    #: engine step counter when the request was submitted / admitted
+    submitted_step: int = 0
+    admitted_step: int = -1
+    slot: int = -1
+    tokens: list[int] = field(default_factory=list)
+    first_token_at: Optional[float] = None
+    done: threading.Event = field(default_factory=threading.Event)
+    error: Optional[Exception] = None
+
+    def wait(self, timeout: Optional[float] = None) -> list[int]:
+        if not self.done.wait(timeout):
+            raise TimeoutError("generation did not complete in time")
+        if self.error is not None:
+            raise self.error
+        return self.tokens
+
+    @property
+    def ttft_s(self) -> Optional[float]:
+        if self.first_token_at is None:
+            return None
+        return self.first_token_at - self.submitted_at
+
+
+class ContinuousEngine:
+    """Slot-pool continuous-batching decode engine over a Llama model.
+
+    Parameters
+    ----------
+    cfg, params:    model config + weights (as in LlamaGenerator).
+    num_slots:      pool width — max requests decoding concurrently.
+    decode_chunk:   sampling steps per dispatch; admission happens between
+                    dispatches (1 = admit at every token boundary).
+    temperature:    0 = greedy; >0 = categorical sampling.
+    eos_id:         optional stop token (host-checked between chunks).
+    """
+
+    def __init__(
+        self,
+        cfg: llamalib.LlamaConfig,
+        params: Any,
+        *,
+        num_slots: int = 8,
+        decode_chunk: int = 1,
+        temperature: float = 0.0,
+        eos_id: Optional[int] = None,
+        seq_buckets: Optional[list[int]] = None,
+        default_max_new_tokens: int = 16,
+        pipeline_depth: int = 2,
+    ):
+        if num_slots < 1:
+            raise ValueError("num_slots must be >= 1")
+        if decode_chunk < 1:
+            raise ValueError("decode_chunk must be >= 1")
+        if pipeline_depth < 1:
+            raise ValueError("pipeline_depth must be >= 1")
+        self.cfg = cfg
+        self.params = params
+        self.num_slots = num_slots
+        self.decode_chunk = decode_chunk
+        self.temperature = float(temperature)
+        self.eos_id = eos_id
+        self.default_max_new_tokens = default_max_new_tokens
+        #: chunks in flight on the device before the host blocks on a
+        #: fetch: depth 2 overlaps chunk k's host round trip with chunk
+        #: k+1's device compute (the tunnel's ~100ms/fetch floor would
+        #: otherwise serialize into the decode timeline — PERF.md).  The
+        #: schedule advanced at dispatch time is value-independent, so
+        #: only EOS retirement lags by up to depth-1 chunks.
+        self.pipeline_depth = pipeline_depth
+        self.model = llamalib.Llama(cfg)
+
+        cap = cfg.max_seq_len - 1
+        raw = seq_buckets or [
+            s for s in (32, 64, 128, 256, 512, 1024, 2048, 4096) if s < cap
+        ] + [cap]
+        self.seq_buckets = tuple(sorted({int(b) for b in raw if 1 <= int(b) <= cap}))
+        if not self.seq_buckets:
+            raise ValueError(f"no usable seq bucket <= {cap}")
+
+        self._build_programs()
+        self._init_pool()
+
+        # host-side scheduler state
+        self._queue: "queue.Queue[Request]" = queue.Queue()
+        self._slots: list[Optional[Request]] = [None] * num_slots
+        self._active = np.zeros(num_slots, dtype=bool)
+        self._positions = np.zeros(num_slots, dtype=np.int32)
+        self._remaining = np.zeros(num_slots, dtype=np.int64)
+        self.step_counter = 0          # decode dispatches so far
+        self.tokens_emitted = 0        # useful (delivered) tokens
+        self._error: Optional[Exception] = None
+        self._stop = threading.Event()
+        self._gate = threading.Lock()
+        self._wake = threading.Event()
+        self._base_key = jax.random.PRNGKey(int.from_bytes(os.urandom(4), "little"))
+        self._thread = threading.Thread(
+            target=self._loop, name="continuous-engine", daemon=True)
+        self._thread.start()
+
+    # -- compiled programs -------------------------------------------------
+
+    def _build_programs(self) -> None:
+        cfg, model, temperature = self.cfg, self.model, self.temperature
+        chunk = self.decode_chunk
+        slots = self.num_slots
+
+        def forward(params, cache, tok, positions):
+            logits, mutated = model.apply(
+                {"params": params, "cache": cache}, tok, positions,
+                decode=True, mutable=["cache"])
+            return logits, mutated["cache"]
+
+        def cache_shapes(batch: int):
+            return jax.eval_shape(
+                lambda k, t, p: model.init(k, t, p, decode=True),
+                jax.ShapeDtypeStruct((2,), jnp.uint32),
+                jax.ShapeDtypeStruct((batch, 1), jnp.int32),
+                jax.ShapeDtypeStruct((batch, 1), jnp.int32),
+            )["cache"]
+
+        pool_proto = cache_shapes(slots)
+        row_proto = cache_shapes(1)
+        # per-leaf batch axis, probed with batch=2 vs batch=1 so it stays
+        # well-defined even when num_slots == 1 (cache_index has no batch
+        # axis — it is informational and left untouched)
+        probe_proto = cache_shapes(2)
+
+        def batch_axis(p, r):
+            diff = [i for i, (a, b) in enumerate(zip(p.shape, r.shape)) if a != b]
+            if not diff:
+                return None
+            if len(diff) != 1:
+                raise RuntimeError(
+                    f"ambiguous batch axis between {p.shape} and {r.shape}")
+            return diff[0]
+
+        self._pool_shapes = pool_proto
+        self._batch_axes = jax.tree.map(batch_axis, probe_proto, row_proto)
+
+        def prefill(params, prompt, lengths):
+            """[1, bucket] ragged prefill -> (last-token logits [1,v], row cache)."""
+            b, length = prompt.shape
+            cache = jax.tree.map(
+                lambda s: jnp.zeros(s.shape, s.dtype), cache_shapes(b))
+            positions = jnp.broadcast_to(
+                jnp.arange(length, dtype=jnp.int32)[None, :], (b, length))
+            logits_all, cache = forward(params, cache, prompt, positions)
+            last = jnp.take_along_axis(
+                logits_all, (lengths - 1)[:, None, None], axis=1)[:, 0]
+            return last, cache
+
+        axes = self._batch_axes
+
+        def merge(pool_cache, pool_logits, row_cache, row_logits, slots):
+            """Scatter a BATCH of prefilled row caches + their next-token
+            logits into the pool at ``slots`` [g].  Padded admission rows
+            carry slot == num_slots, which mode="drop" discards — one
+            merge dispatch admits a whole burst of requests."""
+            def leaf(pool, row, axis):
+                if axis is None:
+                    return pool
+                idx = (slice(None),) * axis + (slots,)
+                return pool.at[idx].set(row, mode="drop")
+
+            merged = jax.tree.map(leaf, pool_cache, row_cache, axes)
+            return merged, pool_logits.at[slots].set(row_logits, mode="drop")
+
+        def decode(params, cache, logits, positions, active, key):
+            """``chunk`` sampling steps for the whole pool in one program.
+
+            Inactive slots still compute (the price of a static pool) but
+            their cache writes drop: position is pinned to max_seq_len,
+            where the per-row scatter's mode="drop" discards the write and
+            the causal mask hides the slot from every live row.
+            """
+            safe = jnp.where(active, positions, cfg.max_seq_len)
+
+            def step(carry, key):
+                cache, logits, pos = carry
+                if temperature > 0:
+                    tok = jax.random.categorical(
+                        key, logits.astype(jnp.float32) / temperature, axis=-1)
+                else:
+                    tok = jnp.argmax(logits, axis=-1)
+                tok = tok.astype(jnp.int32)
+                l, cache = forward(params, cache, tok[:, None], pos[:, None])
+                nxt = jnp.where(active, pos + 1, cfg.max_seq_len)
+                return (cache, l[:, -1, :], nxt), tok
+
+            keys = jax.random.split(key, chunk)
+            (cache, logits, pos), toks = jax.lax.scan(
+                step, (cache, logits, safe), keys)
+            return cache, logits, toks.T  # toks: [slots, chunk]
+
+        # logits dtype follows the model's activation dtype (bf16 on TPU;
+        # the pool logits buffer must match or the decode scan carry
+        # type-mismatches)
+        self._logits_dtype = jax.eval_shape(
+            prefill,
+            self.params,
+            jax.ShapeDtypeStruct((1, self.seq_buckets[0]), jnp.int32),
+            jax.ShapeDtypeStruct((1,), jnp.int32),
+        )[0].dtype
+
+        self._prefill = jax.jit(prefill)
+        # donate pool buffers: the pool cache must exist in HBM once, not
+        # once per in-flight dispatch
+        self._merge = jax.jit(merge, donate_argnums=(0, 1))
+        self._decode = jax.jit(decode, donate_argnums=(1, 2))
+
+    def _init_pool(self) -> None:
+        self._pool_cache = jax.jit(lambda: jax.tree.map(
+            lambda s: jnp.zeros(s.shape, s.dtype), self._pool_shapes))()
+        self._pool_logits = jnp.zeros(
+            (self.num_slots, self.cfg.vocab_size), self._logits_dtype)
+
+    # -- public API --------------------------------------------------------
+
+    def warmup(self, groups: Optional[list[tuple[int, int]]] = None) -> None:
+        """Precompile the (admission-group, prompt-bucket) prefill/merge
+        programs and the decode program so the first real burst doesn't
+        pay compile time mid-request.  Warmup prefills merge into the
+        out-of-range slot (dropped by the scatter) and the warmup decode
+        runs with every slot inactive (cache writes dropped), so pool
+        state is untouched for real traffic.
+
+        ``groups``: list of (group_size, seq_bucket); default = group
+        sizes 1 and num_slots at the smallest bucket.
+        """
+        if groups is None:
+            groups = [(1, self.seq_buckets[0]),
+                      (self.num_slots, self.seq_buckets[0])]
+        for g, bucket in groups:
+            bucket = next(b for b in self.seq_buckets if b >= bucket)
+            row_logits, row_cache = self._prefill(
+                self.params, jnp.zeros((g, bucket), jnp.int32),
+                jnp.ones(g, np.int32))
+            self._pool_cache, self._pool_logits = self._merge(
+                self._pool_cache, self._pool_logits, row_cache, row_logits,
+                jnp.full(g, self.num_slots, jnp.int32))
+        self._pool_cache, self._pool_logits, toks = self._decode(
+            self.params, self._pool_cache, self._pool_logits,
+            jnp.full(self.num_slots, self.cfg.max_seq_len, jnp.int32),
+            jnp.zeros(self.num_slots, bool),
+            jax.random.PRNGKey(0))
+        jax.block_until_ready(toks)
+
+    def submit(
+        self, prompt: list[int], max_new_tokens: Optional[int] = None
+    ) -> Request:
+        req = Request(
+            prompt=list(map(int, prompt)),
+            max_new_tokens=int(max_new_tokens or self.default_max_new_tokens),
+        )
+        req.submitted_step = self.step_counter
+        with self._gate:
+            if self._error is not None:
+                raise RuntimeError(
+                    f"engine failed: {self._error!r}") from self._error
+            if self._stop.is_set():
+                raise RuntimeError("engine is shutting down")
+            self._queue.put(req)
+        self._wake.set()
+        return req
+
+    def generate(self, prompt: list[int], max_new_tokens: Optional[int] = None,
+                 timeout: float = 120.0) -> list[int]:
+        return self.submit(prompt, max_new_tokens).wait(timeout)
+
+    def stop(self) -> None:
+        with self._gate:
+            self._stop.set()
+        self._wake.set()
+        self._thread.join(timeout=10)
+        while True:
+            try:
+                req = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            req.error = RuntimeError("engine shut down")
+            req.done.set()
+        for req in self._slots:
+            if req is not None and not req.done.is_set():
+                req.error = RuntimeError("engine shut down")
+                req.done.set()
+
+    # -- scheduler loop ----------------------------------------------------
+
+    def _admit(self) -> None:
+        """Prefill queued requests into free slots (between decode chunks).
+
+        Admissions are BATCHED: waiting requests group by prompt bucket and
+        each group runs as one multi-row prefill + one multi-slot merge —
+        a burst of 8 requests costs 2 dispatches, not 16 (each dispatch
+        pays the remote-dispatch latency floor, PERF.md)."""
+        free = [i for i, r in enumerate(self._slots) if r is None]
+        taken: list[tuple[Request, list[int], int]] = []  # (req, prompt, slot)
+        while free:
+            try:
+                req = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            # budget the KV cache: prompt + generated tokens must fit
+            # max_seq_len — writes past it are silently dropped by the
+            # per-row scatter and decode would return garbage from a
+            # frozen cache (the same guard LlamaGenerator applies at load)
+            if req.max_new_tokens >= self.cfg.max_seq_len:
+                req.max_new_tokens = self.cfg.max_seq_len - 1
+            cap = min(self.seq_buckets[-1],
+                      self.cfg.max_seq_len - req.max_new_tokens)
+            prompt = req.prompt[-cap:]  # left-truncate, keep the tail
+            if not prompt:
+                # empty prompt -> empty continuation (runtimes.py rule)
+                req.done.set()
+                continue
+            taken.append((req, prompt, free.pop(0)))
+        if not taken:
+            return
+        groups: dict[int, list[tuple[Request, list[int], int]]] = {}
+        for req, prompt, slot in taken:
+            bucket = next(b for b in self.seq_buckets if b >= len(prompt))
+            groups.setdefault(bucket, []).append((req, prompt, slot))
+        for bucket, members in groups.items():
+            # pad the group size up to a power of two (bounded compile
+            # count); pad rows target the out-of-range slot, which the
+            # merge scatter drops
+            g = 1
+            while g < len(members):
+                g *= 2
+            g = min(g, self.num_slots)
+            try:
+                toks = np.zeros((g, bucket), np.int32)
+                lengths = np.ones(g, np.int32)
+                slots = np.full(g, self.num_slots, np.int32)
+                for j, (req, prompt, slot) in enumerate(members):
+                    toks[j, : len(prompt)] = prompt
+                    lengths[j] = len(prompt)
+                    slots[j] = slot
+                row_logits, row_cache = self._prefill(
+                    self.params, jnp.asarray(toks), jnp.asarray(lengths))
+                self._pool_cache, self._pool_logits = self._merge(
+                    self._pool_cache, self._pool_logits,
+                    row_cache, row_logits, jnp.asarray(slots))
+                for req, prompt, slot in members:
+                    self._slots[slot] = req
+                    self._active[slot] = True
+                    self._positions[slot] = len(prompt)
+                    self._remaining[slot] = req.max_new_tokens
+                    req.slot = slot
+                    req.admitted_step = self.step_counter
+            except Exception as e:  # noqa: BLE001 — fail this group only
+                for req, _, _ in members:
+                    req.error = e
+                    req.done.set()
+
+    def _loop(self) -> None:
+        try:
+            self._loop_inner()
+        except Exception as e:  # noqa: BLE001 — a dead engine thread must
+            # not strand waiters: fail everything in flight and refuse new
+            # submissions (submit() re-raises self._error)
+            with self._gate:
+                self._error = e
+            for req in self._slots:
+                if req is not None and not req.done.is_set():
+                    req.error = e
+                    req.done.set()
+            while True:
+                try:
+                    req = self._queue.get_nowait()
+                except queue.Empty:
+                    break
+                req.error = e
+                req.done.set()
+
+    def _loop_inner(self) -> None:
+        # in-flight chunk dispatches: (device tokens, [(slot, req, take)])
+        pending: list[tuple[Any, list[tuple[int, Request, int]]]] = []
+        while not self._stop.is_set():
+            self._admit()
+            if not self._active.any():
+                # drain the tail, then wait for work without spinning
+                while pending:
+                    self._process(*pending.pop(0))
+                if self._active.any() or not self._queue.empty():
+                    continue  # _process freed slots or work arrived
+                self._wake.wait(timeout=0.05)
+                self._wake.clear()
+                continue
+            self.step_counter += 1
+            key = jax.random.fold_in(self._base_key, self.step_counter)
+            snapshot = [
+                (slot, self._slots[slot],
+                 int(min(self.decode_chunk, self._remaining[slot])))
+                for slot in range(self.num_slots)
+                if self._active[slot] and self._slots[slot] is not None
+            ]
+            self._pool_cache, self._pool_logits, toks = self._decode(
+                self.params, self._pool_cache, self._pool_logits,
+                jnp.asarray(self._positions), jnp.asarray(self._active), key)
+            # advance the value-independent schedule NOW so the next chunk
+            # can dispatch before this one's tokens are fetched
+            for slot, req, take in snapshot:
+                self._positions[slot] += self.decode_chunk
+                self._remaining[slot] -= take
+                if self._remaining[slot] <= 0:
+                    # slot is schedulable for a new occupant immediately;
+                    # the request itself resolves when its tokens arrive
+                    self._slots[slot] = None
+                    self._active[slot] = False
+            pending.append((toks, snapshot))
+            if len(pending) >= self.pipeline_depth:
+                self._process(*pending.pop(0))
+        while pending:
+            self._process(*pending.pop(0))
+
+    def _process(self, toks_dev, snapshot) -> None:
+        """Fetch one chunk's tokens (blocks) and deliver them."""
+        toks = np.asarray(jax.device_get(toks_dev))  # [slots, chunk]
+        now = time.perf_counter()
+        for slot, req, take in snapshot:
+            if req.done.is_set():
+                continue  # EOS-retired by an earlier chunk
+            emitted = toks[slot, :take].tolist()
+            done = False
+            if self.eos_id is not None and self.eos_id in emitted:
+                emitted = emitted[: emitted.index(self.eos_id) + 1]
+                done = True
+                # free the slot unless a new occupant already claimed it
+                # (max_new-tokens freeing happens at dispatch time)
+                if self._slots[slot] is req:
+                    self._slots[slot] = None
+                    self._active[slot] = False
+                    self._remaining[slot] = 0
+            if emitted and req.first_token_at is None:
+                req.first_token_at = now
+            req.tokens.extend(emitted)
+            self.tokens_emitted += len(emitted)
+            if done or len(req.tokens) >= req.max_new_tokens:
+                req.done.set()
+
+
+class ContinuousLlamaGenerator(Model):
+    """Serving runtime over :class:`ContinuousEngine`.
+
+    Unlike ``LlamaGenerator`` this model is **self-batching**: the server
+    bypasses the micro-batcher and calls it from each request thread
+    directly; concurrent requests coalesce inside the engine's slot pool
+    at token boundaries instead of at HTTP arrival time.
+
+    config:
+      params_ref:       "mem://key" holding (LlamaConfig, params)
+      num_slots, decode_chunk, temperature, eos_id, max_new_tokens,
+      seq_buckets:      engine knobs (see ContinuousEngine)
+    """
+
+    self_batching = True
+
+    def __init__(self, name: str, config: Optional[dict[str, Any]] = None):
+        super().__init__(name, config)
+        self.engine: Optional[ContinuousEngine] = None
+
+    def load(self) -> None:
+        ref = self.config["params_ref"]
+        cfg, params = fetch_mem(ref[len("mem://"):])
+        self.engine = ContinuousEngine(
+            cfg, params,
+            num_slots=int(self.config.get("num_slots", 8)),
+            decode_chunk=int(self.config.get("decode_chunk", 4)),
+            temperature=float(self.config.get("temperature", 0.0)),
+            eos_id=self.config.get("eos_id"),
+            seq_buckets=self.config.get("seq_buckets"),
+            default_max_new_tokens=int(self.config.get("max_new_tokens", 16)),
+        )
+        # precompile before the first request (load-time AOT, like the
+        # bucketed JaxFunctionModel); config "warmup_groups": [[g, bucket]]
+        groups = self.config.get("warmup_groups")
+        if groups != []:
+            self.engine.warmup(
+                [tuple(g) for g in groups] if groups else None)
+        self.ready = True
+
+    def stop(self) -> None:
+        if self.engine is not None:
+            self.engine.stop()
+            self.engine = None
+        super().stop()
+
+    def predict_batch(self, instances):
+        assert self.engine is not None, "model not loaded"
+        reqs = [self.engine.submit(inst) for inst in instances]
+        return [r.wait(timeout=300.0) for r in reqs]

@@ -58,9 +58,16 @@ class Router:
 
     def __init__(self, activate: Callable[[], None], port: Optional[int] = None):
         self.port = port or allocate_port()
-        self._backends: list[str] = []
-        self._explain_backends: list[str] = []  # ``:explain`` verb tier
-        self._rr = 0
+        #: weighted backend pools: [(urls, weight)] — one pool per
+        #: revision (canary rollout splits traffic here, the
+        #: virtualservice-weight analog); single-revision services have
+        #: one pool at weight 100
+        self._pools: list[tuple[list[str], int]] = []
+        self._explain_pools: list[tuple[list[str], int]] = []
+        self._rr: list[int] = []   # per-pool round-robin cursors
+        self._err: list[int] = []
+        self._wrr: list[int] = []  # smooth-WRR current weights
+        self._ewrr: list[int] = []
         self._lock = threading.Lock()
         self._activate = activate
         self.last_request_time = 0.0
@@ -120,22 +127,63 @@ class Router:
         return f"http://127.0.0.1:{self.port}"
 
     def set_backends(self, urls: list[str]) -> None:
+        self.set_weighted_backends([(list(urls), 100)])
+
+    def set_weighted_backends(self, pools: list[tuple[list[str], int]]) -> None:
+        """Traffic-split backend pools; empty pools and zero weights are
+        dropped (an empty stable pool must fall through to the activator,
+        not eat the canary's share)."""
         with self._lock:
-            self._backends = list(urls)
+            new = [(list(u), int(w)) for u, w in pools if u and w > 0]
+            if ([w for _, w in new] != [w for _, w in self._pools]
+                    or len(self._wrr) != len(new)):
+                self._wrr = [0] * len(new)  # weights changed: reset the WRR
+            if [u for u, _ in new] != [u for u, _ in self._pools]:
+                self._rr = [0] * len(new)  # membership changed: reset RR
+            self._pools = new
 
     def set_explain_backends(self, urls: list[str]) -> None:
         """Backends for the ``:explain`` verb (KServe routes the verb to the
         explainer component, everything else to transformer/predictor)."""
+        self.set_weighted_explain_backends([(list(urls), 100)])
+
+    def set_weighted_explain_backends(
+        self, pools: list[tuple[list[str], int]]
+    ) -> None:
         with self._lock:
-            self._explain_backends = list(urls)
+            new = [(list(u), int(w)) for u, w in pools if u and w > 0]
+            if ([w for _, w in new] != [w for _, w in self._explain_pools]
+                    or len(self._ewrr) != len(new)):
+                self._ewrr = [0] * len(new)
+            if [u for u, _ in new] != [u for u, _ in self._explain_pools]:
+                self._err = [0] * len(new)
+            self._explain_pools = new
 
     def _pick(self, explain: bool = False) -> Optional[str]:
         with self._lock:
-            pool = self._explain_backends if explain and self._explain_backends else self._backends
-            if not pool:
+            use_explain = explain and self._explain_pools
+            pools = self._explain_pools if use_explain else self._pools
+            cur = self._ewrr if use_explain else self._wrr
+            rrs = self._err if use_explain else self._rr
+            if not pools:
                 return None
-            self._rr = (self._rr + 1) % len(pool)
-            return pool[self._rr]
+            # smooth weighted round-robin (nginx-style): deterministic,
+            # exact proportions over any window, and INTERLEAVED — a block
+            # split (first 80 of 100 to stable) would starve the canary on
+            # short request bursts
+            total = sum(w for _, w in pools)
+            best = 0
+            for i, (_, w) in enumerate(pools):
+                cur[i] += w
+                if cur[i] > cur[best]:
+                    best = i
+            cur[best] -= total
+            pool = pools[best][0]
+            # round-robin WITHIN the chosen pool, cursor per pool — a
+            # shared cursor lets a 1-backend pool reset it and starve
+            # backends of the other pool during a canary split
+            rrs[best] = (rrs[best] + 1) % len(pool)
+            return pool[rrs[best]]
 
     def stop(self) -> None:
         self._httpd.shutdown()
@@ -143,16 +191,43 @@ class Router:
         self._thread.join(timeout=2)
 
 
+class _Revision:
+    """One immutable rollout of an InferenceService spec.
+
+    Canary rollout (KServe's canaryTrafficPercent over virtualservice
+    weights) needs two revisions live at once, each serving the spec it
+    was created from — so the resolved runtime + config are frozen here,
+    not re-derived from the (possibly newer) object spec."""
+
+    def __init__(self, rev: int, fingerprint: str, spec, runtime_cls, cfg: dict):
+        self.rev = rev
+        self.fingerprint = fingerprint
+        self.spec = spec
+        self.runtime_cls = runtime_cls
+        self.cfg = cfg
+        self.predictors: list[ModelServer] = []
+        self.transformers: list[ModelServer] = []
+        self.explainers: list[ModelServer] = []
+
+    @property
+    def servers(self) -> list[ModelServer]:
+        return self.explainers + self.transformers + self.predictors
+
+
 class _Deployment:
     """Live serving state for one InferenceService."""
 
     def __init__(self) -> None:
-        self.predictors: list[ModelServer] = []
-        self.transformers: list[ModelServer] = []
-        self.explainers: list[ModelServer] = []
         self.router: Optional[Router] = None
+        self.stable: Optional[_Revision] = None
+        self.canary: Optional[_Revision] = None
+        self.rev_counter = 0
+        self.pct = 0  # live canary traffic share
         self.wants_scale_up = False
-        self.spec_fingerprint = ""
+
+    @property
+    def revisions(self) -> list[_Revision]:
+        return [r for r in (self.stable, self.canary) if r is not None]
 
 
 class InferenceServiceController(Controller):
@@ -175,6 +250,21 @@ class InferenceServiceController(Controller):
 
     # -- reconcile --------------------------------------------------------
 
+    @staticmethod
+    def _fingerprint(spec) -> str:
+        """Spec identity for revision tracking — the traffic split is
+        routing config, not a new revision."""
+        d = spec.model_dump(mode="json")
+        d.pop("canary_traffic_percent", None)
+        return json.dumps(d, sort_keys=True)
+
+    def _new_revision(self, isvc, dep: _Deployment, fingerprint: str) -> _Revision:
+        runtime_cls, cfg = self._resolve(isvc)
+        dep.rev_counter += 1
+        return _Revision(
+            dep.rev_counter, fingerprint, isvc.spec.model_copy(deep=True),
+            runtime_cls, cfg)
+
     def reconcile(self, namespace: str, name: str) -> Optional[Result]:
         key = f"{namespace}/{name}"
         isvc = self.store.try_get(KIND_INFERENCE_SERVICE, name, namespace)
@@ -186,53 +276,96 @@ class InferenceServiceController(Controller):
             return None
         assert isinstance(isvc, InferenceService)
 
-        try:
-            runtime_cls, cfg = self._resolve(isvc)
-        except Exception as e:  # noqa: BLE001 — config errors -> Failed phase
-            self._set_status(
-                isvc, phase=InferenceServicePhase.FAILED, message=f"{type(e).__name__}: {e}")
-            return None
-
         with self._lock:
             dep = self._deployments.setdefault(key, _Deployment())
-        fingerprint = json.dumps(isvc.spec.model_dump(mode="json"), sort_keys=True)
-        if dep.spec_fingerprint and dep.spec_fingerprint != fingerprint:
-            self._teardown_deployment(dep)
-            with self._lock:
-                dep = self._deployments.setdefault(key, _Deployment())
-                self._deployments[key] = dep
-        dep.spec_fingerprint = fingerprint
-
-        pred = isvc.spec.predictor
         if dep.router is None:
             dep.router = Router(activate=lambda: self._request_scale_up(key))
             self._set_status(isvc, phase=InferenceServicePhase.LOADING,
                              message="starting predictor")
 
-        desired = self._desired_replicas(dep, pred)
-        changed = self._scale_predictors(isvc, dep, runtime_cls, cfg, desired)
+        fingerprint = self._fingerprint(isvc.spec)
+        pct = isvc.spec.canary_traffic_percent
+        try:
+            if dep.stable is None:
+                dep.stable = self._new_revision(isvc, dep, fingerprint)
+            elif fingerprint != dep.stable.fingerprint:
+                if pct is not None and pct < 100:
+                    # canary: new revision serves pct%, stable keeps the rest
+                    if dep.canary is None or dep.canary.fingerprint != fingerprint:
+                        if dep.canary is not None:
+                            self._drain_revision(isvc, dep.canary)
+                        dep.canary = self._new_revision(isvc, dep, fingerprint)
+                        self.emit_event(
+                            isvc, "CanaryDeployed",
+                            f"revision {dep.canary.rev} at {pct}%")
+                elif dep.canary is not None and dep.canary.fingerprint == fingerprint:
+                    # promote: the canary becomes the stable revision; the
+                    # old stable drains (no cold start — the promoted
+                    # replicas are already serving)
+                    old = dep.stable
+                    dep.stable, dep.canary = dep.canary, None
+                    self._drain_revision(isvc, old)
+                    self.emit_event(
+                        isvc, "CanaryPromoted", f"revision {dep.stable.rev}")
+                else:
+                    # full rollout without a canary phase
+                    old = dep.stable
+                    dep.stable = self._new_revision(isvc, dep, fingerprint)
+                    self._drain_revision(isvc, old)
+            elif dep.canary is not None:
+                # spec reverted to the stable revision: roll the canary back
+                rolled = dep.canary
+                dep.canary = None
+                self._drain_revision(isvc, rolled)
+                self.emit_event(
+                    isvc, "CanaryRolledBack", f"revision {rolled.rev}")
+        except Exception as e:  # noqa: BLE001 — config errors -> Failed phase
+            self._set_status(
+                isvc, phase=InferenceServicePhase.FAILED,
+                message=f"{type(e).__name__}: {e}")
+            return None
+
+        dep.pct = max(0, min(100, pct or 0)) if dep.canary is not None else 0
+        for rev in dep.revisions:
+            desired = self._desired_replicas(dep, rev)
+            self._scale_predictors(isvc, dep, rev, desired)
         self._wire(isvc, dep)
 
-        ready = bool(dep.predictors) or pred.min_replicas == 0
+        stable_ready = (
+            bool(dep.stable.predictors) or dep.stable.spec.predictor.min_replicas == 0)
+        canary_ready = dep.canary is None or bool(dep.canary.predictors)
+        ready = stable_ready and canary_ready
+        stable_spec = dep.stable.spec.model_dump(mode="json")
+        stable_spec.pop("canary_traffic_percent", None)
         self._set_status(
             isvc,
             phase=InferenceServicePhase.READY if ready else InferenceServicePhase.LOADING,
             url=dep.router.url,
-            active_replicas=len(dep.predictors),
+            active_replicas=sum(len(r.predictors) for r in dep.revisions),
             message="",
+            stable_revision=dep.stable.rev,
+            canary_revision=dep.canary.rev if dep.canary else None,
+            canary_traffic=dep.pct,
+            stable_spec=stable_spec,
         )
         # periodic requeue drives the autoscaler loop
         return Result(requeue_after=0.25)
 
     # -- scaling ----------------------------------------------------------
 
-    def _desired_replicas(self, dep: _Deployment, pred: ComponentSpec) -> int:
-        n = len(dep.predictors)
-        if dep.wants_scale_up:
+    def _desired_replicas(self, dep: _Deployment, rev: _Revision) -> int:
+        pred = rev.spec.predictor
+        n = len(rev.predictors)
+        # during a canary split BOTH revisions must hold the road: a
+        # revision idling to zero would silently forfeit its traffic
+        # share (the router drops empty pools, and with the other pool
+        # still serving, the activator never fires to bring it back)
+        floor = max(pred.min_replicas, 1 if dep.canary is not None else 0)
+        if dep.wants_scale_up and rev is dep.stable:
             dep.wants_scale_up = False
-            return max(n, 1, pred.min_replicas)
+            return max(n, 1, floor)
         inflight = sum(
-            s.metrics.inflight for s in dep.predictors
+            s.metrics.inflight for s in rev.predictors
         )
         if n and inflight / n > pred.scale_target_concurrency:
             return min(n + 1, pred.max_replicas)
@@ -240,105 +373,134 @@ class InferenceServiceController(Controller):
             dep.router is not None
             and time.time() - dep.router.last_request_time > SCALE_IDLE_SECONDS
         )
-        if idle and n > pred.min_replicas:
-            return max(n - 1, pred.min_replicas)
-        return max(n, pred.min_replicas)
+        if idle and n > floor:
+            return max(n - 1, floor)
+        return max(n, floor)
 
     def _scale_predictors(
-        self, isvc, dep: _Deployment, runtime_cls, cfg: dict, desired: int
+        self, isvc, dep: _Deployment, rev: _Revision, desired: int
     ) -> bool:
         changed = False
-        while len(dep.predictors) < desired:
+        while len(rev.predictors) < desired:
             server = ModelServer()
-            model = runtime_cls(isvc.metadata.name, cfg)
-            pred = isvc.spec.predictor
+            model = rev.runtime_cls(isvc.metadata.name, rev.cfg)
+            pred = rev.spec.predictor
             server.register(
                 model,
                 batch_max_size=pred.batch_max_size,
                 batch_timeout_ms=pred.batch_timeout_ms,
             )
             server.start()
-            dep.predictors.append(server)
-            self.emit_event(isvc, "ReplicaStarted", server.url)
+            rev.predictors.append(server)
+            self.emit_event(
+                isvc, "ReplicaStarted", f"rev {rev.rev} {server.url}")
             changed = True
-        while len(dep.predictors) > desired:
-            server = dep.predictors.pop()
+        while len(rev.predictors) > desired:
+            server = rev.predictors.pop()
             self._wire(isvc, dep)  # drop from router before stopping
-            # drain asynchronously: requests already dispatched to this
-            # replica (or queued in its micro-batcher) finish rather than
-            # surfacing as 5xx, and the reconcile worker is not blocked for
-            # the (bounded) drain period.  The initial settle sleep covers
-            # requests the router already picked this backend for but whose
-            # handler has not yet reached _dispatch's inflight increment.
-            def _drain_stop(srv=server, svc=isvc):
-                time.sleep(0.1)
-                deadline = time.monotonic() + 5.0
-                while srv.metrics.inflight > 0 and time.monotonic() < deadline:
-                    time.sleep(0.02)
-                srv.stop()
-                self.emit_event(svc, "ReplicaStopped", srv.url)
-
-            threading.Thread(
-                target=_drain_stop, name="replica-drain", daemon=True
-            ).start()
+            self._drain_stop_server(isvc, server)
             changed = True
         return changed
 
-    def _wire(self, isvc, dep: _Deployment) -> None:
-        """Point the router at the right tier (transformer else predictor);
-        the ``:explain`` verb routes to the explainer component when one is
-        specified [upstream: kserve routes verbs per component]."""
-        espec = isvc.spec.explainer
+    def _drain_stop_server(self, isvc, server: ModelServer) -> None:
+        """Stop a replica after its in-flight requests finish.
+
+        Drain runs asynchronously: requests already dispatched to this
+        replica (or queued in its micro-batcher) finish rather than
+        surfacing as 5xx, and the reconcile worker is not blocked for the
+        (bounded) drain period.  The initial settle sleep covers requests
+        the router already picked this backend for but whose handler has
+        not yet reached _dispatch's inflight increment."""
+        def _drain_stop(srv=server, svc=isvc):
+            time.sleep(0.1)
+            deadline = time.monotonic() + 5.0
+            while srv.metrics.inflight > 0 and time.monotonic() < deadline:
+                time.sleep(0.02)
+            srv.stop()
+            self.emit_event(svc, "ReplicaStopped", srv.url)
+
+        threading.Thread(
+            target=_drain_stop, name="replica-drain", daemon=True
+        ).start()
+
+    def _drain_revision(self, isvc, rev: _Revision) -> None:
+        """Drain-and-stop every server of a retired revision (promote,
+        rollback, or full replacement); the router was already rewired."""
+        for server in rev.servers:
+            self._drain_stop_server(isvc, server)
+        rev.predictors.clear()
+        rev.transformers.clear()
+        rev.explainers.clear()
+
+    def _wire_revision(self, isvc, rev: _Revision) -> tuple[list[str], list[str]]:
+        """Build one revision's serving tier; returns (data-plane urls,
+        explain urls) — the transformer fronts the predictors when one is
+        specified, the ``:explain`` verb routes to the explainer component
+        [upstream: kserve routes verbs per component]."""
+        explain_urls: list[str] = []
+        espec = rev.spec.explainer
         if espec and espec.handler:
-            if not dep.explainers and dep.predictors:
+            if not rev.explainers and rev.predictors:
                 cls = resolve_class(espec.handler)
                 server = ModelServer()
                 model = cls(isvc.metadata.name, {
                     **dict(espec.config),
-                    "predictor_urls": [s.url for s in dep.predictors],
+                    "predictor_urls": [s.url for s in rev.predictors],
                     "model_name": isvc.metadata.name,
                 })
                 server.register(model, batch_max_size=1, batch_timeout_ms=0.0)
                 server.start()
-                dep.explainers.append(server)
-            if dep.explainers:
-                urls = [s.url for s in dep.predictors]
-                for es in dep.explainers:
+                rev.explainers.append(server)
+            if rev.explainers:
+                urls = [s.url for s in rev.predictors]
+                for es in rev.explainers:
                     for m in es.models().values():
                         if hasattr(m, "predictor_urls"):
                             m.predictor_urls = list(urls)
                 # with zero predictors, :explain must fall through to the
                 # activator (empty pool -> scale-from-zero) instead of
                 # reaching an explainer that has nothing to call
-                dep.router.set_explain_backends(
-                    [s.url for s in dep.explainers] if urls else [])
-        tspec = isvc.spec.transformer
+                explain_urls = [s.url for s in rev.explainers] if urls else []
+        tspec = rev.spec.transformer
         if tspec and tspec.handler:
-            if not dep.transformers and dep.predictors:
+            if not rev.transformers and rev.predictors:
                 cls = resolve_class(tspec.handler)
                 cfg = dict(tspec.config)
                 cfg["predictor_url"] = None  # filled per request via backends
                 server = ModelServer()
                 model = cls(isvc.metadata.name, {
-                    **cfg, "predictor_urls": [s.url for s in dep.predictors],
+                    **cfg, "predictor_urls": [s.url for s in rev.predictors],
                     "model_name": isvc.metadata.name,
                 })
                 server.register(model, batch_max_size=tspec.batch_max_size,
                                 batch_timeout_ms=tspec.batch_timeout_ms)
                 server.start()
-                dep.transformers.append(server)
-            if dep.transformers:
+                rev.transformers.append(server)
+            if rev.transformers:
                 # keep the transformer's predictor list current: predictors
                 # churn on every scale event and ports never come back
-                urls = [s.url for s in dep.predictors]
-                for ts in dep.transformers:
+                urls = [s.url for s in rev.predictors]
+                for ts in rev.transformers:
                     for m in ts.models().values():
                         if hasattr(m, "predictor_urls"):
                             m.predictor_urls = list(urls)
-                dep.router.set_backends([s.url for s in dep.transformers])
-                return
-        if dep.router:
-            dep.router.set_backends([s.url for s in dep.predictors])
+                return [s.url for s in rev.transformers], explain_urls
+        return [s.url for s in rev.predictors], explain_urls
+
+    def _wire(self, isvc, dep: _Deployment) -> None:
+        """Point the router at every live revision, weighted by the canary
+        split (the virtualservice-weight analog)."""
+        if dep.router is None or dep.stable is None:
+            return
+        stable_urls, stable_explain = self._wire_revision(isvc, dep.stable)
+        pools = [(stable_urls, 100 - dep.pct)]
+        explain_pools = [(stable_explain, 100 - dep.pct)]
+        if dep.canary is not None:
+            canary_urls, canary_explain = self._wire_revision(isvc, dep.canary)
+            pools.append((canary_urls, dep.pct))
+            explain_pools.append((canary_explain, dep.pct))
+        dep.router.set_weighted_backends(pools)
+        dep.router.set_weighted_explain_backends(explain_pools)
 
     def _request_scale_up(self, key: str) -> None:
         with self._lock:
@@ -372,7 +534,8 @@ class InferenceServiceController(Controller):
             cfg = dict(pred.config)
             if pred.storage_uri:
                 cfg.setdefault("storage_path", download(
-                    pred.storage_uri, cache_dir=cfg.get("model_cache_dir")))
+                    pred.storage_uri, cache_dir=cfg.get("model_cache_dir"),
+                    hf_root=cfg.get("hf_root")))
                 cfg.setdefault("storage_uri", pred.storage_uri)
             return resolve_class(pred.handler), cfg
         else:
@@ -383,23 +546,28 @@ class InferenceServiceController(Controller):
             # merged cfg so a ServingRuntime can enable the cache for all
             # of its models, with the component able to override
             cfg.setdefault("storage_path", download(
-                    pred.storage_uri, cache_dir=cfg.get("model_cache_dir")))
+                    pred.storage_uri, cache_dir=cfg.get("model_cache_dir"),
+                    hf_root=cfg.get("hf_root")))
             cfg.setdefault("storage_uri", pred.storage_uri)
         return resolve_class(runtime.spec.server_class), cfg
 
     # -- teardown / status -------------------------------------------------
 
     def _teardown_deployment(self, dep: _Deployment) -> None:
-        for s in dep.explainers + dep.transformers + dep.predictors:
-            s.stop()
-        dep.explainers.clear()
-        dep.transformers.clear()
-        dep.predictors.clear()
+        for rev in dep.revisions:
+            for s in rev.servers:
+                s.stop()
+            rev.predictors.clear()
+            rev.transformers.clear()
+            rev.explainers.clear()
+        dep.stable = dep.canary = None
         if dep.router:
             dep.router.stop()
             dep.router = None
 
-    def _set_status(self, isvc, phase=None, url=None, active_replicas=None, message=None):
+    def _set_status(self, isvc, phase=None, url=None, active_replicas=None,
+                    message=None, stable_revision=None, canary_revision=...,
+                    canary_traffic=None, stable_spec=None):
         def mut(o):
             assert isinstance(o, InferenceService)
             if phase is not None:
@@ -410,6 +578,14 @@ class InferenceServiceController(Controller):
                 o.status.active_replicas = active_replicas
             if message is not None:
                 o.status.message = message
+            if stable_revision is not None:
+                o.status.stable_revision = stable_revision
+            if canary_revision is not ...:  # None is a real value (no canary)
+                o.status.canary_revision = canary_revision
+            if canary_traffic is not None:
+                o.status.canary_traffic = canary_traffic
+            if stable_spec is not None:
+                o.status.stable_spec = stable_spec
 
         try:
             self.store.update_with_retry(

@@ -249,6 +249,9 @@ class LlamaGenerator(Model):
 
 
 #: server_class registry for ServingRuntime.spec.server_class resolution
+#: (resolve_class imports by path; this dict documents the builtin set —
+#: ContinuousLlamaGenerator lives in continuous.py to keep engine imports
+#: out of the basic-runtime path)
 BUILTIN_RUNTIMES = {
     "kubeflow_tpu.serving.runtimes:EchoModel": EchoModel,
     "kubeflow_tpu.serving.runtimes:JaxFunctionModel": JaxFunctionModel,
@@ -268,6 +271,11 @@ class BertClassifierModel(Model):
     Instances are token-id lists (ragged); predictions are per-class
     probability lists.  Padding tokens are masked out of attention, so a
     padded batch scores identically to per-instance evaluation.
+
+    Weights come from ``params_ref`` (mem://) or, when the storage
+    initializer resolved a ``storage_uri`` (file:// or hf://), from the
+    snapshot directory at ``storage_path`` (config.json +
+    weights.msgpack, models/bert.py save_pretrained layout).
     """
 
     def __init__(self, name: str, config: Optional[dict[str, Any]] = None):
@@ -277,8 +285,15 @@ class BertClassifierModel(Model):
     def load(self) -> None:
         from ..models import bert as bertlib
 
-        ref = self.config["params_ref"]
-        self.cfg, self.params = fetch_mem(ref[len("mem://"):])
+        ref = self.config.get("params_ref")
+        if ref:
+            self.cfg, self.params = fetch_mem(ref[len("mem://"):])
+        elif self.config.get("storage_path"):
+            self.cfg, self.params = bertlib.load_pretrained(
+                self.config["storage_path"])
+        else:
+            raise RuntimeError(
+                f"model {self.name}: need params_ref or storage_uri")
         self.model = bertlib.BertClassifier(self.cfg)
         default_buckets = [b for b in (32, 64, 128, 512)
                            if b <= self.cfg.max_position] or [self.cfg.max_position]

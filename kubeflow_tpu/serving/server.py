@@ -192,8 +192,12 @@ class ModelServer:
     ) -> None:
         model.start()
         self._models[model.name] = model
-        self._batchers[model.name] = MicroBatcher(
-            model, batch_max_size, batch_timeout_ms)
+        # self-batching models (continuous.py) coalesce requests inside
+        # their own decode loop; routing them through the micro-batcher
+        # would serialize requests and defeat token-boundary admission
+        if not getattr(model, "self_batching", False):
+            self._batchers[model.name] = MicroBatcher(
+                model, batch_max_size, batch_timeout_ms)
 
     def unregister(self, name: str) -> None:
         b = self._batchers.pop(name, None)
@@ -311,11 +315,17 @@ class ModelServer:
     def _dispatch(self, name: str, instances: list) -> list:
         batcher = self._batchers.get(name)
         if batcher is None:
-            raise KeyError(name)
+            model = self._models.get(name)
+            if model is None or not getattr(model, "self_batching", False):
+                raise KeyError(name)
         with self.metrics.lock:
             self.metrics.inflight += 1
         try:
-            return batcher.submit(instances)
+            if batcher is not None:
+                return batcher.submit(instances)
+            # self-batching: call from this request thread; concurrency is
+            # the model's own scheduler's job (continuous batching engine)
+            return model(instances)
         finally:
             with self.metrics.lock:
                 self.metrics.inflight -= 1

@@ -8,8 +8,11 @@ contract: ``download(uri) -> local path``.
 Schemes:
   file:///abs/path   local directory/file (the PVC analog)
   mem://<key>        in-process registry (tests, zero-copy handoff)
-  gs:// s3:// hf://  recognized but gated: this environment has zero egress,
-                     so they raise with a clear message instead of hanging.
+  hf://org/name[@rev] LOCAL HuggingFace-hub-layout snapshots resolved
+                     from $KFT_HF_HOME with revision pinning (resolve_hf)
+  gs:// s3://        recognized but gated: this environment has zero
+                     egress, so they raise with a clear message instead
+                     of hanging.
 
 Cache tier (the kserve agent's local-model-cache capability): pass
 ``cache_dir`` (or set ``KFT_MODEL_CACHE``) and ``download`` stages the
@@ -51,7 +54,9 @@ def fetch_mem(key: str) -> Any:
         raise StorageError(f"mem://{key} not registered") from None
 
 
-def download(uri: str, cache_dir: Optional[str] = None) -> str:
+def download(
+    uri: str, cache_dir: Optional[str] = None, hf_root: Optional[str] = None
+) -> str:
     """Resolve ``uri`` to a local filesystem path (V1 storage contract).
 
     With ``cache_dir`` (or ``$KFT_MODEL_CACHE``), file sources are staged
@@ -72,13 +77,74 @@ def download(uri: str, cache_dir: Optional[str] = None) -> str:
         if key not in _MEM_REGISTRY:
             raise StorageError(f"{uri} not registered")
         return uri
-    for scheme in ("gs://", "s3://", "hf://", "http://", "https://"):
+    if uri.startswith("hf://"):
+        path = resolve_hf(uri, hf_root=hf_root)
+        if cache_dir:
+            return stage_to_cache(uri, path, cache_dir)
+        return path
+    for scheme in ("gs://", "s3://", "http://", "https://"):
         if uri.startswith(scheme):
             raise StorageError(
                 f"{uri}: remote storage requires network egress, which this "
                 "deployment does not have; stage the model locally and use file://"
             )
     raise StorageError(f"unsupported storage uri {uri!r}")
+
+
+def resolve_hf(uri: str, hf_root: Optional[str] = None) -> str:
+    """Resolve ``hf://org/name[@revision]`` against a LOCAL HuggingFace-hub
+    layout snapshot root [upstream: kserve -> python/kserve storage hf://
+    scheme; the reference downloads from the Hub — this deployment has
+    zero egress, so the contract is covered by hub-layout directories
+    staged locally (``$KFT_HF_HOME``, e.g. an exported HF_HOME/hub)]:
+
+        <root>/models--org--name/
+            refs/<revision>            # text file naming a commit
+            snapshots/<commit>/...     # config.json + weights
+
+    ``revision`` defaults to ``main``; it may be a named ref, a full
+    commit, or a unique commit prefix — pinning a revision serves exactly
+    that snapshot forever, the property the reference gets from commit-
+    hash URLs.
+    """
+    hf_root = hf_root or os.environ.get("KFT_HF_HOME")
+    if not hf_root:
+        raise StorageError(
+            f"{uri}: hf:// resolves against a local HuggingFace-hub layout "
+            "(zero-egress deployment); set KFT_HF_HOME or pass hf_root")
+    ref = uri[len("hf://"):]
+    repo, _, revision = ref.partition("@")
+    revision = revision or "main"
+    repo = repo.strip("/")
+    if repo.count("/") != 1:
+        raise StorageError(f"{uri}: expected hf://<org>/<name>[@revision]")
+    repo_dir = os.path.join(hf_root, "models--" + repo.replace("/", "--"))
+    if not os.path.isdir(repo_dir):
+        raise StorageError(f"{uri}: {repo!r} not present under {hf_root}")
+    snapshots = os.path.join(repo_dir, "snapshots")
+    commit: Optional[str] = None
+    ref_file = os.path.join(repo_dir, "refs", revision)
+    if os.path.isfile(ref_file):
+        with open(ref_file) as f:
+            commit = f.read().strip()
+    else:
+        try:
+            known = sorted(os.listdir(snapshots))
+        except OSError:
+            known = []
+        matches = [c for c in known if c.startswith(revision)]
+        if len(matches) == 1:
+            commit = matches[0]
+        elif len(matches) > 1:
+            raise StorageError(
+                f"{uri}: revision {revision!r} is ambiguous ({matches})")
+    if not commit:
+        raise StorageError(f"{uri}: unknown revision {revision!r}")
+    snap = os.path.join(snapshots, commit)
+    if not os.path.isdir(snap):
+        raise StorageError(
+            f"{uri}: ref {revision!r} names missing snapshot {commit!r}")
+    return snap
 
 
 # ---------------------------------------------------------------------------
