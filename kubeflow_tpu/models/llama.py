@@ -74,6 +74,12 @@ class LlamaConfig:
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
     moe_normalize_topk: bool = True
+    #: "dense" = GShard capacity-factor dispatch (static one-hot einsums;
+    #: tokens past capacity are DROPPED); "ragged" = dropless dispatch —
+    #: tokens sort by destination expert and only real tokens cross the
+    #: wire via ragged_all_to_all (SURVEY §2.5 EP row), zero drops at any
+    #: load skew.
+    moe_dispatch: str = "dense"
     #: token-embedding lookup: False = gather from an explicitly
     #: replicated table (default; one ICI all-gather per step); True =
     #: one-hot matmul, no table gather (prefer under heavy vocab/TP
@@ -91,6 +97,8 @@ class LlamaConfig:
             raise ValueError(f"unknown attention_impl {self.attention_impl!r}")
         if self.remat_policy not in ("dots", "nothing"):
             raise ValueError(f"unknown remat_policy {self.remat_policy!r}")
+        if self.moe_dispatch not in ("dense", "ragged"):
+            raise ValueError(f"unknown moe_dispatch {self.moe_dispatch!r}")
 
 
 # -- presets ----------------------------------------------------------------

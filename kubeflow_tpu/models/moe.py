@@ -84,9 +84,26 @@ class MoeMlp(nn.Module):
             gate_vals = gate_vals / (
                 gate_vals.sum(axis=-1, keepdims=True) + 1e-9)
 
+        # -- load-balance aux loss (Switch) -------------------------------
+        # fraction of ASSIGNMENTS per expert, pre-capacity (expert_mask,
+        # not dispatch_k): counting only kept tokens would make dropping
+        # lower the loss — the optimizer then prefers collapse-with-drops
+        # over balance.  Normalized by s*k so fractions sum to 1; uniform
+        # routing gives aux = 1, full collapse ~ e.  (In ragged mode
+        # nothing drops, but balance still shapes the transport/compute
+        # load, so the loss is identical.)
+        expert_mask = jax.nn.one_hot(idx, e, dtype=jnp.float32)  # [b, s, k, e]
+        frac_tokens = expert_mask.sum(axis=(1, 2)).mean(axis=0) / (s * k)
+        mean_prob = probs.mean(axis=(0, 1))                         # [e]
+        aux = e * jnp.sum(frac_tokens * mean_prob)
+        self.sow("intermediates", "moe_aux_loss", aux)
+
+        if cfg.moe_dispatch == "ragged":
+            return _ragged_moe(
+                x, idx, gate_vals, w_gate, w_up, w_down, dtype=cfg.dtype)
+
         # -- capacity assignment (sequence-major priority) ----------------
         capacity = max(1, int(cfg.moe_capacity_factor * k * s / e))
-        expert_mask = jax.nn.one_hot(idx, e, dtype=jnp.float32)  # [b, s, k, e]
         flat = expert_mask.transpose(0, 2, 1, 3).reshape(b, k * s, e)
         pos_flat = jnp.cumsum(flat, axis=1) - flat               # queue index
         pos = pos_flat.reshape(b, k, s, e).transpose(0, 2, 1, 3)  # [b, s, k, e]
@@ -98,17 +115,6 @@ class MoeMlp(nn.Module):
         dispatch = jnp.einsum("bske,bskc->bsec", dispatch_k, cap_onehot)
         combine = jnp.einsum(
             "bske,bskc,bsk->bsec", dispatch_k, cap_onehot, gate_vals)
-
-        # -- load-balance aux loss (Switch) -------------------------------
-        # fraction of ASSIGNMENTS per expert, pre-capacity (expert_mask,
-        # not dispatch_k): counting only kept tokens would make dropping
-        # lower the loss — the optimizer then prefers collapse-with-drops
-        # over balance.  Normalized by s*k so fractions sum to 1; uniform
-        # routing gives aux = 1, full collapse ~ e.
-        frac_tokens = expert_mask.sum(axis=(1, 2)).mean(axis=0) / (s * k)
-        mean_prob = probs.mean(axis=(0, 1))                         # [e]
-        aux = e * jnp.sum(frac_tokens * mean_prob)
-        self.sow("intermediates", "moe_aux_loss", aux)
 
         # -- expert computation (all-to-all inserted by GSPMD here) -------
         xin = jnp.einsum(
@@ -125,3 +131,144 @@ class MoeMlp(nn.Module):
             out_e, ("expert", "expert_batch", None, "act_embed"))
         out = jnp.einsum("bsec,ebch->bsh", combine.astype(cfg.dtype), out_e)
         return nn.with_logical_constraint(out, ("batch", "act_seq", "act_embed"))
+
+
+def _ragged_moe(x, idx, gates, w_gate, w_up, w_down, *, dtype):
+    """Dropless MoE dispatch: sort-by-expert + ``ragged_all_to_all``.
+
+    Every (token, expert) assignment is honored — no capacity factor, no
+    drops (SURVEY §2.5 EP row names ragged_all_to_all as the upgrade path
+    over capacity dispatch).  Layout per expert-shard device:
+
+    1. repeat each local token per its top-k choices and SORT by
+       destination expert — the ragged triple (data, offsets, sizes)
+       groups contiguously by destination device;
+    2. exchange counts (all_gather of the send-size matrix), then move
+       only REAL tokens with ``ragged_all_to_all`` — the dense dispatch
+       ships e x capacity slots regardless of load;
+    3. run the local experts over the receive buffer (masked scan per
+       local expert — the grouped-GEMM Pallas kernel is the upgrade path
+       here; with one expert per device, the common EP layout, the mask
+       is just row validity and there is no overhead);
+    4. reverse the transport with the offset matrices transposed, unsort,
+       and combine with the gate weights at the source.
+
+    The receive buffer is statically sized at the true worst case (every
+    global assignment landing on one device): dropless needs the bound,
+    and XLA needs the static shape.  Falls back to a single-device
+    sort/compute/unsort (same math, no collectives) when the mesh has no
+    ``expert`` axis.
+    """
+    from jax import lax
+
+    from ..parallel import collectives
+    from ..parallel.mesh import current_mesh
+
+    b, s, h = x.shape
+    k = idx.shape[-1]
+    e = w_gate.shape[0]
+
+    mesh = current_mesh()
+    d = (
+        mesh.shape["expert"]
+        if mesh is not None and "expert" in mesh.axis_names
+        else 1
+    )
+    if e % max(d, 1):
+        raise ValueError(f"{e} experts not divisible by expert axis {d}")
+
+    def local_compute(recv, lid, valid, wg, wu, wd):
+        """Masked per-expert MLP over the receive buffer.
+
+        recv: [B, h]; lid: [B] local expert ids; valid: [B].
+        wg/wu/wd: [e_local, ...] this shard's experts.
+        """
+        def one_expert(acc, inputs):
+            w_g, w_u, w_d, le = inputs
+            sel = jnp.logical_and(lid == le, valid)
+            xin = jnp.where(sel[:, None], recv, 0).astype(dtype)
+            hidden = nn.silu(xin @ w_g.astype(dtype)) * (xin @ w_u.astype(dtype))
+            out = hidden @ w_d.astype(dtype)
+            return acc + jnp.where(sel[:, None], out, 0).astype(acc.dtype), None
+
+        acc0 = jnp.zeros((recv.shape[0], wd.shape[-1]), dtype)
+        acc, _ = jax.lax.scan(
+            one_expert, acc0,
+            (wg, wu, wd, jnp.arange(wg.shape[0], dtype=jnp.int32)))
+        return acc
+
+    def shard_body(x_blk, idx_blk, gates_blk, wg, wu, wd):
+        """Runs per expert-shard: x_blk [b/d, s, h], wg [e/d, h, m]."""
+        bl = x_blk.shape[0]
+        n = bl * s
+        e_local = wg.shape[0]
+        xf = x_blk.reshape(n, h)
+        flat_expert = idx_blk.reshape(n * k)
+        order = jnp.argsort(flat_expert, stable=True)
+        sorted_expert = flat_expert[order]
+        xs = xf[order // k].astype(dtype)                  # [n*k, h]
+
+        if d == 1:
+            y_buf = local_compute(
+                xs, sorted_expert, jnp.ones((n * k,), bool), wg, wu, wd)
+            y_sorted = y_buf
+        else:
+            me = lax.axis_index("expert")
+            dest_dev = sorted_expert // e_local
+            send_sizes = jax.ops.segment_sum(
+                jnp.ones_like(dest_dev), dest_dev, num_segments=d
+            ).astype(jnp.int32)                            # [D]
+            m_mat = lax.all_gather(send_sizes, "expert")   # [D src, D dst]
+            # exclusive cumsums: mc over sources (receiver-side layout),
+            # mr over destinations (sender-side layout)
+            mc = jnp.cumsum(m_mat, axis=0) - m_mat
+            mr = jnp.cumsum(m_mat, axis=1) - m_mat
+            input_offsets = mr[me]                         # [D]
+            output_offsets = mc[me]                        # [D]
+            recv_sizes = m_mat[:, me]                      # [D]
+            recv_starts = mc[:, me]                        # [D]
+
+            cap = n * k * d  # true worst case: all assignments on one shard
+            buf = jnp.zeros((cap, h), dtype)
+            recv = collectives.ragged_all_to_all(
+                xs, buf, input_offsets, send_sizes, output_offsets,
+                recv_sizes, axis_name="expert")
+            ids_buf = jnp.full((cap,), -1, jnp.int32)
+            ids = collectives.ragged_all_to_all(
+                sorted_expert.astype(jnp.int32), ids_buf, input_offsets,
+                send_sizes, output_offsets, recv_sizes, axis_name="expert")
+
+            rows = jnp.arange(cap)
+            valid = jnp.logical_and(
+                rows[:, None] >= recv_starts[None, :],
+                rows[:, None] < (recv_starts + recv_sizes)[None, :],
+            ).any(axis=1)
+            lid = ids - me * e_local
+            y_buf = local_compute(recv, lid, valid, wg, wu, wd)
+
+            # reverse transport: each received chunk returns to its source
+            # at the source's original sorted position
+            back = jnp.zeros((n * k, h), dtype)
+            y_sorted = collectives.ragged_all_to_all(
+                y_buf, back, recv_starts, recv_sizes, mr[:, me], send_sizes,
+                axis_name="expert")
+
+        inv = jnp.argsort(order)
+        y_flat = y_sorted[inv].reshape(n, k, h)
+        y = (y_flat * gates_blk.reshape(n, k)[..., None].astype(dtype)).sum(1)
+        return y.reshape(bl, s, h)
+
+    if d == 1:
+        return shard_body(x, idx, gates, w_gate, w_up, w_down)
+
+    from jax.sharding import PartitionSpec as P
+
+    return jax.shard_map(
+        shard_body,
+        mesh=mesh,
+        in_specs=(P("expert"), P("expert"), P("expert"), P("expert"),
+                  P("expert"), P("expert")),
+        out_specs=P("expert"),
+        axis_names={"expert"},
+        check_vma=False,
+    )(x, idx, gates, w_gate, w_up, w_down)

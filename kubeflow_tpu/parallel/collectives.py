@@ -1,0 +1,79 @@
+"""Collective wrappers with CPU-testable fallbacks.
+
+``jax.lax.ragged_all_to_all`` (the dropless-MoE transport, SURVEY §2.5 EP
+row) lowers to an HLO the TPU runtime implements but XLA:CPU does not
+(``ragged-all-to-all is not supported by XLA:CPU ThunkEmitter``).  The
+test/dryrun contract of this repo is that every multi-chip code path runs
+on the virtual CPU mesh (SURVEY §4c), so this module provides a wrapper
+with the primitive's exact documented semantics:
+
+- on TPU: the native primitive (which has jvp + transpose rules, so it
+  trains);
+- on CPU: an emulation built from ``lax.all_to_all`` over max-padded
+  chunks plus masked scatters — mathematically identical, differentiable,
+  and O(D x operand) instead of O(sum sizes), which is irrelevant at test
+  shapes.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def ragged_all_to_all(
+    operand: jax.Array,
+    output: jax.Array,
+    input_offsets: jax.Array,
+    send_sizes: jax.Array,
+    output_offsets: jax.Array,
+    recv_sizes: jax.Array,
+    *,
+    axis_name: str,
+) -> jax.Array:
+    """``lax.ragged_all_to_all`` semantics on every backend.
+
+    Per the primitive's contract: device ``i`` sends, for each destination
+    ``d``, ``operand[input_offsets[d] : +send_sizes[d]]``, which lands on
+    ``d`` at ``output_offsets[d]`` (the *receiver-side* offset); rows of
+    ``output`` not written by any received chunk keep their values.
+    """
+    if jax.default_backend() != "cpu":
+        return lax.ragged_all_to_all(
+            operand, output, input_offsets, send_sizes, output_offsets,
+            recv_sizes, axis_name=axis_name)
+    return _emulated_ragged_all_to_all(
+        operand, output, input_offsets, send_sizes, output_offsets,
+        recv_sizes, axis_name=axis_name)
+
+
+def _emulated_ragged_all_to_all(
+    operand, output, input_offsets, send_sizes, output_offsets, recv_sizes,
+    *, axis_name,
+):
+    d = lax.psum(1, axis_name)
+    pad = operand.shape[0]
+
+    # chunk for destination i, max-padded: roll the chunk start to row 0
+    # (send_sizes[i] rows are real, the rest ride along and are masked off
+    # at the receiver)
+    def chunk(i):
+        return jnp.roll(operand, -input_offsets[i], axis=0)
+
+    stacked = jax.vmap(chunk)(jnp.arange(d))          # [D, pad, ...]
+    exchanged = lax.all_to_all(stacked, axis_name, 0, 0)  # [D, pad, ...]
+    # receiver-side offsets of each incoming chunk: the all_to_all of the
+    # senders' output_offsets (exactly the doc's recipe)
+    my_offsets = lax.all_to_all(output_offsets, axis_name, 0, 0, tiled=True)
+
+    rows = jnp.arange(pad)
+
+    def write(i, out):
+        tgt = my_offsets[i] + rows
+        ok = rows < recv_sizes[i]
+        # invalid rows point past the buffer; mode="drop" discards them
+        tgt = jnp.where(ok, tgt, output.shape[0])
+        return out.at[tgt].set(exchanged[i], mode="drop")
+
+    return lax.fori_loop(0, d, write, output)

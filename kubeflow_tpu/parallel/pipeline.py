@@ -21,10 +21,12 @@ transposes to the opposite ring and ``lax.scan`` reverses.
 
 from __future__ import annotations
 
+import dataclasses
 from typing import Any, Callable, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
@@ -129,3 +131,322 @@ def gpipe(
         check_vma=False,
     )(stacked_params, x_mb)
     return out.reshape(batch, *x.shape[1:])
+
+
+# -- 1F1B (perf-grade schedule) ---------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class Schedule1F1B:
+    """Static 1F1B tick tables for ``p`` stages x ``m`` microbatches.
+
+    Each tick is one fwd slot + one bwd slot per stage (the steady-state
+    1F1B pattern).  ``fwd[t, s]`` / ``bwd[t, s]`` give the microbatch index
+    each stage processes at tick ``t`` (-1 = idle slot); ``recv_act`` /
+    ``recv_grad`` give the microbatch whose activation/cotangent arrives
+    over the ppermute ring that tick.  ``act_slots`` / ``grad_slots`` are
+    the stash capacities the schedule provably needs — the 1F1B memory
+    bound (≈ P in-flight microbatches per stage, vs GPipe's M).
+    """
+
+    p: int
+    m: int
+    fwd: np.ndarray        # [T, P] int32
+    bwd: np.ndarray        # [T, P] int32
+    recv_act: np.ndarray   # [T, P] int32
+    recv_grad: np.ndarray  # [T, P] int32
+    act_slots: int
+    grad_slots: int
+
+    @property
+    def ticks(self) -> int:
+        return self.fwd.shape[0]
+
+    @property
+    def useful_fraction(self) -> float:
+        """Filled fwd+bwd slots over total slots (1 - bubble fraction)."""
+        filled = int((self.fwd >= 0).sum() + (self.bwd >= 0).sum())
+        return filled / (2 * self.ticks * self.p)
+
+
+def schedule_1f1b(p: int, m: int) -> Schedule1F1B:
+    """Simulate the 1F1B schedule event-by-event and emit static tables.
+
+    Rules (classic non-interleaved 1F1B, Megatron-style, adapted to a
+    lockstep SPMD program with a 1-tick ppermute latency):
+
+    - a stage forwards microbatches in order as their activations arrive,
+      but holds at most ``P - s + 2`` in flight (the 1F1B throttle — this
+      is what bounds activation memory; the +2 absorbs the two-tick
+      send/receive round trip, reaching the zero-latency schedule length
+      T = M + 2(P-1) at a stash cost of ~2 extra microbatches);
+    - a stage backwards microbatches in order as cotangents arrive; the
+      last stage seeds its own cotangent from the loss at forward time,
+      so it can run fwd(m) and bwd(m) in the same tick;
+    - within a tick, the fwd slot runs before the bwd slot, and a
+      bwd-completing-this-tick frees its in-flight slot for the fwd
+      admission check.
+    """
+    if p < 1 or m < 1:
+        raise ValueError("need p >= 1 and m >= 1")
+    cap = [min(p - s + 2, m) for s in range(p)]
+    next_f, next_b = [0] * p, [0] * p
+    recv_act = [set() for _ in range(p)]
+    recv_grad = [set() for _ in range(p)]
+    fwd_tick = [[-1] * m for _ in range(p)]
+    bwd_tick = [[-1] * m for _ in range(p)]
+    frows, brows = [], []
+    t = 0
+    while any(nb < m for nb in next_b):
+        frow, brow = [-1] * p, [-1] * p
+        for s in range(p):
+            f, b = next_f[s], next_b[s]
+            # tentative bwd readiness (ignoring this tick's own fwd)
+            ready0 = b < m and (
+                (s < p - 1 and b in recv_grad[s])
+                or (s == p - 1 and fwd_tick[s][b] >= 0)
+            )
+            in_flight = f - b
+            if (
+                f < m
+                and (s == 0 or f in recv_act[s])
+                and in_flight - (1 if ready0 else 0) < cap[s]
+            ):
+                frow[s] = f
+            ready = b < m and (
+                (s < p - 1 and b in recv_grad[s])
+                or (s == p - 1 and (fwd_tick[s][b] >= 0 or frow[s] == b))
+            )
+            if ready:
+                brow[s] = b
+        for s in range(p):
+            if frow[s] >= 0:
+                fwd_tick[s][frow[s]] = t
+                next_f[s] += 1
+            if brow[s] >= 0:
+                bwd_tick[s][brow[s]] = t
+                next_b[s] += 1
+        # deliveries land next tick (decisions above read pre-tick state)
+        for s in range(p):
+            if frow[s] >= 0 and s + 1 < p:
+                recv_act[s + 1].add(frow[s])
+            if brow[s] >= 0 and s - 1 >= 0:
+                recv_grad[s - 1].add(brow[s])
+        frows.append(frow)
+        brows.append(brow)
+        t += 1
+        if t > 4 * (m + p) + 16:
+            raise RuntimeError(f"1F1B schedule deadlocked at p={p} m={m}")
+
+    T = len(frows)
+    fwd = np.array(frows, np.int32)
+    bwd = np.array(brows, np.int32)
+    ra = np.full((T, p), -1, np.int32)
+    rg = np.full((T, p), -1, np.int32)
+    for tt in range(1, T):
+        for s in range(p):
+            if s > 0:
+                ra[tt, s] = fwd[tt - 1, s - 1]
+            if s < p - 1:
+                rg[tt, s] = bwd[tt - 1, s + 1]
+
+    def max_overlap(intervals: list[tuple[int, int]]) -> int:
+        best = 0
+        for i, (lo, _) in enumerate(intervals):
+            live = sum(1 for lo2, hi2 in intervals if lo2 <= lo <= hi2)
+            best = max(best, live)
+        return best
+
+    act_slots = 1
+    grad_slots = 1
+    for s in range(p):
+        if s > 0:
+            ivs = [(fwd_tick[s - 1][mb] + 1, bwd_tick[s][mb]) for mb in range(m)]
+            act_slots = max(act_slots, max_overlap(ivs))
+        if s < p - 1:
+            ivs = [(bwd_tick[s + 1][mb] + 1, bwd_tick[s][mb]) for mb in range(m)]
+        else:
+            ivs = [(fwd_tick[s][mb], bwd_tick[s][mb]) for mb in range(m)]
+        grad_slots = max(grad_slots, max_overlap(ivs))
+    return Schedule1F1B(
+        p=p, m=m, fwd=fwd, bwd=bwd, recv_act=ra, recv_grad=rg,
+        act_slots=act_slots, grad_slots=grad_slots,
+    )
+
+
+def one_f_one_b(
+    block_apply: Callable[[Any, jax.Array], jax.Array],
+    loss_fn: Callable[..., jax.Array],
+    stacked_params: Any,
+    head_params: Any,
+    x: jax.Array,
+    loss_args: Any,
+    *,
+    mesh: Optional[Mesh] = None,
+    num_microbatches: Optional[int] = None,
+    remat: bool = True,
+):
+    """Loss **and grads** of a staged block stack under the 1F1B schedule.
+
+    ``loss = mean_mb loss_fn(head_params, blocks(x_mb), loss_args_mb)``;
+    returns ``(loss, (d_stacked_params, d_head_params, d_x))``.
+
+    Why a fused value-and-grad instead of a differentiable forward (what
+    ``gpipe`` is): 1F1B's defining property is that microbatch i's
+    *backward* runs while microbatch i+k's *forward* is still in flight,
+    bounding in-flight activations at ~P per stage instead of M.  Under
+    ``jax.grad`` the whole forward completes before any backward starts
+    (GPipe), so the schedule must own its backward: each backward tick
+    re-runs the stage forward from the stashed input (full within-stage
+    remat) through ``jax.vjp`` and sends the input-cotangent upstream
+    over the reverse ``ppermute`` ring.
+
+    ``loss_fn(head_params, y_mb, args_mb) -> scalar`` runs at the last
+    stage (masked elsewhere — SPMD lockstep executes it everywhere, so
+    keep the head small relative to a stage; at T/M > 1 ticks per useful
+    microbatch the head overhead multiplies).  ``loss_args`` is a pytree
+    whose leaves lead with the batch dim (e.g. targets), microbatched
+    like ``x``.
+    """
+    mesh = mesh or current_mesh()
+    p_size = pipeline_degree(mesh)
+
+    one = jax.checkpoint(block_apply) if remat else block_apply
+
+    def apply_stage(layers, h):
+        def body(h, lp):
+            return one(lp, h), None
+        h, _ = lax.scan(body, h, layers)
+        return h
+
+    if p_size == 1:
+        def seq_loss(sp, hp, xx):
+            return loss_fn(hp, apply_stage(sp, xx), loss_args)
+        loss, grads = jax.value_and_grad(seq_loss, argnums=(0, 1, 2))(
+            stacked_params, head_params, x)
+        return loss, grads
+
+    m = num_microbatches or p_size
+    batch = x.shape[0]
+    if batch % m:
+        raise ValueError(f"batch {batch} not divisible by {m} microbatches")
+    x_mb = x.reshape(m, batch // m, *x.shape[1:])
+    args_mb = jax.tree.map(
+        lambda a: a.reshape(m, batch // m, *a.shape[1:]), loss_args)
+
+    num_layers = jax.tree.leaves(stacked_params)[0].shape[0]
+    if num_layers % p_size:
+        raise ValueError(
+            f"{num_layers} layers not divisible by {p_size} pipeline stages")
+
+    sched = schedule_1f1b(p_size, m)
+    C, Cg = sched.act_slots, sched.grad_slots
+    fwd_tbl = jnp.asarray(sched.fwd)
+    bwd_tbl = jnp.asarray(sched.bwd)
+    ra_tbl = jnp.asarray(sched.recv_act)
+    rg_tbl = jnp.asarray(sched.recv_grad)
+
+    layer_specs = jax.tree.map(lambda _: P(AXIS), stacked_params)
+    perm_fwd = [(i, i + 1) for i in range(p_size - 1)]
+    perm_bwd = [(i + 1, i) for i in range(p_size - 1)]
+
+    def body(local_layers, head_p, x_mb, args_mb):
+        stage = lax.axis_index(AXIS)
+        is_last = stage == p_size - 1
+        mb_shape = x_mb.shape[1:]
+
+        acts_buf = jnp.zeros((C, *mb_shape), x_mb.dtype)
+        grads_buf = jnp.zeros((Cg, *mb_shape), x_mb.dtype)
+        y_prev = jnp.zeros(mb_shape, x_mb.dtype)
+        dh_prev = jnp.zeros(mb_shape, x_mb.dtype)
+        loss_acc = jnp.zeros((), jnp.float32)
+        dlayers_acc = jax.tree.map(
+            lambda l: jnp.zeros(l.shape, l.dtype), local_layers)
+        dhead_acc = jax.tree.map(
+            lambda h: jnp.zeros(h.shape, h.dtype), head_p)
+        dx_buf = jnp.zeros_like(x_mb)
+
+        loss_vag = jax.value_and_grad(loss_fn, argnums=(1, 0))
+
+        def tick(carry, rows):
+            (acts_buf, grads_buf, y_prev, dh_prev,
+             loss_acc, dlayers_acc, dhead_acc, dx_buf) = carry
+            f_row, b_row, ra_row, rg_row = rows
+            f = jnp.take(f_row, stage)
+            b = jnp.take(b_row, stage)
+            ra = jnp.take(ra_row, stage)
+            rg = jnp.take(rg_row, stage)
+
+            # 1. receive activation sent by upstream last tick
+            in_act = lax.ppermute(y_prev, AXIS, perm_fwd)
+            slot_ra = jnp.maximum(ra, 0) % C
+            acts_buf = acts_buf.at[slot_ra].set(
+                jnp.where(ra >= 0, in_act, acts_buf[slot_ra]))
+            # 2. receive cotangent sent by downstream last tick
+            in_grad = lax.ppermute(dh_prev, AXIS, perm_bwd)
+            slot_rg = jnp.maximum(rg, 0) % Cg
+            grads_buf = grads_buf.at[slot_rg].set(
+                jnp.where(rg >= 0, in_grad, grads_buf[slot_rg]))
+
+            # 3. forward slot (masked garbage when f == -1)
+            fidx = jnp.maximum(f, 0)
+            h_in_f = jnp.where(
+                stage == 0, x_mb[jnp.clip(fidx, 0, m - 1)], acts_buf[fidx % C])
+            y = apply_stage(local_layers, h_in_f)
+            # last stage seeds its own cotangent from the loss
+            a_f = jax.tree.map(lambda a: a[jnp.clip(fidx, 0, m - 1)], args_mb)
+            loss_f, (dy_f, dhead_f) = loss_vag(head_p, y, a_f)
+            seed = jnp.logical_and(is_last, f >= 0)
+            slot_f = fidx % Cg
+            grads_buf = grads_buf.at[slot_f].set(
+                jnp.where(seed, (dy_f / m).astype(grads_buf.dtype),
+                          grads_buf[slot_f]))
+            loss_acc = loss_acc + jnp.where(seed, loss_f / m, 0.0)
+            dhead_acc = jax.tree.map(
+                lambda a, g: a + jnp.where(seed, g / m, 0.0).astype(a.dtype),
+                dhead_acc, dhead_f)
+
+            # 4. backward slot: re-run the stage fwd from the stashed input
+            bidx = jnp.maximum(b, 0)
+            h_in_b = jnp.where(
+                stage == 0, x_mb[jnp.clip(bidx, 0, m - 1)], acts_buf[bidx % C])
+            dy_b = grads_buf[bidx % Cg]
+            _, stage_vjp = jax.vjp(apply_stage, local_layers, h_in_b)
+            dlayers_b, dh_b = stage_vjp(dy_b)
+            b_ok = b >= 0
+            dlayers_acc = jax.tree.map(
+                lambda a, g: a + jnp.where(b_ok, g, 0.0).astype(a.dtype),
+                dlayers_acc, dlayers_b)
+            bslot = jnp.clip(bidx, 0, m - 1)
+            wx = jnp.logical_and(b_ok, stage == 0)
+            dx_buf = dx_buf.at[bslot].set(
+                jnp.where(wx, dh_b.astype(dx_buf.dtype), dx_buf[bslot]))
+
+            # 5. what this tick sends (consumed next tick per the tables)
+            return (acts_buf, grads_buf, y, dh_b,
+                    loss_acc, dlayers_acc, dhead_acc, dx_buf), None
+
+        carry = (acts_buf, grads_buf, y_prev, dh_prev,
+                 loss_acc, dlayers_acc, dhead_acc, dx_buf)
+        carry, _ = lax.scan(tick, carry, (fwd_tbl, bwd_tbl, ra_tbl, rg_tbl))
+        (_, _, _, _, loss_acc, dlayers_acc, dhead_acc, dx_buf) = carry
+
+        # only the owning stage's accumulators are real; psum-mask them to
+        # every rank (loss/head: last stage; dx: first stage)
+        loss = lax.psum(jnp.where(is_last, loss_acc, 0.0), AXIS)
+        dhead = jax.tree.map(
+            lambda g: lax.psum(jnp.where(is_last, g, 0.0), AXIS), dhead_acc)
+        dx = lax.psum(
+            jnp.where(stage == 0, dx_buf, jnp.zeros_like(dx_buf)), AXIS)
+        return loss, dlayers_acc, dhead, dx
+
+    head_specs = jax.tree.map(lambda _: P(), head_params)
+    loss, dlayers, dhead, dx = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(layer_specs, head_specs, P(), P()),
+        out_specs=(P(), layer_specs, head_specs, P()),
+        axis_names={AXIS},
+        check_vma=False,
+    )(stacked_params, head_params, x_mb, args_mb)
+    return loss, (dlayers, dhead, dx.reshape(batch, *x.shape[1:]))

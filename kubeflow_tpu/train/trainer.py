@@ -89,6 +89,11 @@ class TrainConfig:
     #: microbatch count for pipeline parallelism (mesh has a ``pipeline``
     #: axis > 1); default = pipeline degree.  Ignored otherwise.
     num_microbatches: Optional[int] = None
+    #: pipeline schedule: "gpipe" (differentiable forward, autodiff
+    #: backward — all M microbatch activations live through the step) or
+    #: "1f1b" (fused value-and-grad, ~P in-flight microbatches — the
+    #: perf-grade memory profile; see parallel/pipeline.py).
+    pipeline_schedule: str = "gpipe"
     #: when set, capture a jax.profiler trace (XPlane, TensorBoard-loadable)
     #: of steps [profile_start, profile_stop) into this directory — the
     #: SURVEY §5 tracing-subsystem hook (reconcile metrics stay Prometheus-
@@ -248,6 +253,13 @@ class Trainer:
         dim (rows i, accum+i, ...) so each one stays evenly spread over the
         mesh's batch axes; grads accumulate in f32 regardless of param
         dtype and are averaged back to the param dtype at the end."""
+        if (self.mesh.shape.get("pipeline", 1) > 1
+                and self.cfg.pipeline_schedule == "1f1b"):
+            if self.cfg.accum_steps > 1:
+                raise NotImplementedError(
+                    "1f1b already microbatches the step; combine via "
+                    "num_microbatches instead of accum_steps")
+            return self._pipeline_1f1b_grads(params, tokens)
         accum = self.cfg.accum_steps
         if accum <= 1:
             return jax.value_and_grad(self._loss_fn)(params, tokens)
@@ -288,6 +300,52 @@ class Trainer:
         grads = jax.tree.map(
             lambda g, p: (g / accum).astype(p.dtype), grad_sum, params)
         return loss_sum / accum, grads
+
+    def _pipeline_1f1b_grads(self, params, tokens: jax.Array):
+        """(loss, grads) through the 1F1B pipeline executor: embedding runs
+        data-parallel under ``jax.vjp``, the staged block stack goes through
+        ``one_f_one_b`` (which owns its backward), and the head + loss live
+        inside the schedule's last stage.  Numerically identical to the
+        GPipe/single-mesh step (same blocks, same microbatch mean)."""
+        from ..parallel import pipeline as pipelib
+
+        mcfg = self.cfg.model
+        if mcfg.tie_embeddings:
+            raise NotImplementedError(
+                "tie_embeddings under 1f1b needs the embed table at the last "
+                "stage; use pipeline_schedule='gpipe'")
+        if mcfg.moe_experts > 0 and self.cfg.aux_loss_coef > 0:
+            raise NotImplementedError(
+                "MoE aux-loss collection is not plumbed through the "
+                "pipelined executor; set aux_loss_coef=0 explicitly")
+        if not mcfg.scan_layers:
+            raise ValueError("pipeline schedules require scan_layers=True")
+        inputs, targets = tokens[:, :-1], tokens[:, 1:]
+        positions = jnp.arange(inputs.shape[-1])[None, :]
+        embed = llamalib.Embedder(mcfg)
+        x, embed_vjp = jax.vjp(
+            lambda ep: embed.apply({"params": ep}, inputs), params["embedder"])
+
+        def block_apply(layer_params, h):
+            return llamalib.Block(mcfg).apply(
+                {"params": layer_params}, h, positions)
+
+        def loss_fn(head_params, y, tgt):
+            logits = llamalib.Head(mcfg).apply({"params": head_params}, y)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits.astype(jnp.float32), tgt).mean()
+
+        loss, (dlayers, dhead, dx) = pipelib.one_f_one_b(
+            block_apply, loss_fn, params["layers"]["block"], params["head"],
+            x, targets,
+            mesh=self.mesh, num_microbatches=self.cfg.num_microbatches,
+            remat=mcfg.remat)
+        (dembed,) = embed_vjp(dx)
+        return loss, {
+            "embedder": dembed,
+            "head": dhead,
+            "layers": {"block": dlayers},
+        }
 
     def _train_step(self, state, batch):
         loss, grads = self._grads_fn(state["params"], batch["tokens"])

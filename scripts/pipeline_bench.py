@@ -1,0 +1,120 @@
+"""Pipeline schedule probe: GPipe vs 1F1B — bubble fraction and
+compiler-estimated memory at M in {4, 8, 16} microbatches.
+
+Runs on the 8-device virtual CPU mesh (the multi-chip stand-in, SURVEY
+§4c): measures per-tick useful-work fraction analytically from the
+schedule tables, wall-clock per step on the mesh, and XLA's
+memory_analysis() for both schedules — the observable the 1F1B memory
+bound (in-flight ~P microbatches vs GPipe's M) shows up in.
+
+Prints one JSON line per (schedule, M) row.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+_flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from kubeflow_tpu.parallel import mesh as meshlib  # noqa: E402
+from kubeflow_tpu.parallel import pipeline as pipelib  # noqa: E402
+from kubeflow_tpu.parallel import sharding as shardlib  # noqa: E402
+
+P_STAGES = 4
+LAYERS = 8
+WIDTH = 256
+BATCH = 32
+STEPS = 10
+
+
+def problem():
+    k = jax.random.PRNGKey(0)
+    kw, kh, kx, kt = jax.random.split(k, 4)
+    ws = jax.random.normal(kw, (LAYERS, WIDTH, WIDTH)) * 0.1
+    head = jax.random.normal(kh, (WIDTH, 8)) * 0.1
+    x = jax.random.normal(kx, (BATCH, WIDTH))
+    tgt = jax.random.normal(kt, (BATCH, 8))
+
+    def block_apply(w, h):
+        return jnp.tanh(h @ w)
+
+    def loss_fn(hp, y, t):
+        return ((y @ hp - t) ** 2).mean()
+
+    return block_apply, loss_fn, ws, head, x, tgt
+
+
+def bench(schedule: str, m: int) -> dict:
+    block_apply, loss_fn, ws, head, x, tgt = problem()
+    mesh = meshlib.build_mesh({"pipeline": P_STAGES, "data": 8 // P_STAGES})
+
+    if schedule == "gpipe":
+        def step(ws, hp, x, tgt):
+            def loss(ws, hp):
+                y = pipelib.gpipe(
+                    block_apply, ws, x, mesh=mesh, num_microbatches=m)
+                return loss_fn(hp, y, tgt)
+            return jax.value_and_grad(loss, argnums=(0, 1))(ws, hp)
+    else:
+        def step(ws, hp, x, tgt):
+            return pipelib.one_f_one_b(
+                block_apply, loss_fn, ws, hp, x, tgt,
+                mesh=mesh, num_microbatches=m)
+
+    with shardlib.shard_context(mesh):
+        lowered = jax.jit(step).lower(ws, head, x, tgt)
+        compiled = lowered.compile()
+        mem = compiled.memory_analysis()
+        out = compiled(ws, head, x, tgt)
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        for _ in range(STEPS):
+            out = compiled(ws, head, x, tgt)
+        jax.block_until_ready(out)
+        dt = (time.perf_counter() - t0) / STEPS
+
+    row = {
+        "metric": "pipeline_schedule_probe",
+        "schedule": schedule,
+        "stages": P_STAGES,
+        "microbatches": m,
+        "step_ms": round(dt * 1e3, 2),
+        "temp_bytes_per_device": getattr(mem, "temp_size_in_bytes", None),
+        "argument_bytes": getattr(mem, "argument_size_in_bytes", None),
+    }
+    if schedule == "1f1b":
+        s = pipelib.schedule_1f1b(P_STAGES, m)
+        row["ticks"] = s.ticks
+        row["useful_fraction"] = round(s.useful_fraction, 3)
+        row["act_stash_microbatches"] = s.act_slots
+    else:
+        row["ticks"] = m + P_STAGES - 1
+        row["useful_fraction"] = round(m / (m + P_STAGES - 1), 3)
+        row["act_stash_microbatches"] = m
+    return row
+
+
+def main() -> None:
+    for m in (4, 8, 16):
+        for schedule in ("gpipe", "1f1b"):
+            print(json.dumps(bench(schedule, m)), flush=True)
+
+
+if __name__ == "__main__":
+    main()

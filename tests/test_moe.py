@@ -139,3 +139,101 @@ class TestExpertParallel:
         m = t.train()
         assert m is not None and m.step == 2
         assert np.isfinite(m.loss)
+
+
+class TestRaggedDispatch:
+    """Dropless dispatch via ragged_all_to_all (SURVEY §2.5 EP row)."""
+
+    def test_ragged_matches_dense_at_ample_capacity(self):
+        """With capacity high enough that dense drops nothing, the two
+        dispatch impls are the same function (fwd)."""
+        x = jax.random.normal(jax.random.PRNGKey(0), (2, 16, 64), jnp.float32)
+        dense = MoeMlp(_cfg(moe_capacity_factor=8.0))
+        ragged = MoeMlp(_cfg(moe_dispatch="ragged"))
+        p = nn.meta.unbox(dense.init(jax.random.PRNGKey(1), x)["params"])
+        out_d = dense.apply({"params": p}, x)
+        out_r = ragged.apply({"params": p}, x)
+        np.testing.assert_allclose(
+            np.asarray(out_r), np.asarray(out_d), atol=2e-5)
+
+    def test_ragged_grads_match_dense(self):
+        x = jax.random.normal(jax.random.PRNGKey(0), (2, 8, 64), jnp.float32)
+        dense = MoeMlp(_cfg(moe_capacity_factor=8.0))
+        ragged = MoeMlp(_cfg(moe_dispatch="ragged"))
+        p = nn.meta.unbox(dense.init(jax.random.PRNGKey(1), x)["params"])
+
+        def loss(mod):
+            return lambda pp: (mod.apply({"params": pp}, x) ** 2).mean()
+
+        g_d = jax.grad(loss(dense))(p)
+        g_r = jax.grad(loss(ragged))(p)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(b), np.asarray(a), atol=3e-5),
+            g_d, g_r)
+
+    def test_ragged_never_drops(self):
+        """The config that forces heavy dropping in dense mode (capacity
+        ~1 slot) changes nothing in ragged mode: dropless means the
+        capacity factor is out of the picture."""
+        x = jax.random.normal(jax.random.PRNGKey(0), (1, 32, 64), jnp.float32)
+        r_small = MoeMlp(_cfg(moe_dispatch="ragged", moe_capacity_factor=0.01))
+        r_big = MoeMlp(_cfg(moe_dispatch="ragged", moe_capacity_factor=8.0))
+        p = nn.meta.unbox(r_big.init(jax.random.PRNGKey(1), x)["params"])
+        out_small = r_small.apply({"params": p}, x)
+        out_big = r_big.apply({"params": p}, x)
+        np.testing.assert_allclose(
+            np.asarray(out_small), np.asarray(out_big), atol=0, rtol=0)
+
+    def test_ragged_skewed_routing_beats_dense_drops(self):
+        """A router collapsed onto one expert: dense at capacity_factor=1
+        drops most assignments; ragged honors all of them (outputs match a
+        drop-free reference)."""
+        cfg = _cfg(moe_dispatch="ragged", moe_top_k=1)
+        x = jax.random.normal(jax.random.PRNGKey(0), (1, 16, 64), jnp.float32)
+        moe = MoeMlp(cfg)
+        p = nn.meta.unbox(moe.init(jax.random.PRNGKey(1), x)["params"])
+        # collapse the router: all tokens to expert 0
+        p = dict(p)
+        p["router"] = jnp.zeros_like(p["router"]).at[:, 0].set(10.0)
+        out_r = moe.apply({"params": p}, x)
+        ample = MoeMlp(_cfg(moe_top_k=1, moe_capacity_factor=64.0))
+        out_ref = ample.apply({"params": p}, x)
+        np.testing.assert_allclose(
+            np.asarray(out_r), np.asarray(out_ref), atol=2e-5)
+        dropped = MoeMlp(_cfg(moe_top_k=1, moe_capacity_factor=1.0))
+        out_drop = dropped.apply({"params": p}, x)
+        # sanity that the comparison means something: dense DID drop
+        assert float(jnp.abs(out_drop - out_ref).max()) > 1e-3
+
+    def test_ragged_sharded_matches_single_device(self):
+        """Ragged MoE Llama forward on an {expert,data} mesh (real
+        ragged_all_to_all transport between expert shards) == single
+        device."""
+        cfg = _cfg(num_layers=2, moe_dispatch="ragged")
+        model = llamalib.Llama(cfg)
+        tokens = jnp.arange(4 * 16, dtype=jnp.int32).reshape(4, 16) % cfg.vocab_size
+        params = model.init(jax.random.PRNGKey(0), tokens)
+        ref = model.apply(params, tokens)
+
+        mesh = meshlib.build_mesh({"expert": 4, "data": 2})
+        with shardlib.shard_context(mesh):
+            sharded = jax.jit(model.apply)(params, tokens)
+        np.testing.assert_allclose(
+            np.asarray(sharded), np.asarray(ref), atol=3e-2, rtol=3e-2)
+
+    def test_ragged_trains_on_expert_mesh(self):
+        from kubeflow_tpu.train import trainer as trainlib
+
+        cfg = trainlib.TrainConfig(
+            model=_cfg(num_layers=2, moe_dispatch="ragged"),
+            mesh_axes={"expert": 2, "data": 4},
+            global_batch=8,
+            seq_len=16,
+            steps=2,
+            log_every=1,
+        )
+        t = trainlib.Trainer(cfg, devices=jax.devices())
+        m = t.train()
+        assert m is not None and m.step == 2
+        assert np.isfinite(m.loss)

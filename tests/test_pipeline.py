@@ -19,7 +19,8 @@ from kubeflow_tpu.parallel import sharding as shardlib
 from kubeflow_tpu.train import trainer as trainlib
 
 
-def _losses(axes, *, num_slices=1, steps=4, num_microbatches=None, model=None):
+def _losses(axes, *, num_slices=1, steps=4, num_microbatches=None, model=None,
+            **kw):
     cfg = trainlib.TrainConfig(
         model=model or llamalib.tiny(num_layers=4, remat=True),
         mesh_axes=axes,
@@ -30,6 +31,7 @@ def _losses(axes, *, num_slices=1, steps=4, num_microbatches=None, model=None):
         log_every=1,
         learning_rate=1e-3,
         num_microbatches=num_microbatches,
+        **kw,
     )
     t = trainlib.Trainer(cfg, devices=jax.devices())
     out = []
@@ -128,3 +130,91 @@ def test_pipeline_indivisible_batch_rejected():
         with shardlib.shard_context(mesh):
             pipelib.gpipe(
                 lambda w, h: h @ w, ws, x, mesh=mesh, num_microbatches=2)
+
+
+# -- 1F1B -------------------------------------------------------------------
+
+
+def _mlp_problem(n_layers=8, width=16, batch=8, seed=0):
+    k = jax.random.PRNGKey(seed)
+    kw, kh, kx, kt = jax.random.split(k, 4)
+    ws = jax.random.normal(kw, (n_layers, width, width)) * 0.1
+    head = jax.random.normal(kh, (width, 4)) * 0.1
+    x = jax.random.normal(kx, (batch, width))
+    tgt = jax.random.normal(kt, (batch, 4))
+
+    def block_apply(w, h):
+        return jnp.tanh(h @ w)
+
+    def loss_fn(hp, y, t):
+        return ((y @ hp - t) ** 2).mean()
+
+    def seq_ref(ws, hp, x):
+        h = x
+        for i in range(n_layers):
+            h = block_apply(ws[i], h)
+        return loss_fn(hp, h, tgt)
+
+    return block_apply, loss_fn, ws, head, x, tgt, seq_ref
+
+
+@pytest.mark.parametrize("p,m", [(2, 2), (2, 4), (4, 4), (4, 8)])
+def test_1f1b_loss_and_grads_match_sequential(p, m):
+    """The fused 1F1B value-and-grad equals sequential autodiff exactly —
+    loss, layer grads, head grads, and input grads."""
+    block_apply, loss_fn, ws, head, x, tgt, seq_ref = _mlp_problem()
+    mesh = meshlib.build_mesh({"pipeline": p, "data": 8 // p})
+
+    ref_loss, ref_grads = jax.jit(
+        jax.value_and_grad(seq_ref, argnums=(0, 1, 2)))(ws, head, x)
+
+    with shardlib.shard_context(mesh):
+        loss, (dws, dhead, dx) = jax.jit(
+            lambda ws, hp, x, tgt: pipelib.one_f_one_b(
+                block_apply, loss_fn, ws, hp, x, tgt,
+                mesh=mesh, num_microbatches=m)
+        )(ws, head, x, tgt)
+
+    np.testing.assert_allclose(float(loss), float(ref_loss), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(dws), np.asarray(ref_grads[0]), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(dhead), np.asarray(ref_grads[1]), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(dx), np.asarray(ref_grads[2]), atol=1e-5)
+
+
+def test_1f1b_no_pipeline_axis_falls_back():
+    block_apply, loss_fn, ws, head, x, tgt, seq_ref = _mlp_problem()
+    mesh = meshlib.build_mesh({"data": 8})
+    ref_loss, _ = jax.jit(
+        jax.value_and_grad(seq_ref, argnums=(0, 1, 2)))(ws, head, x)
+    with shardlib.shard_context(mesh):
+        loss, grads = pipelib.one_f_one_b(
+            block_apply, loss_fn, ws, head, x, tgt, mesh=mesh)
+    np.testing.assert_allclose(float(loss), float(ref_loss), atol=1e-6)
+
+
+def test_1f1b_schedule_properties():
+    """Schedule invariants: every (stage, microbatch) runs fwd and bwd
+    exactly once, in order, and the stash bound stays ~P, not M."""
+    for p, m in [(2, 8), (4, 16), (8, 8)]:
+        s = pipelib.schedule_1f1b(p, m)
+        for st in range(p):
+            fs = [s.fwd[t, st] for t in range(s.ticks) if s.fwd[t, st] >= 0]
+            bs = [s.bwd[t, st] for t in range(s.ticks) if s.bwd[t, st] >= 0]
+            assert fs == list(range(m))
+            assert bs == list(range(m))
+        # the 1F1B memory bound: in-flight activations ~P regardless of M
+        assert s.act_slots <= p + 2
+        assert s.grad_slots <= 2
+        # schedule length reaches the latency-adjusted ideal M + 2(P-1)
+        # (within the few extra warmup ticks deep pipelines need)
+        assert s.ticks <= m + 2 * (p - 1) + p // 2
+
+
+def test_1f1b_trainer_matches_single_mesh_loss_trajectory():
+    """{pipeline:2, data:4} 1F1B training == {data:8} training, step for
+    step — the same bar the GPipe schedule passes."""
+    ref = _losses({"data": 8}, steps=3)
+    pp = _losses({"pipeline": 2, "data": 4}, steps=3,
+                 num_microbatches=4, pipeline_schedule="1f1b")
+    assert len(ref) == len(pp) == 3
+    np.testing.assert_allclose(pp, ref, atol=1e-4)
