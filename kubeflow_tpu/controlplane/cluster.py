@@ -65,6 +65,8 @@ class Cluster:
             ("kft-echo", ["echo"], "kubeflow_tpu.serving.runtimes:EchoModel"),
             ("kft-jax", ["jax", "flax"], "kubeflow_tpu.serving.runtimes:JaxFunctionModel"),
             ("kft-llama", ["llama", "llm"], "kubeflow_tpu.serving.runtimes:LlamaGenerator"),
+            ("kft-llama-continuous", ["llama-continuous"],
+             "kubeflow_tpu.serving.continuous:ContinuousLlamaGenerator"),
             ("kft-bert", ["bert"], "kubeflow_tpu.serving.runtimes:BertClassifierModel"),
         ):
             try:
@@ -101,10 +103,15 @@ class Cluster:
 
     def serve_dashboard(self, port: int = 0) -> str:
         """Start the central dashboard over this cluster's store; returns
-        its URL.  Stopped with the cluster."""
+        its URL.  Stopped with the cluster.  When HPO is enabled the
+        dashboard also gets the observation DB (experiment curves) and
+        the pod-log resolver (log views)."""
         from ..ux.dashboard import Dashboard
 
-        self._dashboard = Dashboard(self.store, port=port or None)
+        self._dashboard = Dashboard(
+            self.store, port=port or None,
+            db=getattr(self, "_db_client", None),
+            log_path_for=getattr(self, "_log_path_for", None))
         return self._dashboard.url
 
     def enable_hpo(
@@ -130,12 +137,19 @@ class Cluster:
         )
         from ..hpo.db import DbManagerClient, DbManagerServer
 
+        self._log_path_for = log_path_for  # also feeds the dashboard's log view
+        dashboard = getattr(self, "_dashboard", None)
+        if dashboard is not None:
+            # dashboard started before HPO: hand it the log source now
+            dashboard.log_path_for = log_path_for
         if db_path is None and metrics_root is not None:
             db_path = os.path.join(metrics_root, "observations.sqlite")
         db_client = None
         if db_path is not None:
             self._db_server = DbManagerServer(db_path).start()
             db_client = self._db_client = DbManagerClient(self._db_server.address)
+            if dashboard is not None:
+                dashboard.db = db_client
 
         self.add_controller(ExperimentController(self.store, db=db_client))
         self.add_controller(SuggestionController(self.store, db=db_client))

@@ -129,20 +129,26 @@ class GridSearch(Suggester):
     resolution), same contract as Katib's grid suggester."""
 
     name = "grid"
+    #: points per continuous axis when the spec gives no ``step`` — kept
+    #: deliberately coarse because grid cost is resolution^d (Katib's grid
+    #: suggester simply REQUIRES step for doubles; defaulting is kinder).
+    #: Override per experiment with settings["resolution"].
     DEFAULT_RESOLUTION = 4
 
-    def _axis(self, p: ParameterSpec) -> list[object]:
+    def _axis(self, p: ParameterSpec, resolution: int) -> list[object]:
         fs = p.feasible_space
         if p.parameter_type in (ParameterType.CATEGORICAL, ParameterType.DISCRETE):
             return list(fs.list_)
         if p.parameter_type == ParameterType.INT:
             step = int(fs.step or 1)
             return list(range(int(fs.min), int(fs.max) + 1, step))
-        n = int((fs.max - fs.min) / fs.step) + 1 if fs.step else self.DEFAULT_RESOLUTION
+        n = int((fs.max - fs.min) / fs.step) + 1 if fs.step else resolution
         return [fs.min + i * (fs.max - fs.min) / max(n - 1, 1) for i in range(n)]
 
     def suggest(self, req: SuggestRequest) -> list[dict[str, object]]:
-        axes = [(p.name, self._axis(p)) for p in req.parameters]
+        resolution = int(req.settings.get(
+            "resolution", self.DEFAULT_RESOLUTION))
+        axes = [(p.name, self._axis(p, resolution)) for p in req.parameters]
         total = math.prod(len(v) for _, v in axes)
         # cursor = assignments already issued (running trials included), NOT
         # completed history — else parallel trials revisit cells
@@ -169,7 +175,19 @@ class Tpe(Suggester):
     N_STARTUP = 5
     N_CANDIDATES = 32
     GAMMA = 0.25
+    #: Parzen-window bandwidth FLOOR in unit space.  The working bandwidth
+    #: is per-dimension Scott's-rule (std(centers_d) * n^(-1/(d+4))),
+    #: floored here so early history (few points, zero spread on a dim)
+    #: still explores; override with settings["bandwidth"].
     BANDWIDTH = 0.15
+
+    def _bandwidths(self, centers: np.ndarray, floor: float) -> np.ndarray:
+        """Scott's-rule per-dimension bandwidths — adapts to history
+        spread and dimensionality instead of one magic constant (r2
+        advisor: fixed 0.15 degrades past ~4 dims)."""
+        n, d = centers.shape
+        scott = centers.std(axis=0) * n ** (-1.0 / (d + 4))
+        return np.clip(scott, floor, 0.5)
 
     def suggest(self, req: SuggestRequest) -> list[dict[str, object]]:
         if len(req.history) < self.N_STARTUP:
@@ -184,11 +202,14 @@ class Tpe(Suggester):
         n_good = max(1, int(self.GAMMA * len(vals)))
         order = np.argsort(vals)
         good, bad = pts[order[:n_good]], pts[order[n_good:]]
+        floor = float(req.settings.get("bandwidth", self.BANDWIDTH))
+        bw_good = self._bandwidths(good, floor)   # [d]
+        bw_all = self._bandwidths(pts, floor)
 
         def density(x: np.ndarray, centers: np.ndarray) -> np.ndarray:
             # product over dims of mean-of-gaussians (Parzen window)
             d2 = (x[:, None, :] - centers[None, :, :]) ** 2
-            kern = np.exp(-0.5 * d2 / self.BANDWIDTH**2)
+            kern = np.exp(-0.5 * d2 / bw_all**2)
             return np.log(kern.mean(axis=1) + 1e-12).sum(axis=-1)
 
         out = []
@@ -196,7 +217,8 @@ class Tpe(Suggester):
         for _ in range(req.count):
             # candidates drawn around the good set
             idx = nprng.integers(0, len(good), self.N_CANDIDATES)
-            cand = good[idx] + nprng.normal(0, self.BANDWIDTH, (self.N_CANDIDATES, pts.shape[1]))
+            cand = good[idx] + nprng.normal(
+                0, 1.0, (self.N_CANDIDATES, pts.shape[1])) * bw_good
             cand = np.clip(cand, 0.0, 1.0)
             score = density(cand, good) - density(cand, bad_aug)
             best = cand[int(np.argmax(score))]
@@ -219,6 +241,11 @@ class BayesianOptimization(Suggester):
     name = "bayesianoptimization"
     N_STARTUP = 4
     N_CANDIDATES = 256
+    #: RBF length-scale FLOOR; the working scale is the median pairwise
+    #: distance of the history in unit space (the standard median
+    #: heuristic), so it adapts to dimensionality — median distance grows
+    #: ~sqrt(d) and a fixed 0.2 would make every point look far in high d.
+    #: Override with settings["length_scale"].
     LENGTH_SCALE = 0.2
     NOISE = 1e-6
 
@@ -236,9 +263,17 @@ class BayesianOptimization(Suggester):
         y_mean, y_std = y.mean(), y.std() or 1.0
         yn = (y - y_mean) / y_std
 
+        if "length_scale" in req.settings:
+            scale = float(req.settings["length_scale"])
+        else:
+            diff2 = ((x[:, None, :] - x[None, :, :]) ** 2).sum(-1)
+            pair = np.sqrt(diff2[np.triu_indices(len(x), k=1)])
+            med = float(np.median(pair)) if len(pair) else 0.0
+            scale = max(med, self.LENGTH_SCALE)
+
         def kernel(a: np.ndarray, b: np.ndarray) -> np.ndarray:
             d2 = ((a[:, None, :] - b[None, :, :]) ** 2).sum(-1)
-            return np.exp(-0.5 * d2 / self.LENGTH_SCALE**2)
+            return np.exp(-0.5 * d2 / scale**2)
 
         k_xx = kernel(x, x) + self.NOISE * np.eye(len(x))
         l_chol = np.linalg.cholesky(k_xx)
@@ -493,16 +528,21 @@ class Pbt(Suggester):
         return out
 
 
-REGISTRY: dict[str, type[Suggester]] = {
+REGISTRY: dict[str, type] = {
     cls.name: cls
     for cls in (RandomSearch, GridSearch, Tpe, BayesianOptimization, CmaEs, Pbt)
 }
 
 
 def get_suggester(name: str) -> Suggester:
+    if name == "darts":  # one-shot NAS lives in nas.py (heavy jax deps)
+        from .nas import OneShotNas
+
+        return OneShotNas()
     try:
         return REGISTRY[name]()
     except KeyError:
         raise ValueError(
-            f"unknown algorithm {name!r}; available: {sorted(REGISTRY)}"
+            f"unknown algorithm {name!r}; available: "
+            f"{sorted(REGISTRY) + ['darts']}"
         ) from None

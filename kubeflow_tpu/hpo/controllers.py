@@ -530,14 +530,28 @@ class TrialController(Controller):
             self._set_phase(trial, "Succeeded", observation=objective, metrics=metrics)
             if self.db is not None:
                 try:
+                    assignments = {
+                        a.name: a.value for a in trial.spec.assignments}
                     self.db.report_observation(
                         experiment=trial.spec.experiment_name,
                         trial=name,
-                        assignments={
-                            a.name: a.value for a in trial.spec.assignments},
+                        assignments=assignments,
                         value=objective,
                         namespace=namespace,
                     )
+                    # per-step series of the objective behind the
+                    # experiment-curves view (Katib's observation log) —
+                    # ONE batched RPC, not one per step
+                    series = self._scrape_series(
+                        namespace, job, trial.spec.objective_metric_name)
+                    if series:
+                        self.db.report_observation_series(
+                            experiment=trial.spec.experiment_name,
+                            trial=name,
+                            assignments=assignments,
+                            series=series,
+                            namespace=namespace,
+                        )
                 except Exception:  # noqa: BLE001 — db down: trial still valid
                     self.emit_event(
                         trial, "ObservationReportFailed",
@@ -650,6 +664,33 @@ class TrialController(Controller):
                     metrics.update(
                         self._read_stdout(self.log_path_for(namespace, pod)))
         return metrics, steps
+
+    def _scrape_series(
+        self, namespace: str, job: JaxJob, metric_name: str
+    ) -> list[tuple[int, float]]:
+        """Full (step, value) series of one metric across worker jsonl
+        streams — the per-step observation log (last value wins per step)."""
+        series: dict[int, float] = {}
+        if not self.metrics_root:
+            return []
+        for rtype, rspec in job.spec.replica_specs.items():
+            for idx in range(rspec.replicas):
+                pod = replica_pod_name(job.metadata.name, rtype, idx)
+                path = os.path.join(
+                    self.metrics_root, "status", namespace, pod, "metrics.jsonl")
+                try:
+                    with open(path) as f:
+                        for line in f:
+                            try:
+                                rec = json.loads(line)
+                                if (str(rec["name"]) == metric_name
+                                        and "step" in rec):
+                                    series[int(rec["step"])] = float(rec["value"])
+                            except (ValueError, KeyError):
+                                continue
+                except OSError:
+                    continue
+        return sorted(series.items())
 
     @staticmethod
     def _read_jsonl(path: str) -> tuple[dict[str, float], dict[str, int]]:

@@ -1,0 +1,256 @@
+"""One-shot NAS: DARTS-style weight-sharing supernet over Llama shapes.
+
+SURVEY §2.3 lists NAS (ENAS/DARTS) among the reference's suggestion
+services [upstream: kubeflow/katib -> pkg/suggestion/v1beta1/nas/...];
+rounds 1-2 covered architecture search only as HPO over shape ints (a
+reduction: every candidate trains from scratch).  This module is the
+one-shot capability: ONE supernet trains with continuous architecture
+parameters, and good discrete architectures read off the learned mixture
+— trial-steps-to-quality beats the from-scratch reduction because weight
+sharing amortizes training across the whole space (tested closed-loop
+against TPE at equal step budget).
+
+TPU-first formulation (everything static-shaped, one jitted train step):
+
+- **depth**: the supernet runs all ``L_max`` blocks and mixes the
+  per-depth hidden states with ``softmax(alpha_depth)`` — the DARTS
+  continuous relaxation of "how many layers".
+- **FFN width**: width choices nest, so mixing over masked widths
+  collapses to one elementwise column gate: ``gate_j = sum of
+  softmax(alpha_ffn)[c] over choices c wider than j``.  No per-choice
+  branches, no dynamic shapes — the mixture costs ONE max-width MLP.
+- first-order DARTS: weights and alphas optimize jointly on the same
+  batches (the standard first-order approximation).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Iterator, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import linen as nn
+
+from ..models import llama as llamalib
+
+
+@dataclasses.dataclass(frozen=True)
+class ArchSpace:
+    """The searched slice of the Llama shape space."""
+
+    max_layers: int = 6
+    ffn_widths: tuple[int, ...] = (64, 128)  # intermediate sizes, ascending
+
+    def __post_init__(self):
+        if list(self.ffn_widths) != sorted(set(self.ffn_widths)):
+            raise ValueError("ffn_widths must be ascending and unique")
+
+
+class _GatedMlp(nn.Module):
+    """Llama gated MLP with a per-column width gate (the nested-mask
+    mixture over FFN width choices)."""
+
+    cfg: llamalib.LlamaConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array, gate: jax.Array) -> jax.Array:
+        cfg = self.cfg
+        h_dim = x.shape[-1]
+        from functools import partial
+
+        proj = partial(
+            llamalib.Einsum, dtype=cfg.dtype, param_dtype=cfg.param_dtype)
+        g = proj("bse,em->bsm", (h_dim, cfg.intermediate_size),
+                 ("embed", "mlp"), name="w_gate")(x)
+        up = proj("bse,em->bsm", (h_dim, cfg.intermediate_size),
+                  ("embed", "mlp"), name="w_up")(x)
+        hidden = nn.silu(g) * up * gate  # gate: [m] soft width mask
+        return proj("bsm,me->bse", (cfg.intermediate_size, h_dim),
+                    ("mlp", "embed"), name="w_down")(hidden)
+
+
+class _SuperBlock(nn.Module):
+    cfg: llamalib.LlamaConfig
+
+    @nn.compact
+    def __call__(self, x, positions, gate):
+        cfg = self.cfg
+        h = llamalib.RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="attn_norm")(x)
+        x = x + llamalib.Attention(cfg, name="attn")(h, positions)
+        h = llamalib.RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="mlp_norm")(x)
+        x = x + _GatedMlp(cfg, name="mlp")(h, gate)
+        return x
+
+
+class SupernetLM(nn.Module):
+    """Weight-sharing Llama supernet with architecture parameters.
+
+    ``alpha_depth`` [L_max] and ``alpha_ffn`` [len(ffn_widths)] live in
+    the ``arch`` param collection so the optimizer can treat them
+    separately from weights.
+    """
+
+    cfg: llamalib.LlamaConfig  # at max shape (intermediate_size = widest)
+    space: ArchSpace
+
+    @nn.compact
+    def __call__(self, tokens: jax.Array) -> jax.Array:
+        cfg, space = self.cfg, self.space
+        positions = jnp.arange(tokens.shape[-1])[None, :]
+        alpha_d = self.param(
+            "alpha_depth", nn.initializers.zeros, (space.max_layers,),
+            jnp.float32)
+        alpha_f = self.param(
+            "alpha_ffn", nn.initializers.zeros, (len(space.ffn_widths),),
+            jnp.float32)
+
+        # nested width masks -> one soft column gate
+        widths = jnp.asarray(space.ffn_widths)
+        cols = jnp.arange(cfg.intermediate_size)
+        nested = (cols[None, :] < widths[:, None]).astype(jnp.float32)
+        gate = jax.nn.softmax(alpha_f) @ nested  # [intermediate_size]
+
+        x = llamalib.Embedder(cfg, name="embedder")(tokens)
+        depth_w = jax.nn.softmax(alpha_d)
+        mix = jnp.zeros_like(x)
+        for layer in range(space.max_layers):
+            x = _SuperBlock(cfg, name=f"layer_{layer}")(x, positions, gate)
+            mix = mix + depth_w[layer] * x
+        return llamalib.Head(cfg, name="head")(mix)
+
+
+@dataclasses.dataclass
+class NasResult:
+    alpha_depth: np.ndarray
+    alpha_ffn: np.ndarray
+    #: (layers, ffn_width) ranked by joint architecture probability
+    ranked: list[tuple[int, int]]
+    final_loss: float
+
+
+def darts_search(
+    base_cfg: llamalib.LlamaConfig,
+    space: ArchSpace,
+    batches: Iterator[Any],
+    *,
+    steps: int = 200,
+    weights_lr: float = 3e-3,
+    arch_lr: float = 3e-2,
+    seed: int = 0,
+) -> NasResult:
+    """Train the supernet for ``steps`` and read off ranked architectures.
+
+    ``batches`` yields int32 [b, s] token arrays (next-token LM objective,
+    same as the trainer's).  Architecture params get their own learning
+    rate (DARTS convention: alphas move faster than weights but start
+    uniform).
+    """
+    cfg = dataclasses.replace(
+        base_cfg,
+        num_layers=space.max_layers,
+        intermediate_size=space.ffn_widths[-1],
+        scan_layers=False, remat=False,
+    )
+    model = SupernetLM(cfg, space)
+    first = next(batches)
+    params = model.init(jax.random.PRNGKey(seed), jnp.asarray(first))["params"]
+
+    def is_arch(path: tuple) -> bool:
+        return any(getattr(k, "key", None) in ("alpha_depth", "alpha_ffn")
+                   for k in path)
+
+    label = jax.tree_util.tree_map_with_path(
+        lambda p, _: "arch" if is_arch(p) else "weights", params)
+    tx = optax.multi_transform(
+        {"weights": optax.adamw(weights_lr), "arch": optax.adam(arch_lr)},
+        label)
+    opt_state = tx.init(params)
+
+    def loss_fn(params, tokens):
+        logits = model.apply({"params": params}, tokens[:, :-1])
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits.astype(jnp.float32), tokens[:, 1:]).mean()
+
+    @jax.jit
+    def step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    loss = jnp.inf
+    tokens = jnp.asarray(first)
+    for i in range(steps):
+        params, opt_state, loss = step(params, opt_state, tokens)
+        tokens = jnp.asarray(next(batches))
+
+    a_d = np.asarray(params["alpha_depth"], np.float64)
+    a_f = np.asarray(params["alpha_ffn"], np.float64)
+    p_d = np.exp(a_d - a_d.max()); p_d /= p_d.sum()
+    p_f = np.exp(a_f - a_f.max()); p_f /= p_f.sum()
+    combos = [
+        (int(layer + 1), int(w), float(p_d[layer] * p_f[c]))
+        for layer in range(space.max_layers)
+        for c, w in enumerate(space.ffn_widths)
+    ]
+    combos.sort(key=lambda t: -t[2])
+    return NasResult(
+        alpha_depth=a_d, alpha_ffn=a_f,
+        ranked=[(layers, w) for layers, w, _ in combos],
+        final_loss=float(loss),
+    )
+
+
+# -- suggester integration ----------------------------------------------------
+
+#: task registry: experiments point the darts suggester at a supernet
+#: task via settings {"task_ref": "<key>"}; the value is a zero-arg
+#: callable -> (base_cfg, ArchSpace, batch_iterator)
+_TASKS: dict[str, Callable[[], tuple]] = {}
+
+
+def register_task(key: str, factory: Callable[[], tuple]) -> str:
+    _TASKS[key] = factory
+    return key
+
+
+class OneShotNas:
+    """Katib-style suggester façade over ``darts_search``.
+
+    The reference's DARTS suggestion service receives the search space
+    and the trial trains the supernet; here the (in-process) suggestion
+    service runs the supernet itself on first call — one shot — and then
+    suggests architectures in ranked order for verification trials.
+    Stateless-replay safe: same settings + seed -> same supernet run ->
+    same ranking (cached per settings fingerprint).
+    """
+
+    name = "darts"
+
+    def __init__(self) -> None:
+        self._cache: dict[str, list[tuple[int, int]]] = {}
+
+    def suggest(self, req) -> list[dict[str, object]]:
+        settings = req.settings
+        key = settings.get("task_ref", "")
+        if key not in _TASKS:
+            raise ValueError(
+                f"darts suggester needs settings.task_ref naming a "
+                f"registered nas task; got {key!r}")
+        fp = f"{key}:{settings.get('supernet_steps', '')}:{req.seed}"
+        if fp not in self._cache:
+            base_cfg, space, batches = _TASKS[key]()
+            result = darts_search(
+                base_cfg, space, batches,
+                steps=int(settings.get("supernet_steps", 200)),
+                seed=req.seed or 0,
+            )
+            self._cache[fp] = result.ranked
+        ranked = self._cache[fp]
+        out = []
+        for i in range(req.count):
+            layers, width = ranked[(req.issued + i) % len(ranked)]
+            out.append({"layers": layers, "ffn_width": width})
+        return out

@@ -63,10 +63,17 @@ def _phase_of(summary: dict) -> str:
 
 
 class Dashboard:
-    """Serve ``/`` (HTML index), ``/api/overview`` and ``/api/<section>``."""
+    """Serve ``/`` (HTML index), ``/api/overview``, ``/api/<section>``,
+    per-object detail ``/api/<section>/<ns>/<name>`` (+ its events, + pod
+    logs via ``log_path_for``), and experiment metric curves
+    ``/api/experiments/<ns>/<name>/curves`` (the Katib UI's main job,
+    read from the observation DB)."""
 
-    def __init__(self, store: Store, port: Optional[int] = None):
+    def __init__(self, store: Store, port: Optional[int] = None,
+                 db=None, log_path_for=None):
         self.store = store
+        self.db = db  # hpo.db.DbManagerClient (experiment curves)
+        self.log_path_for = log_path_for  # (namespace, pod) -> log path
         self.port = port or allocate_port()
         dash = self
 
@@ -89,12 +96,33 @@ class Dashboard:
                         self._send(200, json.dumps(dash.overview()).encode(),
                                    "application/json")
                     elif self.path.startswith("/api/"):
-                        section = self.path[len("/api/"):].strip("/")
-                        if section not in _SECTIONS:
+                        parts = self.path[len("/api/"):].strip("/").split("/")
+                        if parts[0] not in _SECTIONS:
                             self._send(404, b'{"error": "unknown section"}',
                                        "application/json")
                             return
-                        self._send(200, json.dumps(dash.section(section)).encode(),
+                        if len(parts) == 1:
+                            payload = dash.section(parts[0])
+                        elif len(parts) == 3:
+                            payload = dash.detail(parts[0], parts[1], parts[2])
+                        elif (len(parts) == 4 and parts[0] == "experiments"
+                              and parts[3] == "curves"):
+                            payload = dash.curves(parts[1], parts[2])
+                        elif (len(parts) == 4 and parts[0] == "pods"
+                              and parts[3] == "logs"):
+                            self._send(
+                                200, dash.pod_logs(parts[1], parts[2]).encode(),
+                                "text/plain")
+                            return
+                        else:
+                            self._send(404, b'{"error": "unknown path"}',
+                                       "application/json")
+                            return
+                        if payload is None:
+                            self._send(404, b'{"error": "not found"}',
+                                       "application/json")
+                            return
+                        self._send(200, json.dumps(payload).encode(),
                                    "application/json")
                     else:
                         self._send(404, b"not found", "text/plain")
@@ -121,6 +149,52 @@ class Dashboard:
 
     def section(self, name: str) -> list[dict]:
         return [_summarize(o) for o in self.store.list(_SECTIONS[name])]
+
+    def detail(self, section: str, namespace: str, name: str) -> Optional[dict]:
+        """Full object dump + its events (the kubectl-describe surface the
+        upstream web apps render per object)."""
+        from ..controlplane.controller import events_for
+
+        obj = self.store.try_get(_SECTIONS[section], name, namespace)
+        if obj is None:
+            return None
+        events = [
+            {"reason": e.reason, "message": e.message, "type": e.type,
+             "timestamp": e.timestamp}
+            for e in events_for(self.store, _SECTIONS[section], name)
+            if e.metadata.namespace == namespace
+        ]
+        out = {"object": obj.model_dump(mode="json"), "events": events}
+        if section == "experiments" and self.db is not None:
+            out["curves"] = self.curves(namespace, name)
+        return out
+
+    def curves(self, namespace: str, name: str) -> Optional[dict]:
+        """Per-trial objective curves, step-ordered for plotting (the
+        Katib UI experiment-curves view); needs the observation DB.
+        Returns None (HTTP 404) when no DB is attached — the payload
+        schema is trial-name -> points, so an inline error object would
+        masquerade as a trial."""
+        if self.db is None:
+            return None
+        rows = self.db.get_observation_log(name, namespace=namespace)
+        curves: dict[str, list] = {}
+        for r in rows:
+            curves.setdefault(r.get("trial", "?"), []).append({
+                k: r[k] for k in ("step", "value", "phase", "assignments")
+                if k in r
+            })
+        return curves
+
+    def pod_logs(self, namespace: str, name: str) -> str:
+        """Captured stdout/stderr of a pod (the kubectl-logs surface)."""
+        if self.log_path_for is None:
+            return "(no log source attached)"
+        try:
+            with open(self.log_path_for(namespace, name)) as f:
+                return f.read()
+        except OSError as e:
+            return f"(no logs: {e})"
 
     def overview(self) -> dict:
         return {name: len(self.store.list(kind))

@@ -225,3 +225,68 @@ class TestDashboard:
                 assert any(e.get("reason") == "PodGroupCreated" for e in events)
             finally:
                 kubelet.stop()
+
+
+class TestDashboardDetail:
+    def test_object_detail_and_events(self):
+        """Per-object detail route: full dump + its events (the kubectl-
+        describe surface the upstream web apps render)."""
+        c = _cluster()
+        kubelet = FakeKubelet(c.store, lambda pod: PodScript(run_seconds=0.05))
+        with c:
+            kubelet.start()
+            try:
+                url = c.serve_dashboard()
+                c.store.create(make_job(name="detjob", replicas=1))
+                wait_for(
+                    lambda: (j := c.store.try_get(KIND_JAXJOB, "detjob"))
+                    and j.status.conditions, desc="job visible")
+                with urllib.request.urlopen(
+                        f"{url}/api/jaxjobs/default/detjob", timeout=5) as r:
+                    det = json.loads(r.read())
+                assert det["object"]["metadata"]["name"] == "detjob"
+                assert det["object"]["status"]["conditions"]
+                assert any(e["reason"] for e in det["events"])
+                # unknown object -> 404
+                try:
+                    urllib.request.urlopen(
+                        f"{url}/api/jaxjobs/default/nope", timeout=5)
+                    raise AssertionError("expected 404")
+                except urllib.error.HTTPError as e:
+                    assert e.code == 404
+            finally:
+                kubelet.stop()
+
+    def test_experiment_curves_from_db(self, tmp_path):
+        """The Katib-UI main job: per-trial objective curves read from the
+        observation DB through the dashboard."""
+        from kubeflow_tpu.hpo.db import DbManagerClient, DbManagerServer
+        from kubeflow_tpu.ux.dashboard import Dashboard
+
+        c = _cluster()
+        server = DbManagerServer(str(tmp_path / "obs.sqlite")).start()
+        db = DbManagerClient(server.address)
+        with c:
+            try:
+                # per-step observation log + the final (step=-1) value
+                db.report_observation("exp1", "exp1-t1", {"lr": 0.1}, 0.5, step=10)
+                db.report_observation("exp1", "exp1-t1", {"lr": 0.1}, 0.8, step=20)
+                db.report_observation("exp1", "exp1-t1", {"lr": 0.1}, 0.8)
+                db.report_observation("exp1", "exp1-t2", {"lr": 0.01}, 0.3)
+                dash = Dashboard(c.store, db=db)
+                try:
+                    with urllib.request.urlopen(
+                            f"{dash.url}/api/experiments/default/exp1/curves",
+                            timeout=5) as r:
+                        curves = json.loads(r.read())
+                    assert set(curves) == {"exp1-t1", "exp1-t2"}
+                    t1 = [(pt["step"], pt["value"]) for pt in curves["exp1-t1"]]
+                    assert t1 == [(-1, 0.8), (10, 0.5), (20, 0.8)]
+                finally:
+                    dash.stop()
+                # the replay surface still sees ONE final value per trial
+                finals = db.get_observations("exp1")
+                assert sorted((o["trial"], o["value"]) for o in finals) == [
+                    ("exp1-t1", 0.8), ("exp1-t2", 0.3)]
+            finally:
+                server.stop()
