@@ -80,6 +80,12 @@ class LlamaConfig:
     #: wire via ragged_all_to_all (SURVEY §2.5 EP row), zero drops at any
     #: load skew.
     moe_dispatch: str = "dense"
+    #: local expert compute under ragged dispatch: "masked" = per-expert
+    #: masked matmuls over the whole buffer (E_local x the useful FLOPs —
+    #: free only at one expert/device); "grouped" = the Pallas grouped-GEMM
+    #: kernel (ops/grouped_matmul.py, block-sparse over expert row ranges);
+    #: "auto" = grouped on TPU when shapes are MXU-tileable, else masked.
+    moe_ragged_compute: str = "auto"
     #: token-embedding lookup: False = gather from an explicitly
     #: replicated table (default; one ICI all-gather per step); True =
     #: one-hot matmul, no table gather (prefer under heavy vocab/TP
@@ -99,6 +105,9 @@ class LlamaConfig:
             raise ValueError(f"unknown remat_policy {self.remat_policy!r}")
         if self.moe_dispatch not in ("dense", "ragged"):
             raise ValueError(f"unknown moe_dispatch {self.moe_dispatch!r}")
+        if self.moe_ragged_compute not in ("auto", "masked", "grouped"):
+            raise ValueError(
+                f"unknown moe_ragged_compute {self.moe_ragged_compute!r}")
 
 
 # -- presets ----------------------------------------------------------------

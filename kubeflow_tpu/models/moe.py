@@ -100,7 +100,8 @@ class MoeMlp(nn.Module):
 
         if cfg.moe_dispatch == "ragged":
             return _ragged_moe(
-                x, idx, gate_vals, w_gate, w_up, w_down, dtype=cfg.dtype)
+                x, idx, gate_vals, w_gate, w_up, w_down, dtype=cfg.dtype,
+                compute=cfg.moe_ragged_compute)
 
         # -- capacity assignment (sequence-major priority) ----------------
         capacity = max(1, int(cfg.moe_capacity_factor * k * s / e))
@@ -133,7 +134,8 @@ class MoeMlp(nn.Module):
         return nn.with_logical_constraint(out, ("batch", "act_seq", "act_embed"))
 
 
-def _ragged_moe(x, idx, gates, w_gate, w_up, w_down, *, dtype):
+def _ragged_moe(x, idx, gates, w_gate, w_up, w_down, *, dtype,
+                compute: str = "auto"):
     """Dropless MoE dispatch: sort-by-expert + ``ragged_all_to_all``.
 
     Every (token, expert) assignment is honored — no capacity factor, no
@@ -146,10 +148,13 @@ def _ragged_moe(x, idx, gates, w_gate, w_up, w_down, *, dtype):
     2. exchange counts (all_gather of the send-size matrix), then move
        only REAL tokens with ``ragged_all_to_all`` — the dense dispatch
        ships e x capacity slots regardless of load;
-    3. run the local experts over the receive buffer (masked scan per
-       local expert — the grouped-GEMM Pallas kernel is the upgrade path
-       here; with one expert per device, the common EP layout, the mask
-       is just row validity and there is no overhead);
+    3. run the local experts over the receive buffer — either the Pallas
+       grouped-GEMM kernel (ops/grouped_matmul.py: rows re-grouped by
+       local expert, block-sparse matmuls touch each row tile once) or
+       the masked-scan fallback (per-expert masked matmuls over the full
+       buffer: E_local x the useful FLOPs, free only at one expert per
+       device), per ``compute`` ("auto" picks the kernel on TPU with
+       MXU-tileable shapes);
     4. reverse the transport with the offset matrices transposed, unsort,
        and combine with the gate weights at the source.
 
@@ -177,6 +182,22 @@ def _ragged_moe(x, idx, gates, w_gate, w_up, w_down, *, dtype):
     if e % max(d, 1):
         raise ValueError(f"{e} experts not divisible by expert axis {d}")
 
+    m_dim = w_up.shape[-1]
+    e_local_static = e // max(d, 1)
+    if compute == "auto":
+        # measured on v5e (scripts/moe_bench.py, PERF.md): the MegaBlox
+        # grouped GEMM runs at ~20% of plain-matmul efficiency at MoE
+        # shapes, so the masked path (E_local x full-buffer matmuls that
+        # XLA fuses at full MXU rate) wins until the expert count per
+        # device is large; sharded EP keeps e_local small, so auto
+        # defaults to masked and flips only for fat local expert sets
+        use_grouped = (
+            jax.default_backend() == "tpu"
+            and e_local_static > 12
+            and h % 128 == 0 and m_dim % 128 == 0)
+    else:
+        use_grouped = compute == "grouped"
+
     def local_compute(recv, lid, valid, wg, wu, wd):
         """Masked per-expert MLP over the receive buffer.
 
@@ -197,6 +218,40 @@ def _ragged_moe(x, idx, gates, w_gate, w_up, w_down, *, dtype):
             (wg, wu, wd, jnp.arange(wg.shape[0], dtype=jnp.int32)))
         return acc
 
+    def grouped_compute(recv, lid, valid, wg, wu, wd):
+        """Grouped-GEMM expert MLP: re-group rows by local expert, run the
+        block-sparse kernel over contiguous expert ranges, un-group."""
+        from ..ops.grouped_matmul import grouped_matmul
+
+        e_local = wg.shape[0]
+        key = jnp.where(valid, lid, e_local)  # invalid rows sort last
+        order2 = jnp.argsort(key, stable=True)
+        xs2 = recv[order2]
+        counts = jax.ops.segment_sum(
+            jnp.where(valid, 1, 0), jnp.clip(key, 0, e_local),
+            num_segments=e_local + 1)[:e_local]
+        offsets = jnp.concatenate(
+            [jnp.zeros((1,), jnp.int32), jnp.cumsum(counts).astype(jnp.int32)])
+        g = grouped_matmul(xs2, wg.astype(dtype), offsets)
+        u = grouped_matmul(xs2, wu.astype(dtype), offsets)
+        hidden = nn.silu(g) * u
+        y2 = grouped_matmul(hidden, wd.astype(dtype), offsets)
+        return y2[jnp.argsort(order2)]
+
+    expert_mlp = grouped_compute if use_grouped else local_compute
+
+    def _pad_rows(arrs, rows):
+        """Pad leading dim up to an MXU-tileable multiple (extra rows fall
+        outside every group / are invalid, so they produce zeros)."""
+        if rows % 128 == 0 or not use_grouped:
+            return arrs, rows
+        padded = ((rows + 127) // 128) * 128
+        return [
+            jnp.concatenate(
+                [a, jnp.zeros((padded - rows, *a.shape[1:]), a.dtype)])
+            for a in arrs
+        ], padded
+
     def shard_body(x_blk, idx_blk, gates_blk, wg, wu, wd):
         """Runs per expert-shard: x_blk [b/d, s, h], wg [e/d, h, m]."""
         bl = x_blk.shape[0]
@@ -209,9 +264,12 @@ def _ragged_moe(x, idx, gates, w_gate, w_up, w_down, *, dtype):
         xs = xf[order // k].astype(dtype)                  # [n*k, h]
 
         if d == 1:
-            y_buf = local_compute(
-                xs, sorted_expert, jnp.ones((n * k,), bool), wg, wu, wd)
-            y_sorted = y_buf
+            (xs_p, ids_p), rows = _pad_rows(
+                [xs, sorted_expert], n * k)
+            valid_p = jnp.arange(rows) < n * k
+            y_buf = expert_mlp(
+                xs_p, jnp.where(valid_p, ids_p, e_local), valid_p, wg, wu, wd)
+            y_sorted = y_buf[: n * k]
         else:
             me = lax.axis_index("expert")
             dest_dev = sorted_expert // e_local
@@ -229,6 +287,8 @@ def _ragged_moe(x, idx, gates, w_gate, w_up, w_down, *, dtype):
             recv_starts = mc[:, me]                        # [D]
 
             cap = n * k * d  # true worst case: all assignments on one shard
+            if use_grouped:
+                cap = ((cap + 127) // 128) * 128  # MXU-tileable row count
             buf = jnp.zeros((cap, h), dtype)
             recv = collectives.ragged_all_to_all(
                 xs, buf, input_offsets, send_sizes, output_offsets,
@@ -244,7 +304,7 @@ def _ragged_moe(x, idx, gates, w_gate, w_up, w_down, *, dtype):
                 rows[:, None] < (recv_starts + recv_sizes)[None, :],
             ).any(axis=1)
             lid = ids - me * e_local
-            y_buf = local_compute(recv, lid, valid, wg, wu, wd)
+            y_buf = expert_mlp(recv, lid, valid, wg, wu, wd)
 
             # reverse transport: each received chunk returns to its source
             # at the source's original sorted position

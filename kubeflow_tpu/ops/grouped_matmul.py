@@ -1,0 +1,110 @@
+"""Grouped matrix multiply: the dropless-MoE expert GEMM.
+
+``out[i] = x[i] @ w[g(i)]`` where rows of ``x`` are SORTED by group and
+``group_offsets`` [E+1] gives each group's contiguous range — the layout
+the ragged MoE dispatch produces (models/moe.py).  The masked-scan
+fallback there computes every expert's full-buffer matmul (E x the
+useful FLOPs); a grouped GEMM touches each row tile once.
+
+Implementation: delegates to Pallas' MegaBlox ``gmm`` kernel
+(jax.experimental.pallas.ops.tpu.megablox), the production block-sparse
+grouped matmul — it builds tile/group visit tables from the group sizes
+so each LHS row tile is visited once per overlapping group and RHS
+expert blocks stream once per (group, n-tile), and it carries a custom
+VJP (dx via gmm against transposed RHS, dw via the transposed tgmm
+kernel).  A first-principles Pallas kernel lived here briefly; measured
+on v5e it re-streamed the expert weights once per row tile (~GBs per
+matmul) and lost to the masked fallback — the tile-table structure is
+the whole game, so the library kernel is the right engineering call.
+
+This wrapper pins the repo's contract on top:
+
+- offsets [E+1] API (what the dispatch math produces) -> group sizes;
+- rows at or past ``offsets[-1]`` (padding / invalid transport rows)
+  return ZEROS — megablox leaves tiles beyond the last group unwritten;
+- shape-adaptive tiling so tiny CPU-test shapes work, and interpret mode
+  off-TPU (flash-attention convention: the identical kernel is what the
+  CPU suite exercises).
+"""
+
+from __future__ import annotations
+
+import importlib
+import sys
+
+import jax
+import jax.numpy as jnp
+
+importlib.import_module("jax.experimental.pallas.ops.tpu.megablox")
+_mb = sys.modules["jax.experimental.pallas.ops.tpu.megablox.gmm"]
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _block(dim: int, want: int) -> int:
+    b = min(want, dim)
+    while dim % b:
+        b //= 2
+    return max(b, 1)
+
+
+def _gmm(x, w, offsets):
+    """Raw megablox call + the no-group-row contract: the kernel never
+    visits tiles past the last group, so those output rows come back as
+    uninitialized memory — pin them to zeros."""
+    b, h = x.shape
+    m = w.shape[-1]
+    sizes = jnp.diff(offsets).astype(jnp.int32)
+    out = _mb.gmm(
+        x, w, sizes,
+        preferred_element_type=jnp.float32,
+        tiling=(_block(b, 128), _block(h, 128), _block(m, 128)),
+        interpret=_interpret(),
+    )
+    rows = jnp.arange(b, dtype=jnp.int32)
+    return jnp.where(rows[:, None] < offsets[-1], out, 0.0).astype(x.dtype)
+
+
+@jax.custom_vjp
+def grouped_matmul(x: jax.Array, w: jax.Array, offsets: jax.Array) -> jax.Array:
+    """``out[i] = x[i] @ w[e]`` for rows ``offsets[e] <= i < offsets[e+1]``.
+
+    x: [B, h] rows sorted/grouped by expert; w: [E, h, m]; offsets:
+    int32 [E+1] monotone group boundaries (rows >= offsets[-1] belong to
+    no group and produce zeros).  Returns [B, m] in x.dtype.
+
+    Own VJP (instead of megablox's) because the no-group rows need the
+    same zero-pinning on the backward outputs: dx rows past the last
+    group and dw blocks of EMPTY groups are tiles the kernels never
+    visit, i.e. uninitialized memory.
+    """
+    return _gmm(x, w, offsets)
+
+
+def _vjp_fwd(x, w, offsets):
+    return _gmm(x, w, offsets), (x, w, offsets)
+
+
+def _vjp_bwd(res, g):
+    x, w, offsets = res
+    b, h = x.shape
+    m = w.shape[-1]
+    sizes = jnp.diff(offsets).astype(jnp.int32)
+    # dx: the grouped product against transposed weights; zero-pinning for
+    # no-group rows comes with _gmm
+    dx = _gmm(g.astype(x.dtype), jnp.swapaxes(w, 1, 2), offsets)
+    # dw[e] = x_e^T @ g_e (the transposed grouped matmul); empty groups'
+    # blocks are unvisited -> pin to zero
+    dw = _mb.tgmm(
+        x.swapaxes(0, 1), g.astype(x.dtype), sizes,
+        preferred_element_type=jnp.float32,
+        tiling=(_block(h, 128), _block(b, 128), _block(m, 128)),
+        interpret=_interpret(),
+    )
+    dw = jnp.where(sizes[:, None, None] > 0, dw, 0.0).astype(w.dtype)
+    return dx, dw, None
+
+
+grouped_matmul.defvjp(_vjp_fwd, _vjp_bwd)

@@ -1,0 +1,75 @@
+"""Grouped-GEMM Pallas kernel (ops/grouped_matmul.py) — the dropless-MoE
+expert compute.  Interpret mode on CPU exercises the identical kernel
+the TPU runs (flash-attention convention)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubeflow_tpu.ops.grouped_matmul import grouped_matmul
+
+
+def _ref(x, w, sizes):
+    out = np.zeros((x.shape[0], w.shape[-1]), np.float32)
+    s = 0
+    for e, n in enumerate(sizes):
+        out[s:s + n] = np.asarray(x[s:s + n] @ w[e])
+        s += n
+    return out
+
+
+@pytest.mark.parametrize("sizes", [
+    [10, 0, 15],          # empty group + trailing no-group rows
+    [32, 32, 32, 32],     # exact tile alignment (B=128, bm=128)
+    [1, 127],             # boundary mid-tile
+    [0, 0, 64],           # leading empty groups
+])
+def test_forward_matches_reference(sizes):
+    rng = np.random.default_rng(0)
+    b = 128
+    e, h, m = len(sizes), 64, 96
+    x = jnp.asarray(rng.normal(size=(b, h)), jnp.float32)
+    w = jnp.asarray(rng.normal(size=(e, h, m)), jnp.float32)
+    offs = jnp.asarray(np.concatenate([[0], np.cumsum(sizes)]), jnp.int32)
+    out = grouped_matmul(x, w, offs)
+    np.testing.assert_allclose(np.asarray(out), _ref(x, w, sizes), atol=2e-5)
+
+
+def test_grads_match_dense_construction():
+    rng = np.random.default_rng(1)
+    b, e, h, m = 64, 3, 32, 48
+    sizes = [20, 0, 30]
+    x = jnp.asarray(rng.normal(size=(b, h)), jnp.float32)
+    w = jnp.asarray(rng.normal(size=(e, h, m)), jnp.float32)
+    offs = jnp.asarray(np.concatenate([[0], np.cumsum(sizes)]), jnp.int32)
+
+    def loss(x, w):
+        return (grouped_matmul(x, w, offs) ** 2).sum()
+
+    def loss_ref(x, w):
+        parts, s = [], 0
+        for ee, n in enumerate(sizes):
+            parts.append(x[s:s + n] @ w[ee])
+            s += n
+        o = jnp.concatenate(parts + [jnp.zeros((b - s, m))], axis=0)
+        return (o ** 2).sum()
+
+    gx, gw = jax.grad(loss, argnums=(0, 1))(x, w)
+    gx_r, gw_r = jax.grad(loss_ref, argnums=(0, 1))(x, w)
+    np.testing.assert_allclose(np.asarray(gx), np.asarray(gx_r), atol=1e-4)
+    np.testing.assert_allclose(np.asarray(gw), np.asarray(gw_r), atol=1e-4)
+
+
+def test_jit_and_dynamic_offsets():
+    """Offsets are runtime data (routing-dependent): one compiled program
+    serves every load distribution."""
+    rng = np.random.default_rng(2)
+    b, e, h, m = 64, 2, 32, 32
+    x = jnp.asarray(rng.normal(size=(b, h)), jnp.float32)
+    w = jnp.asarray(rng.normal(size=(e, h, m)), jnp.float32)
+    f = jax.jit(grouped_matmul)
+    for sizes in ([40, 24], [0, 64], [64, 0], [10, 10]):
+        offs = jnp.asarray(np.concatenate([[0], np.cumsum(sizes)]), jnp.int32)
+        np.testing.assert_allclose(
+            np.asarray(f(x, w, offs)), _ref(x, w, sizes), atol=2e-5)

@@ -237,3 +237,49 @@ class TestRaggedDispatch:
         m = t.train()
         assert m is not None and m.step == 2
         assert np.isfinite(m.loss)
+
+
+class TestGroupedCompute:
+    """moe_ragged_compute="grouped": the Pallas grouped-GEMM path equals
+    the masked-scan fallback bit-for-bit (same math, fewer FLOPs)."""
+
+    def test_grouped_matches_masked_single_shard(self):
+        x = jax.random.normal(jax.random.PRNGKey(0), (2, 16, 64), jnp.float32)
+        masked = MoeMlp(_cfg(moe_dispatch="ragged", moe_ragged_compute="masked"))
+        grouped = MoeMlp(_cfg(moe_dispatch="ragged", moe_ragged_compute="grouped"))
+        p = nn.meta.unbox(masked.init(jax.random.PRNGKey(1), x)["params"])
+        out_m = masked.apply({"params": p}, x)
+        out_g = grouped.apply({"params": p}, x)
+        np.testing.assert_allclose(
+            np.asarray(out_g), np.asarray(out_m), atol=2e-5)
+
+    def test_grouped_grads_match_masked(self):
+        x = jax.random.normal(jax.random.PRNGKey(0), (2, 8, 64), jnp.float32)
+        masked = MoeMlp(_cfg(moe_dispatch="ragged", moe_ragged_compute="masked"))
+        grouped = MoeMlp(_cfg(moe_dispatch="ragged", moe_ragged_compute="grouped"))
+        p = nn.meta.unbox(masked.init(jax.random.PRNGKey(1), x)["params"])
+
+        def loss(mod):
+            return lambda pp: (mod.apply({"params": pp}, x) ** 2).mean()
+
+        g_m = jax.grad(loss(masked))(p)
+        g_g = jax.grad(loss(grouped))(p)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(b), np.asarray(a), atol=3e-5),
+            g_m, g_g)
+
+    def test_grouped_sharded_matches_single_device(self):
+        """Grouped compute downstream of the real ragged transport on an
+        {expert, data} mesh == single device."""
+        cfg = _cfg(num_layers=2, moe_dispatch="ragged",
+                   moe_ragged_compute="grouped")
+        model = llamalib.Llama(cfg)
+        tokens = jnp.arange(4 * 16, dtype=jnp.int32).reshape(4, 16) % cfg.vocab_size
+        params = model.init(jax.random.PRNGKey(0), tokens)
+        ref = model.apply(params, tokens)
+        mesh = meshlib.build_mesh({"expert": 2, "data": 4})
+        with shardlib.shard_context(mesh):
+            sharded = jax.jit(model.apply)(params, tokens)
+        np.testing.assert_allclose(
+            np.asarray(sharded), np.asarray(ref), atol=3e-2, rtol=3e-2)
