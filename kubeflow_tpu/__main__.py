@@ -1,0 +1,5 @@
+"""``python -m kubeflow_tpu`` — the kft CLI entry point."""
+
+from .cli import main
+
+raise SystemExit(main())

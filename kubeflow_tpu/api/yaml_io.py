@@ -17,9 +17,13 @@ import yaml
 
 from .common import TypedObject
 from .experiment import Experiment, Suggestion, Trial
-from .inference import InferenceService, ServingRuntime
+from .inference import InferenceGraph, InferenceService, ServingRuntime
 from .jaxjob import JaxJob
+from .platform import Notebook, PodDefault, Profile
 
+#: kind -> class; cluster-substrate kinds (Pod/Node/Service/PodGroup/Event)
+#: self-register from controlplane.objects at import time — the api layer
+#: must not import upward into controlplane.
 KIND_REGISTRY: dict[str, Type[TypedObject]] = {
     "JaxJob": JaxJob,
     "Experiment": Experiment,
@@ -27,6 +31,10 @@ KIND_REGISTRY: dict[str, Type[TypedObject]] = {
     "Suggestion": Suggestion,
     "InferenceService": InferenceService,
     "ServingRuntime": ServingRuntime,
+    "InferenceGraph": InferenceGraph,
+    "Profile": Profile,
+    "Notebook": Notebook,
+    "PodDefault": PodDefault,
 }
 
 _CAMEL_RE = re.compile(r"(?<!^)(?=[A-Z])")

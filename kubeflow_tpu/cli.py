@@ -1,0 +1,221 @@
+"""kft — the kubectl-style CLI over the REST API server.
+
+The reference's whole UX runs through kubectl verbs against CRDs (every
+SURVEY §3 call stack starts at ``kubectl apply``); this is that surface
+for the TPU platform, talking HTTP to ``controlplane/apiserver.py``:
+
+    kft --server URL apply -f job.yaml      # create-or-update (multi-doc)
+    kft get jaxjobs [-n ns] [-o yaml|json]
+    kft get isvc my-svc
+    kft describe jaxjob demo                # object + events
+    kft delete trial demo-t0001
+    kft logs demo-worker-0
+    kft api-resources
+
+The server URL comes from ``--server`` or ``$KFT_SERVER`` (a cluster
+started with ``Cluster.serve_api()`` prints it).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+import urllib.error
+import urllib.request
+from typing import Any, Optional
+
+import yaml
+
+
+class CliError(RuntimeError):
+    pass
+
+
+def _request(method: str, url: str, body: Optional[dict] = None) -> Any:
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(
+        url, data=data, method=method,
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            raw = resp.read()
+            ctype = resp.headers.get("Content-Type", "")
+            return raw.decode() if "text/plain" in ctype else json.loads(raw)
+    except urllib.error.HTTPError as e:
+        try:
+            msg = json.loads(e.read()).get("error", str(e))
+        except Exception:  # noqa: BLE001
+            msg = str(e)
+        raise CliError(f"{method} {url}: {msg}") from None
+    except OSError as e:
+        raise CliError(f"cannot reach API server at {url}: {e}") from None
+
+
+def _phase_of(obj: dict) -> str:
+    st = obj.get("status", {}) or {}
+    if st.get("phase"):
+        return str(st["phase"])
+    conds = st.get("conditions") or []
+    return str(conds[-1].get("type", "")) if conds else ""
+
+
+def _age(obj: dict) -> str:
+    ts = (obj.get("metadata", {}) or {}).get("creationTimestamp") or (
+        obj.get("metadata", {}) or {}).get("creation_timestamp")
+    if not ts:
+        return ""
+    try:
+        s = int(time.time() - float(ts))
+    except (TypeError, ValueError):
+        return ""
+    if s < 120:
+        return f"{s}s"
+    if s < 7200:
+        return f"{s // 60}m"
+    return f"{s // 3600}h"
+
+
+def cmd_apply(server: str, args) -> int:
+    with (sys.stdin if args.filename == "-" else open(args.filename)) as f:
+        docs = [d for d in yaml.safe_load_all(f.read()) if d]
+    for doc in docs:
+        kind = doc.get("kind")
+        if not kind:
+            raise CliError("manifest document has no 'kind'")
+        name = (doc.get("metadata") or {}).get("name", "?")
+        ns = (doc.get("metadata") or {}).get("namespace", "default")
+        try:
+            _request("POST", f"{server}/apis/{kind}", doc)
+            print(f"{kind.lower()}/{name} created")
+        except CliError as e:
+            if "exists" not in str(e):
+                raise
+            # create-or-update: refresh spec onto the live object (kubectl
+            # apply semantics, optimistic concurrency handled by re-read)
+            cur = _request("GET", f"{server}/apis/{kind}/{ns}/{name}")
+            cur["spec"] = doc.get("spec", cur.get("spec"))
+            _request("PUT", f"{server}/apis/{kind}/{ns}/{name}", cur)
+            print(f"{kind.lower()}/{name} configured")
+    return 0
+
+
+def cmd_get(server: str, args) -> int:
+    if args.name:
+        obj = _request(
+            "GET", f"{server}/apis/{args.kind}/{args.namespace}/{args.name}")
+        items = [obj]
+    else:
+        url = f"{server}/apis/{args.kind}"
+        if args.namespace != "_all":
+            url += f"?namespace={args.namespace}"
+        items = _request("GET", url)["items"]
+    if args.output == "json":
+        print(json.dumps(items if not args.name else items[0], indent=1))
+        return 0
+    if args.output == "yaml":
+        print(yaml.safe_dump_all(items, sort_keys=False), end="")
+        return 0
+    rows = [("NAMESPACE", "NAME", "PHASE", "AGE")]
+    for o in items:
+        md = o.get("metadata", {}) or {}
+        rows.append((md.get("namespace", ""), md.get("name", ""),
+                     _phase_of(o), _age(o)))
+    widths = [max(len(r[i]) for r in rows) for i in range(4)]
+    for r in rows:
+        print("  ".join(c.ljust(w) for c, w in zip(r, widths)).rstrip())
+    return 0
+
+
+def cmd_describe(server: str, args) -> int:
+    obj = _request(
+        "GET", f"{server}/apis/{args.kind}/{args.namespace}/{args.name}")
+    print(yaml.safe_dump(obj, sort_keys=False), end="")
+    events = _request(
+        "GET",
+        f"{server}/apis/{args.kind}/{args.namespace}/{args.name}/events",
+    )["items"]
+    print("Events:")
+    if not events:
+        print("  <none>")
+    for e in events:
+        print(f"  {e.get('type', '')}\t{e.get('reason', '')}\t"
+              f"{e.get('message', '')}")
+    return 0
+
+
+def cmd_delete(server: str, args) -> int:
+    _request(
+        "DELETE", f"{server}/apis/{args.kind}/{args.namespace}/{args.name}")
+    print(f"{args.kind.lower()}/{args.name} deleted")
+    return 0
+
+
+def cmd_logs(server: str, args) -> int:
+    out = _request(
+        "GET", f"{server}/apis/Pod/{args.namespace}/{args.name}/logs")
+    print(out, end="" if str(out).endswith("\n") else "\n")
+    return 0
+
+
+def cmd_api_resources(server: str, args) -> int:
+    for kind in _request("GET", f"{server}/apis")["kinds"]:
+        print(kind)
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="kft", description="kubectl-style CLI for the TPU platform")
+    p.add_argument("--server", default=os.environ.get("KFT_SERVER"),
+                   help="API server URL (or $KFT_SERVER)")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    sp = sub.add_parser("apply", help="create or update from a manifest")
+    sp.add_argument("-f", "--filename", required=True)
+    sp.set_defaults(fn=cmd_apply)
+
+    for verb, fn in (("get", cmd_get),):
+        sp = sub.add_parser(verb)
+        sp.add_argument("kind")
+        sp.add_argument("name", nargs="?")
+        sp.add_argument("-n", "--namespace", default="default")
+        sp.add_argument("-A", "--all-namespaces", dest="namespace",
+                        action="store_const", const="_all")
+        sp.add_argument("-o", "--output", choices=("table", "yaml", "json"),
+                        default="table")
+        sp.set_defaults(fn=fn)
+
+    for verb, fn in (("describe", cmd_describe), ("delete", cmd_delete)):
+        sp = sub.add_parser(verb)
+        sp.add_argument("kind")
+        sp.add_argument("name")
+        sp.add_argument("-n", "--namespace", default="default")
+        sp.set_defaults(fn=fn)
+
+    sp = sub.add_parser("logs", help="pod stdout/stderr")
+    sp.add_argument("name")
+    sp.add_argument("-n", "--namespace", default="default")
+    sp.set_defaults(fn=cmd_logs)
+
+    sp = sub.add_parser("api-resources", help="list served kinds")
+    sp.set_defaults(fn=cmd_api_resources)
+    return p
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    if not args.server:
+        print("kft: no API server (--server or $KFT_SERVER)", file=sys.stderr)
+        return 2
+    try:
+        return args.fn(args.server.rstrip("/"), args)
+    except CliError as e:
+        print(f"kft: {e}", file=sys.stderr)
+        return 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,214 @@
+"""REST API server over the object store — the kube-apiserver analog.
+
+SURVEY §1 L0 names the cluster substrate's public interface "the k8s REST
+API"; in-process callers use the Store directly, and this server gives the
+same objects an HTTP surface so out-of-process clients (the kft CLI,
+curl, CI scripts) get the kubectl-equivalent UX [upstream: the reference's
+CRDs are served by kube-apiserver; every kubectl verb in SURVEY §3's call
+stacks starts here].
+
+Routes (JSON bodies; YAML accepted on writes):
+
+    GET    /healthz
+    GET    /apis                          -> served kinds
+    GET    /apis/<kind>?namespace=ns      -> list (all namespaces if omitted)
+    POST   /apis/<kind>                   -> create (manifest body)
+    GET    /apis/<kind>/<ns>/<name>       -> object
+    PUT    /apis/<kind>/<ns>/<name>       -> update (optimistic concurrency:
+                                             resource_version must match)
+    DELETE /apis/<kind>/<ns>/<name>
+    GET    /apis/<kind>/<ns>/<name>/events -> events for the object
+    GET    /apis/Pod/<ns>/<name>/logs      -> pod stdout (when a log source
+                                              is attached)
+
+Error mapping follows the apiserver conventions: 404 NotFound, 409
+AlreadyExists/Conflict, 422 admission-rejected.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Optional
+from urllib.parse import parse_qs, urlparse
+
+import yaml
+
+from ..api.yaml_io import KIND_REGISTRY, from_dict, to_dict
+from ..utils.net import allocate_port
+from .controller import events_for
+from .store import AlreadyExists, Conflict, NotFound, Rejected, Store
+
+#: case-insensitive kind aliases (kubectl-style shortnames + plurals)
+KIND_ALIASES = {
+    "jaxjobs": "JaxJob", "jj": "JaxJob",
+    "pods": "Pod", "po": "Pod",
+    "nodes": "Node", "no": "Node",
+    "services": "Service", "svc": "Service",
+    "podgroups": "PodGroup", "pg": "PodGroup",
+    "events": "Event", "ev": "Event",
+    "experiments": "Experiment", "exp": "Experiment",
+    "suggestions": "Suggestion",
+    "trials": "Trial",
+    "inferenceservices": "InferenceService", "isvc": "InferenceService",
+    "servingruntimes": "ServingRuntime",
+    "inferencegraphs": "InferenceGraph", "ig": "InferenceGraph",
+    "notebooks": "Notebook", "nb": "Notebook",
+    "profiles": "Profile",
+    "poddefaults": "PodDefault",
+}
+
+
+def resolve_kind(token: str) -> str:
+    """kubectl-ish kind resolution: exact, alias, lowercase, or
+    lowercase-plural."""
+    if token in KIND_REGISTRY:
+        return token
+    low = token.lower()
+    if low in KIND_ALIASES:
+        return KIND_ALIASES[low]
+    for kind in KIND_REGISTRY:
+        if low in (kind.lower(), kind.lower() + "s"):
+            return kind
+    raise KeyError(token)
+
+
+class ApiServer:
+    """HTTP facade over a Store (one per cluster)."""
+
+    def __init__(self, store: Store, port: Optional[int] = None,
+                 log_path_for: Optional[Callable[[str, str], str]] = None):
+        self.store = store
+        self.log_path_for = log_path_for
+        self.port = port or allocate_port()
+        api = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                pass
+
+            def _send(self, code: int, payload, raw: Optional[bytes] = None,
+                      ctype: str = "application/json") -> None:
+                body = raw if raw is not None else json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _body(self) -> dict:
+                n = int(self.headers.get("Content-Length", "0"))
+                raw = self.rfile.read(n) if n else b"{}"
+                text = raw.decode()
+                if self.headers.get("Content-Type", "").startswith(
+                        "application/yaml") or not text.lstrip().startswith("{"):
+                    return yaml.safe_load(text) or {}
+                return json.loads(text)
+
+            def do_GET(self):
+                api._handle(self, "GET")
+
+            def do_POST(self):
+                api._handle(self, "POST")
+
+            def do_PUT(self):
+                api._handle(self, "PUT")
+
+            def do_DELETE(self):
+                api._handle(self, "DELETE")
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", self.port), Handler)
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name=f"apiserver-{self.port}",
+            daemon=True)
+        self._thread.start()
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=2)
+
+    # -- request handling --------------------------------------------------
+
+    def _handle(self, h, method: str) -> None:
+        try:
+            self._route(h, method)
+        except NotFound as e:
+            h._send(404, {"error": str(e)})
+        except (AlreadyExists, Conflict) as e:
+            h._send(409, {"error": str(e)})
+        except Rejected as e:
+            h._send(422, {"error": str(e)})
+        except KeyError as e:
+            h._send(404, {"error": f"unknown kind {e}"})
+        except Exception as e:  # noqa: BLE001 — surface as 400
+            h._send(400, {"error": f"{type(e).__name__}: {e}"})
+
+    def _route(self, h, method: str) -> None:
+        u = urlparse(h.path)
+        parts = [p for p in u.path.split("/") if p]
+        q = parse_qs(u.query)
+        if u.path == "/healthz":
+            h._send(200, {"ok": True})
+            return
+        if not parts or parts[0] != "apis":
+            h._send(404, {"error": f"unknown path {u.path}"})
+            return
+        if len(parts) == 1:
+            h._send(200, {"kinds": sorted(KIND_REGISTRY)})
+            return
+        kind = resolve_kind(parts[1])
+        if len(parts) == 2:
+            if method == "POST":
+                manifest = h._body()
+                manifest.setdefault("kind", kind)
+                created = self.store.create(from_dict(manifest))
+                h._send(201, to_dict(created))
+                return
+            ns = q.get("namespace", [None])[0]
+            objs = self.store.list(kind, ns)
+            h._send(200, {"items": [to_dict(o) for o in objs]})
+            return
+        if len(parts) == 3:
+            # /apis/<kind>/<ns> — namespace-scoped list (also the natural
+            # exploratory URL; must not 400 on a missing name segment)
+            objs = self.store.list(kind, parts[2])
+            h._send(200, {"items": [to_dict(o) for o in objs]})
+            return
+        ns, name = parts[2], parts[3]
+        if len(parts) == 5 and parts[4] == "events":
+            h._send(200, {"items": [to_dict(e) for e in events_for(
+                self.store, kind, name) if e.metadata.namespace == ns]})
+            return
+        if len(parts) == 5 and parts[4] == "logs" and kind == "Pod":
+            if self.log_path_for is None:
+                h._send(404, {"error": "no log source attached"})
+                return
+            try:
+                with open(self.log_path_for(ns, name)) as f:
+                    h._send(200, None, raw=f.read().encode(),
+                            ctype="text/plain")
+            except OSError as e:
+                h._send(404, {"error": f"no logs: {e}"})
+            return
+        if method == "GET":
+            h._send(200, to_dict(self.store.get(kind, name, ns)))
+            return
+        if method == "PUT":
+            manifest = h._body()
+            manifest.setdefault("kind", kind)
+            obj = from_dict(manifest)
+            obj.metadata.name, obj.metadata.namespace = name, ns
+            h._send(200, to_dict(self.store.update(obj)))
+            return
+        if method == "DELETE":
+            self.store.delete(kind, name, ns)
+            h._send(200, {"deleted": f"{kind}/{ns}/{name}"})
+            return
+        h._send(405, {"error": f"{method} not supported on {u.path}"})

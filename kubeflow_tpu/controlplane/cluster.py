@@ -101,6 +101,17 @@ class Cluster:
         self.add_controller(ProfileController(self.store))
         self.add_controller(NotebookController(self.store))
 
+    def serve_api(self, port: int = 0) -> str:
+        """Start the REST API server (kube-apiserver analog) over this
+        cluster's store; returns its URL for the kft CLI ($KFT_SERVER).
+        Stopped with the cluster."""
+        from .apiserver import ApiServer
+
+        self._apiserver = ApiServer(
+            self.store, port=port or None,
+            log_path_for=getattr(self, "_log_path_for", None))
+        return self._apiserver.url
+
     def serve_dashboard(self, port: int = 0) -> str:
         """Start the central dashboard over this cluster's store; returns
         its URL.  Stopped with the cluster.  When HPO is enabled the
@@ -137,11 +148,14 @@ class Cluster:
         )
         from ..hpo.db import DbManagerClient, DbManagerServer
 
-        self._log_path_for = log_path_for  # also feeds the dashboard's log view
+        self._log_path_for = log_path_for  # also feeds dashboard + apiserver
         dashboard = getattr(self, "_dashboard", None)
         if dashboard is not None:
             # dashboard started before HPO: hand it the log source now
             dashboard.log_path_for = log_path_for
+        apiserver = getattr(self, "_apiserver", None)
+        if apiserver is not None:
+            apiserver.log_path_for = log_path_for  # kft logs
         if db_path is None and metrics_root is not None:
             db_path = os.path.join(metrics_root, "observations.sqlite")
         db_client = None
@@ -258,6 +272,9 @@ class Cluster:
         if getattr(self, "_dashboard", None) is not None:
             self._dashboard.stop()
             self._dashboard = None
+        if getattr(self, "_apiserver", None) is not None:
+            self._apiserver.stop()
+            self._apiserver = None
         if getattr(self, "_db_client", None) is not None:
             self._db_client.close()
             self._db_client = None

@@ -139,3 +139,16 @@ class Event(TypedObject):
 def pod_resources(pod: Pod) -> dict[str, float]:
     r = pod.spec.container.resources
     return {"cpu": r.cpu, "memory_gb": r.memory_gb, "tpu": float(r.tpu)}
+
+
+# Make the cluster-substrate kinds YAML/REST-addressable (the api layer's
+# KIND_REGISTRY must not import upward, so registration happens here).
+from ..api.yaml_io import KIND_REGISTRY as _KIND_REGISTRY  # noqa: E402
+
+_KIND_REGISTRY.update({
+    KIND_POD: Pod,
+    KIND_SERVICE: Service,
+    KIND_PODGROUP: PodGroup,
+    KIND_NODE: Node,
+    KIND_EVENT: Event,
+})

@@ -512,7 +512,11 @@ class TrialController(Controller):
         assert isinstance(job, JaxJob)
 
         if has_condition(job.status.conditions, JobConditionType.SUCCEEDED):
-            metrics = self._scrape(namespace, job)
+            # one pass over the metric streams: final values AND the
+            # objective's per-step series (re-reading the jsonl for the
+            # series would double the reconcile-thread IO on long runs)
+            metrics, series = self._scrape_with_series(
+                namespace, job, trial.spec.objective_metric_name)
             objective = metrics.get(trial.spec.objective_metric_name)
             if objective is None:
                 # grace period for scrape latency; then fail loudly rather
@@ -542,8 +546,6 @@ class TrialController(Controller):
                     # per-step series of the objective behind the
                     # experiment-curves view (Katib's observation log) —
                     # ONE batched RPC, not one per step
-                    series = self._scrape_series(
-                        namespace, job, trial.spec.objective_metric_name)
                     if series:
                         self.db.report_observation_series(
                             experiment=trial.spec.experiment_name,
@@ -657,7 +659,7 @@ class TrialController(Controller):
                 if self.metrics_root:
                     path = os.path.join(
                         self.metrics_root, "status", namespace, pod, "metrics.jsonl")
-                    vals, stps = self._read_jsonl(path)
+                    vals, stps, _ = self._read_jsonl(path)
                     metrics.update(vals)
                     steps.update(stps)
                 if self.log_path_for:
@@ -665,37 +667,38 @@ class TrialController(Controller):
                         self._read_stdout(self.log_path_for(namespace, pod)))
         return metrics, steps
 
-    def _scrape_series(
+    def _scrape_with_series(
         self, namespace: str, job: JaxJob, metric_name: str
-    ) -> list[tuple[int, float]]:
-        """Full (step, value) series of one metric across worker jsonl
-        streams — the per-step observation log (last value wins per step)."""
+    ) -> tuple[dict[str, float], list[tuple[int, float]]]:
+        """One pass over every worker's metric streams: final metric values
+        plus ``metric_name``'s full (step, value) series (the per-step
+        observation log; last value wins per step)."""
+        metrics: dict[str, float] = {}
         series: dict[int, float] = {}
-        if not self.metrics_root:
-            return []
         for rtype, rspec in job.spec.replica_specs.items():
             for idx in range(rspec.replicas):
                 pod = replica_pod_name(job.metadata.name, rtype, idx)
-                path = os.path.join(
-                    self.metrics_root, "status", namespace, pod, "metrics.jsonl")
-                try:
-                    with open(path) as f:
-                        for line in f:
-                            try:
-                                rec = json.loads(line)
-                                if (str(rec["name"]) == metric_name
-                                        and "step" in rec):
-                                    series[int(rec["step"])] = float(rec["value"])
-                            except (ValueError, KeyError):
-                                continue
-                except OSError:
-                    continue
-        return sorted(series.items())
+                if self.metrics_root:
+                    path = os.path.join(
+                        self.metrics_root, "status", namespace, pod,
+                        "metrics.jsonl")
+                    vals, _, s = self._read_jsonl(path, series_for=metric_name)
+                    metrics.update(vals)
+                    series.update(s)
+                if self.log_path_for:
+                    metrics.update(
+                        self._read_stdout(self.log_path_for(namespace, pod)))
+        return metrics, sorted(series.items())
 
     @staticmethod
-    def _read_jsonl(path: str) -> tuple[dict[str, float], dict[str, int]]:
+    def _read_jsonl(
+        path: str, series_for: Optional[str] = None
+    ) -> tuple[dict[str, float], dict[str, int], dict[int, float]]:
+        """One pass over a metrics stream: (last values, last steps, and —
+        when ``series_for`` names a metric — its full per-step series)."""
         values: dict[str, float] = {}
         steps: dict[str, int] = {}
+        series: dict[int, float] = {}
         try:
             with open(path) as f:
                 for line in f:
@@ -705,11 +708,13 @@ class TrialController(Controller):
                         values[name] = float(rec["value"])
                         if "step" in rec:
                             steps[name] = int(rec["step"])
+                            if name == series_for:
+                                series[int(rec["step"])] = float(rec["value"])
                     except (ValueError, KeyError):
                         continue
         except OSError:
             pass
-        return values, steps
+        return values, steps, series
 
     @staticmethod
     def _read_stdout(path: str) -> dict[str, float]:

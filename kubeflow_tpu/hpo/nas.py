@@ -216,6 +216,12 @@ def register_task(key: str, factory: Callable[[], tuple]) -> str:
     return key
 
 
+#: supernet runs keyed by (task, steps, seed) — MODULE level, because the
+#: suggestion service constructs a fresh suggester per RPC; a per-instance
+#: cache would retrain the supernet on every GetSuggestions call
+_RANKING_CACHE: dict[str, list[tuple[int, int]]] = {}
+
+
 class OneShotNas:
     """Katib-style suggester façade over ``darts_search``.
 
@@ -229,9 +235,6 @@ class OneShotNas:
 
     name = "darts"
 
-    def __init__(self) -> None:
-        self._cache: dict[str, list[tuple[int, int]]] = {}
-
     def suggest(self, req) -> list[dict[str, object]]:
         settings = req.settings
         key = settings.get("task_ref", "")
@@ -240,17 +243,24 @@ class OneShotNas:
                 f"darts suggester needs settings.task_ref naming a "
                 f"registered nas task; got {key!r}")
         fp = f"{key}:{settings.get('supernet_steps', '')}:{req.seed}"
-        if fp not in self._cache:
+        if fp not in _RANKING_CACHE:
             base_cfg, space, batches = _TASKS[key]()
             result = darts_search(
                 base_cfg, space, batches,
                 steps=int(settings.get("supernet_steps", 200)),
                 seed=req.seed or 0,
             )
-            self._cache[fp] = result.ranked
-        ranked = self._cache[fp]
+            _RANKING_CACHE[fp] = result.ranked
+        ranked = _RANKING_CACHE[fp]
         out = []
+        # finite space: stop at the end instead of cycling — returning
+        # fewer than requested is the suggester-exhausted contract
+        # (GridSearch does the same), so the experiment doesn't burn its
+        # budget re-evaluating duplicate architectures
         for i in range(req.count):
-            layers, width = ranked[(req.issued + i) % len(ranked)]
+            pos = req.issued + i
+            if pos >= len(ranked):
+                break
+            layers, width = ranked[pos]
             out.append({"layers": layers, "ffn_width": width})
         return out
