@@ -107,7 +107,12 @@ def plan_mesh(
     dcn: dict[str, int] = {}
     if num_slices > 1:
         remaining = num_slices
-        for a in list(axes):
+        # factor slices onto DCN-tolerant axes FIRST: {pipeline: 2, fsdp: 16}
+        # on 2 slices must put pipeline (not fsdp) over DCN even though fsdp
+        # precedes it in canonical mesh order
+        order = [a for a in axes if a in DCN_TOLERANT_AXES] + [
+            a for a in axes if a not in DCN_TOLERANT_AXES]
+        for a in order:
             if remaining == 1:
                 break
             s = axes[a]

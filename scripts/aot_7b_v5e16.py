@@ -47,13 +47,17 @@ V5E_PEAK_FLOPS = 197e12               # bf16
 
 
 def compile_candidate(devs, mesh_axes, *, global_batch, seq_len, accum_steps,
-                      model_cfg):
+                      model_cfg, num_slices=1, num_microbatches=None,
+                      pipeline_schedule="gpipe"):
     cfg = trainlib.TrainConfig(
         model=model_cfg,
         mesh_axes=mesh_axes,
         global_batch=global_batch,
         seq_len=seq_len,
         accum_steps=accum_steps,
+        num_slices=num_slices,
+        num_microbatches=num_microbatches,
+        pipeline_schedule=pipeline_schedule,
     )
     t = trainlib.Trainer(cfg, devices=devs)
     state = t.abstract_state()
@@ -88,6 +92,9 @@ def compile_candidate(devs, mesh_axes, *, global_batch, seq_len, accum_steps,
             tokens_per_step / (n_chips * step_s), 1)
     return {
         "mesh_axes": mesh_axes,
+        "num_slices": num_slices,
+        "num_microbatches": num_microbatches,
+        "pipeline_schedule": pipeline_schedule if "pipeline" in mesh_axes else None,
         "global_batch": global_batch,
         "seq_len": seq_len,
         "accum_steps": accum_steps,
@@ -109,6 +116,8 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--fast", action="store_true",
                     help="compile only the primary candidate")
+    ap.add_argument("--multislice-only", action="store_true",
+                    help="compile only the v5e-32 two-slice candidates")
     ap.add_argument("--topology", default="v5e:4x4")
     args = ap.parse_args()
 
@@ -135,6 +144,8 @@ def main():
     ]
     if args.fast:
         candidates = candidates[:1]
+    if args.multislice_only:
+        candidates = []
 
     results = []
     for cand in candidates:
@@ -146,15 +157,44 @@ def main():
         results.append(r)
         print(json.dumps(r), file=sys.stderr)
 
+    if not args.fast or args.multislice_only:
+        # scale-out leg: TWO v5e-16 slices (32 chips) with the pipeline
+        # axis over DCN — the SURVEY §7 "PP over DCN" configuration, AOT-
+        # compiled with real stage shardings.  Activations cross the slice
+        # boundary once per microbatch per stage; fsdp stays intra-slice.
+        topo32 = topologies.get_topology_desc("v5e:4x8", platform="tpu")
+        devs32 = list(topo32.devices)
+        for cand in (
+            dict(mesh_axes={"fsdp": 32}, global_batch=32, seq_len=4096,
+                 accum_steps=1),
+            # GPipe at 7B/seq-4096 OOMs (all-M microbatch activation
+            # buffers, measured 19.3 GB); 1F1B's ~P-bounded stash is the
+            # schedule that fits — exactly what it exists for
+            dict(mesh_axes={"pipeline": 2, "fsdp": 16}, global_batch=32,
+                 seq_len=4096, accum_steps=1, num_slices=2,
+                 num_microbatches=8, pipeline_schedule="1f1b"),
+        ):
+            print(f"compiling v5e-32 {cand} ...", file=sys.stderr)
+            try:
+                r = compile_candidate(devs32, model_cfg=model_cfg, **cand)
+            except Exception as e:
+                r = {**cand, "error": f"{type(e).__name__}: {e}"}
+            r["topology"] = "v5e:4x8 (2 slices over DCN)"
+            results.append(r)
+            print(json.dumps(r), file=sys.stderr)
+
     out = {
-        "topology": args.topology,
-        "n_chips": len(devs),
+        "topology": ("v5e:4x8 (2 slices)" if args.multislice_only
+                     else args.topology),
+        "n_chips": 32 if args.multislice_only else len(devs),
         "model": "llama2_7b",
         "n_params": n_params,
         "results": results,
     }
+    name = ("aot_7b_v5e32.json" if args.multislice_only
+            else "aot_7b_v5e16.json")
     path = os.path.join(os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))), "artifacts", "aot_7b_v5e16.json")
+        os.path.abspath(__file__))), "artifacts", name)
     os.makedirs(os.path.dirname(path), exist_ok=True)
     with open(path, "w") as f:
         json.dump(out, f, indent=1)
