@@ -29,6 +29,8 @@ from __future__ import annotations
 
 import json
 import threading
+import time
+from collections import deque
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Optional
 from urllib.parse import parse_qs, urlparse
@@ -118,6 +120,18 @@ class ApiServer:
             def do_DELETE(self):
                 api._handle(self, "DELETE")
 
+        # one persistent store watch feeds a bounded event buffer; watch
+        # long-polls resume from a resource_version against this buffer,
+        # so events BETWEEN polls are not lost (a per-request store watch
+        # would drop anything that happened while no poll was in flight)
+        self._events: "deque[tuple[int, object]]" = deque(maxlen=2048)
+        self._events_cond = threading.Condition()
+        self._event_seq = 0
+        self._store_watch = store.watch(list(KIND_REGISTRY))
+        self._pump = threading.Thread(
+            target=self._pump_events, name="apiserver-watch-pump", daemon=True)
+        self._pump.start()
+
         self._httpd = ThreadingHTTPServer(("127.0.0.1", self.port), Handler)
         self._httpd.daemon_threads = True
         self._thread = threading.Thread(
@@ -130,9 +144,13 @@ class ApiServer:
         return f"http://127.0.0.1:{self.port}"
 
     def stop(self) -> None:
+        self.store.stop_watch(self._store_watch)
         self._httpd.shutdown()
         self._httpd.server_close()
         self._thread.join(timeout=2)
+        with self._events_cond:  # release any parked long-polls
+            self._events_cond.notify_all()
+        self._pump.join(timeout=2)
 
     # -- request handling --------------------------------------------------
 
@@ -149,6 +167,59 @@ class ApiServer:
             h._send(404, {"error": f"unknown kind {e}"})
         except Exception as e:  # noqa: BLE001 — surface as 400
             h._send(400, {"error": f"{type(e).__name__}: {e}"})
+
+    def _pump_events(self) -> None:
+        import queue as queuelib
+
+        while True:
+            try:
+                ev = self._store_watch.q.get(timeout=0.5)
+            except queuelib.Empty:
+                if getattr(self._store_watch, "closed", False):
+                    return
+                continue
+            with self._events_cond:
+                self._event_seq += 1
+                self._events.append((self._event_seq, ev))
+                self._events_cond.notify_all()
+
+    def _watch(self, h, kind: str, ns: Optional[str], timeout: float,
+               after: int) -> None:
+        """Long-poll against the buffered event stream.
+
+        ``after`` is the cursor (the ``seq`` of the last event the client
+        saw; 0 = only future events).  Each response carries ``seq`` per
+        item and ``cursor`` to pass back — re-polling with the cursor
+        recovers everything that happened between polls (up to the
+        buffer's retention)."""
+        deadline = time.monotonic() + min(max(timeout, 0.0), 300.0)
+        if after == 0:
+            with self._events_cond:
+                after = self._event_seq  # "now": only future events
+
+        def collect():
+            return [
+                (seq, ev) for seq, ev in self._events
+                if seq > after and ev.obj.kind == kind
+                and (ns is None or ev.obj.metadata.namespace == ns)
+            ]
+
+        with self._events_cond:
+            matched = collect()
+            while not matched:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._events_cond.wait(timeout=remaining)
+                matched = collect()
+            cursor = matched[-1][0] if matched else after
+        h._send(200, {
+            "cursor": cursor,
+            "items": [
+                {"type": ev.type, "seq": seq, "object": to_dict(ev.obj)}
+                for seq, ev in matched
+            ],
+        })
 
     def _route(self, h, method: str) -> None:
         u = urlparse(h.path)
@@ -172,6 +243,15 @@ class ApiServer:
                 h._send(201, to_dict(created))
                 return
             ns = q.get("namespace", [None])[0]
+            if (method == "GET"
+                    and q.get("watch", ["false"])[0] in ("true", "1")):
+                # kubectl -w analog: long-poll the buffered event stream;
+                # pass back the returned ``cursor`` to resume without
+                # losing events that land between polls
+                self._watch(h, kind, ns,
+                            float(q.get("timeout", ["30"])[0]),
+                            int(q.get("cursor", ["0"])[0]))
+                return
             objs = self.store.list(kind, ns)
             h._send(200, {"items": [to_dict(o) for o in objs]})
             return

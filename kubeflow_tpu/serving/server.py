@@ -162,6 +162,13 @@ class ModelServer:
         self.port = port or allocate_port()
         self._models: dict[str, Model] = {}
         self._batchers: dict[str, MicroBatcher] = {}
+        #: name -> (class, config, batch_max, batch_timeout): rebuild specs
+        #: for the V2 repository API's unload/load cycle
+        self._specs: dict[str, tuple] = {}
+        #: serializes repository mutations — load/unload arrive on
+        #: concurrent HTTP threads; racing registers would leak batcher
+        #: threads and model instances
+        self._repo_lock = threading.Lock()
         self.metrics = ServerMetrics()
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
@@ -192,6 +199,10 @@ class ModelServer:
     ) -> None:
         model.start()
         self._models[model.name] = model
+        # remember how to rebuild it: the V2 repository API's unload/load
+        # cycle re-instantiates from this spec
+        self._specs[model.name] = (
+            type(model), dict(model.config), batch_max_size, batch_timeout_ms)
         # self-batching models (continuous.py) coalesce requests inside
         # their own decode loop; routing them through the micro-batcher
         # would serialize requests and defeat token-boundary admission
@@ -206,6 +217,46 @@ class ModelServer:
         m = self._models.pop(name, None)
         if m:
             m.stop()
+        self._specs.pop(name, None)
+
+    # -- V2 repository API (dynamic load/unload) --------------------------
+
+    def unload_model(self, name: str) -> bool:
+        """Unload but KEEP the spec so a later load can rebuild (the V2
+        repository contract: unloaded models stay indexed, not-ready).
+        Idempotent: unloading an already-unloaded (but known) model
+        succeeds — retry-safe automation depends on it."""
+        with self._repo_lock:
+            if name not in self._models:
+                return name in self._specs  # known-but-unloaded: no-op ok
+            spec = self._specs.get(name)
+            self.unregister(name)
+            if spec is not None:
+                self._specs[name] = spec
+            return True
+
+    def load_model(self, name: str) -> bool:
+        with self._repo_lock:
+            if name in self._models:
+                return True  # already live
+            spec = self._specs.get(name)
+            if spec is None:
+                return False
+            cls, cfg, bmax, btimeout = spec
+            self.register(cls(name, cfg), batch_max_size=bmax,
+                          batch_timeout_ms=btimeout)
+            return True
+
+    def repository_index(self) -> list[dict]:
+        out = []
+        for name, spec in self._specs.items():
+            live = self._models.get(name)
+            out.append({
+                "name": name,
+                "state": "READY" if live is not None and live.ready else "UNAVAILABLE",
+                "reason": "" if live is not None else "unloaded",
+            })
+        return out
 
     def models(self) -> dict[str, Model]:
         return dict(self._models)
@@ -310,6 +361,23 @@ class ModelServer:
             name = path[len("/v2/models/"):-len("/infer")]
             self._predict_v2(h, name, payload)
             return
+        # V2 repository API: dynamic load/unload + index
+        if path == "/v2/repository/index":
+            h._send(200, self.repository_index())
+            return
+        if path.startswith("/v2/repository/models/"):
+            rest = path[len("/v2/repository/models/"):]
+            name, _, verb = rest.rpartition("/")
+            if verb in ("load", "unload") and name:
+                try:
+                    ok = (self.load_model(name) if verb == "load"
+                          else self.unload_model(name))
+                except Exception as e:  # noqa: BLE001 — load() may raise
+                    h._send(500, {"error": f"{type(e).__name__}: {e}"})
+                    return
+                h._send(200 if ok else 404,
+                        {"ok": ok} if ok else {"error": f"model {name} unknown"})
+                return
         h._send(404, {"error": f"unknown path {path}"})
 
     def _dispatch(self, name: str, instances: list) -> list:

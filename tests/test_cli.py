@@ -128,3 +128,58 @@ class TestKftCli:
         monkeypatch.delenv("KFT_SERVER", raising=False)
         assert cli.main(["get", "jaxjobs"]) == 2
         assert "no API server" in capsys.readouterr().err
+
+
+class TestWatch:
+    def test_watch_long_poll_sees_create(self, api_cluster):
+        """kubectl -w analog: a watcher blocked on ?watch=true receives the
+        ADDED event when an object lands."""
+        import threading
+
+        _, url = api_cluster
+        got = {}
+
+        def watcher():
+            got["events"] = _get(
+                f"{url}/apis/Profile?watch=true&timeout=10")["items"]
+
+        t = threading.Thread(target=watcher)
+        t.start()
+        import time
+        time.sleep(0.3)  # watcher in the long poll before the create
+        body = {"kind": "Profile", "metadata": {"name": "watched"},
+                "spec": {"owner": "w@corp"}}
+        req = urllib.request.Request(
+            f"{url}/apis/Profile", data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"})
+        urllib.request.urlopen(req, timeout=10)
+        t.join(timeout=15)
+        assert not t.is_alive()
+        evs = got["events"]
+        assert any(e["type"] == "ADDED"
+                   and e["object"]["metadata"]["name"] == "watched"
+                   for e in evs), evs
+
+    def test_watch_timeout_returns_empty(self, api_cluster):
+        _, url = api_cluster
+        out = _get(f"{url}/apis/Notebook?watch=true&timeout=0.3")
+        assert out["items"] == []
+
+    def test_watch_cursor_resumes_between_polls(self, api_cluster):
+        """Events landing BETWEEN polls are recovered by re-polling with
+        the returned cursor (the resourceVersion-resume analog)."""
+        _, url = api_cluster
+        first = _get(f"{url}/apis/Profile?watch=true&timeout=0.2")
+        cursor = first["cursor"]
+        # object lands while NO poll is in flight
+        body = {"kind": "Profile", "metadata": {"name": "between"},
+                "spec": {"owner": "b@corp"}}
+        req = urllib.request.Request(
+            f"{url}/apis/Profile", data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"})
+        urllib.request.urlopen(req, timeout=10)
+        out = _get(
+            f"{url}/apis/Profile?watch=true&timeout=5&cursor={cursor}")
+        assert any(e["type"] == "ADDED"
+                   and e["object"]["metadata"]["name"] == "between"
+                   for e in out["items"]), out

@@ -624,3 +624,51 @@ class TestHfScheme:
         probs = out["predictions"][0]
         assert len(probs) == cfg.num_classes
         assert abs(sum(probs) - 1.0) < 1e-3
+
+
+class TestRepositoryApi:
+    """V2 repository API (SURVEY §2.2 model server library: 'model
+    repository with dynamic load/unload')."""
+
+    def test_index_unload_load_cycle(self):
+        from kubeflow_tpu.serving.runtimes import EchoModel
+        from kubeflow_tpu.serving.server import ModelServer
+
+        server = ModelServer().start()
+        try:
+            server.register(EchoModel("m1"))
+            code, idx = _post_like_get(f"{server.url}/v2/repository/index")
+            assert code == 200
+            assert idx == [{"name": "m1", "state": "READY", "reason": ""}]
+
+            code, out = _post(
+                f"{server.url}/v2/repository/models/m1/unload", {})
+            assert code == 200 and out["ok"]
+            # unloaded: indexed but unavailable; infer now 404s
+            _, idx = _post_like_get(f"{server.url}/v2/repository/index")
+            assert idx[0]["state"] == "UNAVAILABLE"
+            try:
+                _post(f"{server.url}/v1/models/m1:predict", {"instances": [1]})
+                raise AssertionError("expected 404 for unloaded model")
+            except urllib.error.HTTPError as e:
+                assert e.code == 404
+
+            code, out = _post(
+                f"{server.url}/v2/repository/models/m1/load", {})
+            assert code == 200 and out["ok"]
+            code, out = _post(f"{server.url}/v1/models/m1:predict",
+                              {"instances": [1, 2]})
+            assert code == 200 and out["predictions"] == [1, 2]
+
+            try:
+                _post(f"{server.url}/v2/repository/models/ghost/load", {})
+                raise AssertionError("expected 404 for unknown model")
+            except urllib.error.HTTPError as e:
+                assert e.code == 404
+        finally:
+            server.stop()
+
+
+def _post_like_get(url):
+    code, out = _post(url, {})
+    return code, out
