@@ -242,6 +242,12 @@ class Einsum(nn.Module):
 class Attention(nn.Module):
     cfg: LlamaConfig
     decode: bool = False
+    #: decode-time attention window: attend only over cache slots
+    #: [0, decode_attend_len) instead of all max_seq_len — the KV read is
+    #: the decode step's HBM bill, and short live fronts shouldn't pay
+    #: for the whole buffer.  Callers guarantee every live position is
+    #: below it; writes still target the full cache.
+    decode_attend_len: Optional[int] = None
 
     @nn.compact
     def __call__(self, x: jax.Array, positions: jax.Array) -> jax.Array:
@@ -311,12 +317,17 @@ class Attention(nn.Module):
         cached_v.value = cached_v.value.at[rows, positions].set(
             v.astype(cfg.dtype), mode="drop")
         idx.value = idx.value + sc  # legacy cursor, informational only
-        kf, vf = cached_k.value, cached_v.value
+        # static slice to the live front: the decode step streams the
+        # whole attended cache from HBM every token, so a 192-token
+        # conversation must not read a 4096-slot buffer
+        attend = self.decode_attend_len or cfg.max_seq_len
+        kf = cached_k.value[:, :attend]
+        vf = cached_v.value[:, :attend]
         qh = q.reshape(batch, sc, cfg.num_kv_heads, cfg.q_per_kv, cfg.head_dim)
         logits = jnp.einsum("bqkgh,bskh->bkgqs", qh.astype(jnp.float32), kf.astype(jnp.float32))
         logits = logits / jnp.sqrt(cfg.head_dim).astype(jnp.float32)
         # per-row per-query causal mask over cache slots
-        valid = (jnp.arange(cfg.max_seq_len)[None, None, :]
+        valid = (jnp.arange(attend)[None, None, :]
                  <= positions[:, :, None])  # [b, q, s]
         logits = jnp.where(valid[:, None, None, :, :], logits, -1e30)
         probs = jax.nn.softmax(logits, axis=-1)
@@ -375,13 +386,15 @@ def remat_policy(cfg: LlamaConfig):
 class Block(nn.Module):
     cfg: LlamaConfig
     decode: bool = False
+    decode_attend_len: Optional[int] = None
 
     @nn.compact
     def __call__(self, x: jax.Array, positions: jax.Array):
         cfg = self.cfg
         x = nn.with_logical_constraint(x, ("batch", "act_seq", "act_embed"))
         h = RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="attn_norm")(x)
-        x = x + Attention(cfg, self.decode, name="attn")(h, positions)
+        x = x + Attention(cfg, self.decode, self.decode_attend_len,
+                          name="attn")(h, positions)
         h = RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="mlp_norm")(x)
         if cfg.moe_experts > 0:
             from .moe import MoeMlp
@@ -398,10 +411,12 @@ class _ScanBlock(nn.Module):
 
     cfg: LlamaConfig
     decode: bool = False
+    decode_attend_len: Optional[int] = None
 
     @nn.compact
     def __call__(self, x, positions):
-        return Block(self.cfg, self.decode, name="block")(x, positions), None
+        return Block(self.cfg, self.decode, self.decode_attend_len,
+                     name="block")(x, positions), None
 
 
 class Embedder(nn.Module):
@@ -478,6 +493,10 @@ class Head(nn.Module):
 
 class Llama(nn.Module):
     cfg: LlamaConfig
+    #: decode-time attention window (see Attention.decode_attend_len);
+    #: serving runtimes compile one program per window bucket so short
+    #: conversations read KV proportional to their live front
+    decode_attend_len: Optional[int] = None
 
     @nn.compact
     def __call__(
@@ -511,10 +530,11 @@ class Llama(nn.Module):
                 in_axes=nn.broadcast,
                 length=cfg.num_layers,
                 metadata_params={nn.PARTITION_NAME: "layers"},
-            )(cfg, decode, name="layers")(x, positions)
+            )(cfg, decode, self.decode_attend_len, name="layers")(x, positions)
         else:
             for i in range(cfg.num_layers):
-                x = block_cls(cfg, decode, name=f"layer_{i}")(x, positions)
+                x = block_cls(cfg, decode, self.decode_attend_len,
+                              name=f"layer_{i}")(x, positions)
 
         table = embedder.table() if cfg.tie_embeddings else None
         return Head(cfg, name="head")(x, table)

@@ -172,6 +172,15 @@ class ContinuousEngine:
                 decode=True, mutable=["cache"])
             return logits, mutated["cache"]
 
+        #: decode-attention window buckets: each decode dispatch attends
+        #: only over cache slots below the smallest bucket covering every
+        #: live position (+ the chunk about to be generated) — the KV read
+        #: is the decode step's HBM bill, and early conversation turns
+        #: must not stream the whole max_seq_len buffer
+        self.attend_buckets = tuple(
+            [b for b in (128, 256, 512, 1024, 2048) if b < cfg.max_seq_len]
+            + [cfg.max_seq_len])
+
         def cache_shapes(batch: int):
             return jax.eval_shape(
                 lambda k, t, p: model.init(k, t, p, decode=True),
@@ -199,8 +208,38 @@ class ContinuousEngine:
         self._pool_shapes = pool_proto
         self._batch_axes = jax.tree.map(batch_axis, probe_proto, row_proto)
 
+        def make_prefill(attend: int):
+            wmodel = llamalib.Llama(cfg, decode_attend_len=attend)
+
+            def prefill(params, prompt, lengths):
+                """[g, bucket] ragged prefill -> (last-token logits [g,v],
+                row cache), attending only over [0, attend)."""
+                b, length = prompt.shape
+                cache = jax.tree.map(
+                    lambda s: jnp.zeros(s.shape, s.dtype), cache_shapes(b))
+                positions = jnp.broadcast_to(
+                    jnp.arange(length, dtype=jnp.int32)[None, :], (b, length))
+                logits_all, mutated = wmodel.apply(
+                    {"params": params, "cache": cache}, prompt, positions,
+                    decode=True, mutable=["cache"])
+                last = jnp.take_along_axis(
+                    logits_all, (lengths - 1)[:, None, None], axis=1)[:, 0]
+                return last, mutated["cache"]
+
+            return jax.jit(prefill)
+
+        self._prefill_programs: dict[int, Any] = {}
+
+        def prefill_for(bucket: int):
+            attend = next(b for b in self.attend_buckets if b >= bucket)
+            if attend not in self._prefill_programs:
+                self._prefill_programs[attend] = make_prefill(attend)
+            return self._prefill_programs[attend]
+
+        self._prefill_for = prefill_for
+
+        # the plain (windowless) prefill stays for shape probing
         def prefill(params, prompt, lengths):
-            """[1, bucket] ragged prefill -> (last-token logits [1,v], row cache)."""
             b, length = prompt.shape
             cache = jax.tree.map(
                 lambda s: jnp.zeros(s.shape, s.dtype), cache_shapes(b))
@@ -227,32 +266,55 @@ class ContinuousEngine:
             merged = jax.tree.map(leaf, pool_cache, row_cache, axes)
             return merged, pool_logits.at[slots].set(row_logits, mode="drop")
 
-        def decode(params, cache, logits, positions, active, key):
-            """``chunk`` sampling steps for the whole pool in one program.
+        def make_decode(attend: int):
+            wmodel = llamalib.Llama(cfg, decode_attend_len=attend)
 
-            Inactive slots still compute (the price of a static pool) but
-            their cache writes drop: position is pinned to max_seq_len,
-            where the per-row scatter's mode="drop" discards the write and
-            the causal mask hides the slot from every live row.
-            """
-            safe = jnp.where(active, positions, cfg.max_seq_len)
+            def decode(params, cache, logits, positions, active, key):
+                """``chunk`` sampling steps for the whole pool in one
+                program, attending only over cache slots [0, attend).
 
-            def step(carry, key):
-                cache, logits, pos = carry
-                if temperature > 0:
-                    tok = jax.random.categorical(
-                        key, logits.astype(jnp.float32) / temperature, axis=-1)
-                else:
-                    tok = jnp.argmax(logits, axis=-1)
-                tok = tok.astype(jnp.int32)
-                l, cache = forward(params, cache, tok[:, None], pos[:, None])
-                nxt = jnp.where(active, pos + 1, cfg.max_seq_len)
-                return (cache, l[:, -1, :], nxt), tok
+                Inactive slots still compute (the price of a static pool)
+                but their cache writes drop: position is pinned to
+                max_seq_len, where the per-row scatter's mode="drop"
+                discards the write and the causal mask hides the slot from
+                every live row.
+                """
+                safe = jnp.where(active, positions, cfg.max_seq_len)
 
-            keys = jax.random.split(key, chunk)
-            (cache, logits, pos), toks = jax.lax.scan(
-                step, (cache, logits, safe), keys)
-            return cache, logits, toks.T  # toks: [slots, chunk]
+                def step(carry, key):
+                    cache, logits, pos = carry
+                    if temperature > 0:
+                        tok = jax.random.categorical(
+                            key, logits.astype(jnp.float32) / temperature,
+                            axis=-1)
+                    else:
+                        tok = jnp.argmax(logits, axis=-1)
+                    tok = tok.astype(jnp.int32)
+                    l, mutated = wmodel.apply(
+                        {"params": params, "cache": cache}, tok[:, None],
+                        pos[:, None], decode=True, mutable=["cache"])
+                    nxt = jnp.where(active, pos + 1, cfg.max_seq_len)
+                    return (mutated["cache"], l[:, -1, :], nxt), tok
+
+                keys = jax.random.split(key, chunk)
+                (cache, logits, pos), toks = jax.lax.scan(
+                    step, (cache, logits, safe), keys)
+                return cache, logits, toks.T  # toks: [slots, chunk]
+
+            # donate pool buffers: the pool cache must exist in HBM once
+            return jax.jit(decode, donate_argnums=(1, 2))
+
+        self._decode_programs: dict[int, Any] = {}
+
+        def decode_for(needed: int):
+            attend = next(
+                (b for b in self.attend_buckets if b >= needed),
+                cfg.max_seq_len)
+            if attend not in self._decode_programs:
+                self._decode_programs[attend] = make_decode(attend)
+            return self._decode_programs[attend]
+
+        self._decode_for = decode_for
 
         # logits dtype follows the model's activation dtype (bf16 on TPU;
         # the pool logits buffer must match or the decode scan carry
@@ -264,11 +326,9 @@ class ContinuousEngine:
             jax.ShapeDtypeStruct((1,), jnp.int32),
         )[0].dtype
 
-        self._prefill = jax.jit(prefill)
         # donate pool buffers: the pool cache must exist in HBM once, not
         # once per in-flight dispatch
         self._merge = jax.jit(merge, donate_argnums=(0, 1))
-        self._decode = jax.jit(decode, donate_argnums=(1, 2))
 
     def _init_pool(self) -> None:
         self._pool_cache = jax.jit(lambda: jax.tree.map(
@@ -287,25 +347,31 @@ class ContinuousEngine:
         state is untouched for real traffic.
 
         ``groups``: list of (group_size, seq_bucket); default = group
-        sizes 1 and num_slots at the smallest bucket.
+        sizes 1 and num_slots at the smallest bucket.  ``attend_buckets``
+        (optional): decode-window buckets to precompile; default = the
+        windows the warmed prompt buckets will first decode in.
         """
         if groups is None:
             groups = [(1, self.seq_buckets[0]),
                       (self.num_slots, self.seq_buckets[0])]
+        warm_attends = set()
         for g, bucket in groups:
             bucket = next(b for b in self.seq_buckets if b >= bucket)
-            row_logits, row_cache = self._prefill(
+            row_logits, row_cache = self._prefill_for(bucket)(
                 self.params, jnp.zeros((g, bucket), jnp.int32),
                 jnp.ones(g, np.int32))
             self._pool_cache, self._pool_logits = self._merge(
                 self._pool_cache, self._pool_logits, row_cache, row_logits,
                 jnp.full(g, self.num_slots, jnp.int32))
-        self._pool_cache, self._pool_logits, toks = self._decode(
-            self.params, self._pool_cache, self._pool_logits,
-            jnp.full(self.num_slots, self.cfg.max_seq_len, jnp.int32),
-            jnp.zeros(self.num_slots, bool),
-            jax.random.PRNGKey(0))
-        jax.block_until_ready(toks)
+            warm_attends.add(bucket + self.decode_chunk)
+        for needed in sorted(warm_attends):
+            self._pool_cache, self._pool_logits, toks = self._decode_for(
+                needed)(
+                self.params, self._pool_cache, self._pool_logits,
+                jnp.full(self.num_slots, self.cfg.max_seq_len, jnp.int32),
+                jnp.zeros(self.num_slots, bool),
+                jax.random.PRNGKey(0))
+            jax.block_until_ready(toks)
 
     def submit(
         self, prompt: list[int], max_new_tokens: Optional[int] = None
@@ -398,7 +464,7 @@ class ContinuousEngine:
                     toks[j, : len(prompt)] = prompt
                     lengths[j] = len(prompt)
                     slots[j] = slot
-                row_logits, row_cache = self._prefill(
+                row_logits, row_cache = self._prefill_for(bucket)(
                     self.params, jnp.asarray(toks), jnp.asarray(lengths))
                 self._pool_cache, self._pool_logits = self._merge(
                     self._pool_cache, self._pool_logits,
@@ -457,9 +523,21 @@ class ContinuousEngine:
                 for slot in range(self.num_slots)
                 if self._active[slot] and self._slots[slot] is not None
             ]
-            self._pool_cache, self._pool_logits, toks = self._decode(
+            # window = smallest attend bucket covering every live position
+            # plus this chunk — early turns read KV proportional to the
+            # conversation front, not max_seq_len
+            needed = int(self._positions[self._active].max()) + self.decode_chunk
+            # pass NUMPY COPIES that are never mutated again: the CPU
+            # backend zero-copies numpy buffers across the jit boundary,
+            # and the schedule advance below mutates self._positions /
+            # self._active while the async-dispatched decode may not have
+            # executed yet — an aliased input then reads ADVANCED
+            # positions (writes land one slot off, intermittently, under
+            # dispatch-ahead pipelining; reproduced 3/10 before this fix)
+            self._pool_cache, self._pool_logits, toks = self._decode_for(
+                needed)(
                 self.params, self._pool_cache, self._pool_logits,
-                jnp.asarray(self._positions), jnp.asarray(self._active), key)
+                self._positions.copy(), self._active.copy(), key)
             # advance the value-independent schedule NOW so the next chunk
             # can dispatch before this one's tokens are fetched
             for slot, req, take in snapshot:

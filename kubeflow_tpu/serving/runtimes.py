@@ -121,54 +121,74 @@ class LlamaGenerator(Model):
                 self.params)
         temperature = self.temperature
         n_new = self.max_new_tokens
+        cfg = self.cfg
 
-        def forward(params, cache, tok, positions):
-            logits, mutated = self.model.apply(
-                {"params": params, "cache": cache}, tok, positions,
-                decode=True, mutable=["cache"])
-            return logits, mutated["cache"]
+        def make_programs(attend: int):
+            """(prefill, sample) jitted pair attending only over cache
+            slots [0, attend) — the decode step streams the attended
+            cache from HBM every token, so a 128-token prompt must not
+            read max_seq_len slots.  One pair per window bucket."""
+            model = llamalib.Llama(cfg, decode_attend_len=attend)
 
-        def prefill(params, cache, prompt, lengths):
-            """Chunked prefill of a RAGGED batch padded to one bucket: the
-            whole padded prompt in one decode=True forward.  The cache's
-            per-row position mask makes pad junk invisible; each row's next
-            -token logits are gathered at its true last token."""
-            b, length = prompt.shape
-            positions = jnp.broadcast_to(
-                jnp.arange(length, dtype=jnp.int32)[None, :], (b, length))
-            logits_all, cache = forward(params, cache, prompt, positions)
-            last = jnp.take_along_axis(
-                logits_all, (lengths - 1)[:, None, None], axis=1)[:, 0]
-            return last, cache
+            def forward(params, cache, tok, positions):
+                logits, mutated = model.apply(
+                    {"params": params, "cache": cache}, tok, positions,
+                    decode=True, mutable=["cache"])
+                return logits, mutated["cache"]
 
-        def sample(params, cache, logits, lengths, key):
-            """n_new single-token decode steps as one lax.scan — compiled
-            per (batch, bucket)-shape, reused across requests.  Per-row
-            positions start at each row's true length, so ragged rows
-            decode in lockstep without poisoning each other's cache.  One
-            dispatch + one host fetch per generate; a per-token Python
-            loop with per-element int() fetches paid ~one host round trip
-            per token (~100ms each on the remote-dispatch tunnel: the r3
-            serving-bench finding)."""
+            def prefill(params, cache, prompt, lengths):
+                """Chunked prefill of a RAGGED batch padded to one bucket:
+                the whole padded prompt in one decode=True forward.  The
+                cache's per-row position mask makes pad junk invisible;
+                each row's next-token logits are gathered at its true last
+                token."""
+                b, length = prompt.shape
+                positions = jnp.broadcast_to(
+                    jnp.arange(length, dtype=jnp.int32)[None, :], (b, length))
+                logits_all, cache = forward(params, cache, prompt, positions)
+                last = jnp.take_along_axis(
+                    logits_all, (lengths - 1)[:, None, None], axis=1)[:, 0]
+                return last, cache
 
-            def step(carry, key):
-                cache, logits, pos = carry  # pos: [b] per-row positions
-                if temperature > 0:
-                    tok = jax.random.categorical(
-                        key, logits.astype(jnp.float32) / temperature, axis=-1)
-                else:
-                    tok = jnp.argmax(logits, axis=-1)
-                tok = tok.astype(jnp.int32)
-                l, cache = forward(params, cache, tok[:, None], pos[:, None])
-                return (cache, l[:, -1, :], pos + 1), tok
+            def sample(params, cache, logits, lengths, key):
+                """n_new single-token decode steps as one lax.scan —
+                compiled per (batch, bucket)-shape, reused across requests.
+                Per-row positions start at each row's true length, so
+                ragged rows decode in lockstep without poisoning each
+                other's cache.  One dispatch + one host fetch per generate;
+                a per-token Python loop with per-element int() fetches paid
+                ~one host round trip per token (~100ms each on the
+                remote-dispatch tunnel: the r3 serving-bench finding)."""
 
-            keys = jax.random.split(key, n_new)
-            (_, _, _), toks = jax.lax.scan(
-                step, (cache, logits, lengths), keys)
-            return toks.T  # [b, n_new]
+                def step(carry, key):
+                    cache, logits, pos = carry  # pos: [b] per-row positions
+                    if temperature > 0:
+                        tok = jax.random.categorical(
+                            key, logits.astype(jnp.float32) / temperature,
+                            axis=-1)
+                    else:
+                        tok = jnp.argmax(logits, axis=-1)
+                    tok = tok.astype(jnp.int32)
+                    l, cache = forward(params, cache, tok[:, None], pos[:, None])
+                    return (cache, l[:, -1, :], pos + 1), tok
 
-        self._prefill = jax.jit(prefill)
-        self._sample = jax.jit(sample)
+                keys = jax.random.split(key, n_new)
+                (_, _, _), toks = jax.lax.scan(
+                    step, (cache, logits, lengths), keys)
+                return toks.T  # [b, n_new]
+
+            return jax.jit(prefill), jax.jit(sample)
+
+        self._programs: dict[int, tuple] = {}
+
+        def programs_for(bucket: int):
+            # prefill positions < bucket; decode positions < bucket + n_new
+            attend = min(bucket + n_new, cfg.max_seq_len)
+            if attend not in self._programs:
+                self._programs[attend] = make_programs(attend)
+            return self._programs[attend]
+
+        self._programs_for = programs_for
         cap = self.cfg.max_seq_len - n_new
         if cap < 1:
             raise ValueError(
@@ -232,14 +252,15 @@ class LlamaGenerator(Model):
         for i, p in enumerate(prompts):
             toks[i, : len(p)] = p
         cache = self._init_cache(batch)
-        logits, cache = self._prefill(
+        prefill, sample = self._programs_for(bucket)
+        logits, cache = prefill(
             self.params, cache, jnp.asarray(toks), jnp.asarray(lengths))
         # per-request sampling key: temperature>0 must differ across
         # requests AND across replicas/restarts (a fixed key made every
         # "random" continuation identical; a bare counter would replay the
         # same sequence on every replica)
         self._req_counter = getattr(self, "_req_counter", 0) + 1
-        out = self._sample(
+        out = sample(
             self.params, cache, logits, jnp.asarray(lengths),
             jax.random.fold_in(self._base_key, self._req_counter))
         rows = np.asarray(jax.device_get(out)).tolist()
