@@ -103,6 +103,8 @@ def cmd_apply(server: str, args) -> int:
 
 
 def cmd_get(server: str, args) -> int:
+    if getattr(args, "watch", False):
+        return _watch_loop(server, args)
     if args.name:
         obj = _request(
             "GET", f"{server}/apis/{args.kind}/{args.namespace}/{args.name}")
@@ -126,6 +128,31 @@ def cmd_get(server: str, args) -> int:
     widths = [max(len(r[i]) for r in rows) for i in range(4)]
     for r in rows:
         print("  ".join(c.ljust(w) for c, w in zip(r, widths)).rstrip())
+    return 0
+
+
+def _watch_loop(server: str, args) -> int:
+    """kubectl get -w: stream events for a kind until --watch-seconds
+    elapses, resuming between long-polls with the server's cursor (no
+    events are lost between polls)."""
+    deadline = time.time() + args.watch_seconds
+    cursor = 0
+    while time.time() < deadline:
+        poll = max(1.0, min(30.0, deadline - time.time()))
+        out = _request(
+            "GET",
+            f"{server}/apis/{args.kind}?watch=true&timeout={poll}"
+            f"&cursor={cursor}&namespace={args.namespace}"
+            if args.namespace != "_all"
+            else f"{server}/apis/{args.kind}?watch=true&timeout={poll}"
+                 f"&cursor={cursor}",
+        )
+        cursor = out["cursor"]
+        for ev in out["items"]:
+            md = ev["object"].get("metadata", {}) or {}
+            print(f"{ev['type']}	{md.get('namespace', '')}/"
+                  f"{md.get('name', '')}	{_phase_of(ev['object'])}",
+                  flush=True)
     return 0
 
 
@@ -186,6 +213,9 @@ def build_parser() -> argparse.ArgumentParser:
                         action="store_const", const="_all")
         sp.add_argument("-o", "--output", choices=("table", "yaml", "json"),
                         default="table")
+        sp.add_argument("-w", "--watch", action="store_true",
+                        help="stream events for this kind")
+        sp.add_argument("--watch-seconds", type=float, default=30.0)
         sp.set_defaults(fn=fn)
 
     for verb, fn in (("describe", cmd_describe), ("delete", cmd_delete)):

@@ -184,16 +184,18 @@ class ApiServer:
                 self._events_cond.notify_all()
 
     def _watch(self, h, kind: str, ns: Optional[str], timeout: float,
-               after: int) -> None:
+               after: Optional[int]) -> None:
         """Long-poll against the buffered event stream.
 
         ``after`` is the cursor (the ``seq`` of the last event the client
-        saw; 0 = only future events).  Each response carries ``seq`` per
-        item and ``cursor`` to pass back — re-polling with the cursor
-        recovers everything that happened between polls (up to the
-        buffer's retention)."""
+        saw); ABSENT means "only future events".  A cursor of 0 is a real
+        resume point (a first poll before any event legitimately returns
+        cursor 0), so absence is None, not a 0 sentinel.  Each response
+        carries ``seq`` per item and ``cursor`` to pass back — re-polling
+        with the cursor recovers everything that happened between polls
+        (up to the buffer's retention)."""
         deadline = time.monotonic() + min(max(timeout, 0.0), 300.0)
-        if after == 0:
+        if after is None:
             with self._events_cond:
                 after = self._event_seq  # "now": only future events
 
@@ -248,9 +250,10 @@ class ApiServer:
                 # kubectl -w analog: long-poll the buffered event stream;
                 # pass back the returned ``cursor`` to resume without
                 # losing events that land between polls
+                cur = q.get("cursor", [None])[0]
                 self._watch(h, kind, ns,
                             float(q.get("timeout", ["30"])[0]),
-                            int(q.get("cursor", ["0"])[0]))
+                            int(cur) if cur is not None else None)
                 return
             objs = self.store.list(kind, ns)
             h._send(200, {"items": [to_dict(o) for o in objs]})

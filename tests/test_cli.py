@@ -183,3 +183,28 @@ class TestWatch:
         assert any(e["type"] == "ADDED"
                    and e["object"]["metadata"]["name"] == "between"
                    for e in out["items"]), out
+
+    def test_kft_get_watch_flag(self, api_cluster, capsys):
+        """kft get <kind> -w streams events until --watch-seconds."""
+        import threading
+        import time as _time
+
+        _, url = api_cluster
+
+        def late_create():
+            _time.sleep(0.4)
+            body = {"kind": "Profile", "metadata": {"name": "streamed"},
+                    "spec": {"owner": "s@corp"}}
+            req = urllib.request.Request(
+                f"{url}/apis/Profile", data=json.dumps(body).encode(),
+                headers={"Content-Type": "application/json"})
+            urllib.request.urlopen(req, timeout=10)
+
+        t = threading.Thread(target=late_create)
+        t.start()
+        rc = cli.main(["--server", url, "get", "profiles", "-w",
+                       "--watch-seconds", "2"])
+        t.join()
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "ADDED\tdefault/streamed" in out, out
