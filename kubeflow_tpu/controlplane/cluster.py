@@ -67,6 +67,8 @@ class Cluster:
             ("kft-llama", ["llama", "llm"], "kubeflow_tpu.serving.runtimes:LlamaGenerator"),
             ("kft-llama-continuous", ["llama-continuous"],
              "kubeflow_tpu.serving.continuous:ContinuousLlamaGenerator"),
+            ("kft-text-llm", ["text-llm"],
+             "kubeflow_tpu.serving.text:TextGenerator"),
             ("kft-bert", ["bert"], "kubeflow_tpu.serving.runtimes:BertClassifierModel"),
         ):
             try:

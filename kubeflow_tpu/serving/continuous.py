@@ -378,7 +378,11 @@ class ContinuousEngine:
     ) -> Request:
         req = Request(
             prompt=list(map(int, prompt)),
-            max_new_tokens=int(max_new_tokens or self.default_max_new_tokens),
+            # explicit None check: 0 is a real request ("no completion",
+            # OpenAI max_tokens=0) and must not fall through to the default
+            max_new_tokens=int(
+                self.default_max_new_tokens
+                if max_new_tokens is None else max_new_tokens),
         )
         req.submitted_step = self.step_counter
         with self._gate:
@@ -580,6 +584,28 @@ class ContinuousEngine:
                 req.done.set()
 
 
+def build_engine(cfg, params, config: dict, *, default_eos=None,
+                 default_max_new_tokens: int = 16) -> "ContinuousEngine":
+    """Engine from a serving-config dict — the ONE construction site shared
+    by every runtime that fronts the engine (token-level and text), so
+    knobs stay in sync.  Honors "warmup_groups": [] to skip warmup."""
+    engine = ContinuousEngine(
+        cfg, params,
+        num_slots=int(config.get("num_slots", 8)),
+        decode_chunk=int(config.get("decode_chunk", 4)),
+        temperature=float(config.get("temperature", 0.0)),
+        eos_id=config.get("eos_id", default_eos),
+        seq_buckets=config.get("seq_buckets"),
+        pipeline_depth=int(config.get("pipeline_depth", 2)),
+        default_max_new_tokens=int(
+            config.get("max_new_tokens", default_max_new_tokens)),
+    )
+    groups = config.get("warmup_groups")
+    if groups != []:
+        engine.warmup([tuple(g) for g in groups] if groups else None)
+    return engine
+
+
 class ContinuousLlamaGenerator(Model):
     """Serving runtime over :class:`ContinuousEngine`.
 
@@ -603,21 +629,7 @@ class ContinuousLlamaGenerator(Model):
     def load(self) -> None:
         ref = self.config["params_ref"]
         cfg, params = fetch_mem(ref[len("mem://"):])
-        self.engine = ContinuousEngine(
-            cfg, params,
-            num_slots=int(self.config.get("num_slots", 8)),
-            decode_chunk=int(self.config.get("decode_chunk", 4)),
-            temperature=float(self.config.get("temperature", 0.0)),
-            eos_id=self.config.get("eos_id"),
-            seq_buckets=self.config.get("seq_buckets"),
-            default_max_new_tokens=int(self.config.get("max_new_tokens", 16)),
-        )
-        # precompile before the first request (load-time AOT, like the
-        # bucketed JaxFunctionModel); config "warmup_groups": [[g, bucket]]
-        groups = self.config.get("warmup_groups")
-        if groups != []:
-            self.engine.warmup(
-                [tuple(g) for g in groups] if groups else None)
+        self.engine = build_engine(cfg, params, self.config)
         self.ready = True
 
     def stop(self) -> None:

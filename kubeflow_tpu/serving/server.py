@@ -197,7 +197,12 @@ class ModelServer:
     def register(
         self, model: Model, *, batch_max_size: int = 8, batch_timeout_ms: float = 2.0
     ) -> None:
-        model.start()
+        if not model.ready:  # idempotent: a live model re-registers as-is
+            model.start()
+        old_batcher = self._batchers.pop(model.name, None)
+        if old_batcher is not None:
+            # re-registration must not leak the previous batcher's thread
+            old_batcher.stop()
         self._models[model.name] = model
         # remember how to rebuild it: the V2 repository API's unload/load
         # cycle re-instantiates from this spec
@@ -360,6 +365,28 @@ class ModelServer:
         if path.startswith("/v2/models/") and path.endswith("/infer"):
             name = path[len("/v2/models/"):-len("/infer")]
             self._predict_v2(h, name, payload)
+            return
+        # OpenAI completions (huggingfaceserver parity): routed to models
+        # that implement openai_completions (serving/text.py)
+        if path == "/openai/v1/completions":
+            name = payload.get("model", "")
+            m = self._models.get(name)
+            if m is None or not hasattr(m, "openai_completions"):
+                h._send(404, {"error": f"no completions model {name!r}"})
+                return
+            t0 = time.perf_counter()
+            with self.metrics.lock:  # inflight gauge covers completions too
+                self.metrics.inflight += 1
+            try:
+                out = m.openai_completions(payload)
+                self.metrics.observe(name, time.perf_counter() - t0, error=False)
+                h._send(200, out)
+            except Exception as e:  # noqa: BLE001
+                self.metrics.observe(name, time.perf_counter() - t0, error=True)
+                h._send(500, {"error": f"{type(e).__name__}: {e}"})
+            finally:
+                with self.metrics.lock:
+                    self.metrics.inflight -= 1
             return
         # V2 repository API: dynamic load/unload + index
         if path == "/v2/repository/index":

@@ -1,0 +1,171 @@
+"""Text-in/text-out LLM serving: tokenizer in the server + OpenAI-style
+completions.
+
+[upstream: kserve -> python/huggingfaceserver] — the reference's LLM
+runtime tokenizes inside the server (clients send text) and exposes the
+OpenAI completions API in front of its vLLM/transformers backends.  This
+module is that surface over the TPU generation engines:
+
+- ``TextGenerator``: a self-batching Model wrapping ContinuousEngine —
+  string prompts in, continuations out, with the tokenizer resolved from
+  config (``bytes`` needs nothing; ``hf`` loads a local HuggingFace
+  tokenizer directory, e.g. an ``hf://`` snapshot resolved by the
+  storage initializer);
+- ``ByteTokenizer``: UTF-8 bytes <-> ids — zero-asset, works with any
+  vocab >= 256 (the tiny test model's vocab is exactly 256);
+- ``HfTokenizer``: ``transformers.AutoTokenizer`` over a LOCAL directory
+  (zero-egress deployment: snapshots come from $KFT_HF_HOME);
+- the OpenAI completions contract (``openai_completions``), served by
+  ModelServer at ``POST /openai/v1/completions``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from .model import Model
+from .storage import fetch_mem
+
+
+class ByteTokenizer:
+    """UTF-8 bytes as token ids.  Asset-free; round-trips any text."""
+
+    vocab_size = 256
+
+    def encode(self, text: str) -> list[int]:
+        return list(text.encode("utf-8"))
+
+    def decode(self, ids: list[int]) -> str:
+        return bytes(int(i) % 256 for i in ids).decode("utf-8", errors="replace")
+
+
+class HfTokenizer:
+    """HuggingFace tokenizer from a LOCAL directory (no hub egress)."""
+
+    def __init__(self, path: str):
+        from transformers import AutoTokenizer
+
+        self._tok = AutoTokenizer.from_pretrained(path, local_files_only=True)
+
+    @property
+    def vocab_size(self) -> int:
+        # len() covers added tokens beyond the base vocab; the model-vocab
+        # compatibility guard in TextGenerator.load depends on this
+        return len(self._tok)
+
+    @property
+    def eos_id(self) -> Optional[int]:
+        return self._tok.eos_token_id
+
+    def encode(self, text: str) -> list[int]:
+        return self._tok.encode(text, add_special_tokens=False)
+
+    def decode(self, ids: list[int]) -> str:
+        return self._tok.decode(ids, skip_special_tokens=True)
+
+
+def resolve_tokenizer(config: dict):
+    """config["tokenizer"]: "bytes" (default) | {"type": "hf", "path": dir}
+    — the hf path may come from the storage initializer (storage_path)."""
+    spec = config.get("tokenizer", "bytes")
+    if spec == "bytes":
+        return ByteTokenizer()
+    if isinstance(spec, dict) and spec.get("type") == "hf":
+        path = spec.get("path") or config.get("storage_path")
+        if not path:
+            raise ValueError("hf tokenizer needs a local path "
+                             "(tokenizer.path or storage_uri)")
+        return HfTokenizer(path)
+    if isinstance(spec, str) and spec not in ("bytes",):
+        # a bare string is a local tokenizer directory
+        return HfTokenizer(spec)
+    raise ValueError(f"unknown tokenizer spec {spec!r}")
+
+
+class TextGenerator(Model):
+    """Text completions over the continuous-batching engine.
+
+    config:
+      params_ref:  "mem://key" holding (LlamaConfig, params)
+      tokenizer:   "bytes" | {"type": "hf", "path": dir}
+      max_new_tokens, num_slots, decode_chunk, temperature, eos_id,
+      warmup_groups: engine knobs (see serving/continuous.py)
+
+    Instances are prompt STRINGS (or {"prompt": str, "max_tokens": int});
+    predictions are continuation strings.  Self-batching: concurrent
+    requests coalesce in the engine's slot pool at token boundaries.
+    """
+
+    self_batching = True
+
+    def __init__(self, name: str, config: Optional[dict[str, Any]] = None):
+        super().__init__(name, config)
+        self.engine = None
+        self.tokenizer = None
+
+    def load(self) -> None:
+        from .continuous import build_engine
+
+        self.tokenizer = resolve_tokenizer(self.config)
+        cfg, params = fetch_mem(
+            self.config["params_ref"][len("mem://"):])
+        if getattr(self.tokenizer, "vocab_size", 0) > cfg.vocab_size:
+            raise ValueError(
+                f"tokenizer needs vocab {self.tokenizer.vocab_size} but the "
+                f"model has {cfg.vocab_size}")
+        eos = self.config.get("eos_id", getattr(self.tokenizer, "eos_id", None))
+        self.engine = build_engine(
+            cfg, params, self.config, default_eos=eos,
+            default_max_new_tokens=32)
+        self.ready = True
+
+    def stop(self) -> None:
+        if self.engine is not None:
+            self.engine.stop()
+            self.engine = None
+        super().stop()
+
+    def _submit(self, inst):
+        if isinstance(inst, dict):
+            prompt = inst.get("prompt", "")
+            max_new = inst.get("max_tokens")
+        else:
+            prompt, max_new = str(inst), None
+        return self.engine.submit(self.tokenizer.encode(prompt), max_new)
+
+    def predict_batch(self, instances):
+        assert self.engine is not None, "model not loaded"
+        reqs = [self._submit(i) for i in instances]
+        return [self.tokenizer.decode(r.wait(300.0)) for r in reqs]
+
+    # -- OpenAI completions contract (huggingfaceserver parity) -----------
+
+    def openai_completions(self, payload: dict) -> dict:
+        """``POST /openai/v1/completions`` body -> response (text
+        completions; served by ModelServer for models providing this)."""
+        prompts = payload.get("prompt", "")
+        if isinstance(prompts, str):
+            prompts = [prompts]
+        max_tokens = payload.get("max_tokens")
+        reqs = [
+            self.engine.submit(self.tokenizer.encode(p), max_tokens)
+            for p in prompts
+        ]
+        choices = []
+        completion_tokens = 0
+        for i, r in enumerate(reqs):
+            ids = r.wait(300.0)
+            completion_tokens += len(ids)  # TOKENS, not decoded chars
+            choices.append({
+                "index": i,
+                "text": self.tokenizer.decode(ids),
+                "finish_reason": (
+                    "stop" if self.engine.eos_id is not None
+                    and ids and ids[-1] == self.engine.eos_id else "length"),
+            })
+        return {
+            "object": "text_completion",
+            "model": payload.get("model", self.name),
+            "choices": choices,
+            "usage": {"completion_tokens": completion_tokens},
+        }

@@ -1,0 +1,115 @@
+"""Text-in/text-out LLM serving (serving/text.py): tokenizer in the
+server + OpenAI-style completions — the huggingfaceserver surface
+[upstream: kserve -> python/huggingfaceserver]."""
+
+import json
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from kubeflow_tpu.models import llama as llamalib
+from kubeflow_tpu.serving.storage import register_mem
+from kubeflow_tpu.serving.text import (
+    ByteTokenizer,
+    HfTokenizer,
+    TextGenerator,
+    resolve_tokenizer,
+)
+
+
+@pytest.fixture(scope="module")
+def text_model():
+    cfg = llamalib.tiny()  # vocab 256 == the byte tokenizer's range
+    model = llamalib.Llama(cfg)
+    params = model.init(jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32))
+    ref = register_mem("text-llama", (cfg, params["params"]))
+    m = TextGenerator("textgen", {
+        "params_ref": ref, "max_new_tokens": 6, "decode_chunk": 2,
+        "num_slots": 4, "warmup_groups": []})
+    m.start()
+    yield m
+    m.stop()
+
+
+class TestTokenizers:
+    def test_byte_tokenizer_round_trips(self):
+        t = ByteTokenizer()
+        for s in ("hello", "héllo wörld", ""):
+            assert t.decode(t.encode(s)) == s
+
+    def test_hf_tokenizer_local(self, tmp_path):
+        """AutoTokenizer from a LOCAL directory (zero-egress contract)."""
+        from tokenizers import Tokenizer, models, pre_tokenizers
+        from transformers import PreTrainedTokenizerFast
+
+        vocab = {"<unk>": 0, "hello": 1, "world": 2, "tpu": 3}
+        tok = Tokenizer(models.WordLevel(vocab, unk_token="<unk>"))
+        tok.pre_tokenizer = pre_tokenizers.Whitespace()
+        PreTrainedTokenizerFast(
+            tokenizer_object=tok, unk_token="<unk>"
+        ).save_pretrained(str(tmp_path / "tok"))
+        t = HfTokenizer(str(tmp_path / "tok"))
+        ids = t.encode("hello tpu")
+        assert ids == [1, 3]
+        assert t.decode(ids) == "hello tpu"
+        # resolve via config spec
+        t2 = resolve_tokenizer({"tokenizer": {"type": "hf",
+                                              "path": str(tmp_path / "tok")}})
+        assert t2.encode("world") == [2]
+
+    def test_unknown_spec_rejected(self):
+        with pytest.raises(ValueError):
+            resolve_tokenizer({"tokenizer": {"type": "nope"}})
+
+
+class TestTextGenerator:
+    def test_text_in_text_out(self, text_model):
+        out = text_model.predict_batch(["hi", {"prompt": "ab", "max_tokens": 3}])
+        assert len(out) == 2
+        assert all(isinstance(o, str) for o in out)
+        assert len(out[0].encode("utf-8", errors="replace")) >= 1
+        # dict form honored its own budget (3 byte-tokens max)
+        assert len(text_model.tokenizer.encode(out[1])) <= 3 or len(out[1]) <= 3
+
+    def test_deterministic_greedy(self, text_model):
+        a = text_model.predict_batch(["same prompt"])[0]
+        b = text_model.predict_batch(["same prompt"])[0]
+        assert a == b
+
+    def test_openai_completions_endpoint(self, text_model):
+        """The OpenAI completions contract over live HTTP."""
+        from kubeflow_tpu.serving.server import ModelServer
+
+        server = ModelServer().start()
+        try:
+            server.register(text_model)
+            body = {"model": "textgen", "prompt": ["x", "yz"],
+                    "max_tokens": 4}
+            req = urllib.request.Request(
+                f"{server.url}/openai/v1/completions",
+                data=json.dumps(body).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=60) as r:
+                out = json.loads(r.read())
+            assert out["object"] == "text_completion"
+            assert len(out["choices"]) == 2
+            assert {c["index"] for c in out["choices"]} == {0, 1}
+            assert all(isinstance(c["text"], str) for c in out["choices"])
+            # unknown model -> 404
+            bad = urllib.request.Request(
+                f"{server.url}/openai/v1/completions",
+                data=json.dumps({"model": "ghost", "prompt": "q"}).encode(),
+                headers={"Content-Type": "application/json"})
+            try:
+                urllib.request.urlopen(bad, timeout=10)
+                raise AssertionError("expected 404")
+            except urllib.error.HTTPError as e:
+                assert e.code == 404
+        finally:
+            # the module-scoped model outlives this server: detach without
+            # stopping the engine
+            server._models.pop("textgen", None)
+            server._specs.pop("textgen", None)
+            server.stop()
