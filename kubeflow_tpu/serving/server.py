@@ -378,12 +378,30 @@ class ModelServer:
             with self.metrics.lock:  # inflight gauge covers completions too
                 self.metrics.inflight += 1
             try:
+                if payload.get("stream") and hasattr(m, "openai_stream"):
+                    # SSE: tokens stream as the engine emits decode chunks
+                    h.send_response(200)
+                    h.send_header("Content-Type", "text/event-stream")
+                    h.send_header("Cache-Control", "no-cache")
+                    h.end_headers()
+                    for chunk in m.openai_stream(payload):
+                        h.wfile.write(chunk)
+                        h.wfile.flush()
+                    self.metrics.observe(
+                        name, time.perf_counter() - t0, error=False)
+                    return
                 out = m.openai_completions(payload)
                 self.metrics.observe(name, time.perf_counter() - t0, error=False)
                 h._send(200, out)
+            except BrokenPipeError:
+                # client hung up mid-stream: not a server error
+                self.metrics.observe(name, time.perf_counter() - t0, error=False)
             except Exception as e:  # noqa: BLE001
                 self.metrics.observe(name, time.perf_counter() - t0, error=True)
-                h._send(500, {"error": f"{type(e).__name__}: {e}"})
+                try:
+                    h._send(500, {"error": f"{type(e).__name__}: {e}"})
+                except (BrokenPipeError, OSError):
+                    pass  # headers already sent mid-stream
             finally:
                 with self.metrics.lock:
                     self.metrics.inflight -= 1

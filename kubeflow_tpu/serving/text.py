@@ -36,7 +36,21 @@ class ByteTokenizer:
         return list(text.encode("utf-8"))
 
     def decode(self, ids: list[int]) -> str:
-        return bytes(int(i) % 256 for i in ids).decode("utf-8", errors="replace")
+        # out-of-range ids (model vocab > 256) become U+FFFD — aliasing
+        # them mod 256 would return deterministic-looking garbage as if
+        # it were a real completion
+        out: list[str] = []
+        buf = bytearray()
+        for i in ids:
+            i = int(i)
+            if 0 <= i < 256:
+                buf.append(i)
+            else:
+                out.append(buf.decode("utf-8", errors="replace"))
+                buf = bytearray()
+                out.append("�")
+        out.append(buf.decode("utf-8", errors="replace"))
+        return "".join(out)
 
 
 class HfTokenizer:
@@ -139,6 +153,61 @@ class TextGenerator(Model):
         return [self.tokenizer.decode(r.wait(300.0)) for r in reqs]
 
     # -- OpenAI completions contract (huggingfaceserver parity) -----------
+
+    def openai_stream(self, payload: dict):
+        """``stream: true`` — yield OpenAI-style SSE chunks as tokens
+        land.  The engine's Request accrues tokens per decode chunk, so
+        streaming polls the growing token lists (ALL prompts of the
+        request, one choice index each).  A delta is emitted only while
+        the re-decoded text extends what was already sent — a decode
+        boundary can change how the tail decodes (a split UTF-8
+        multibyte char, BPE re-merges), and that tail must be HELD until
+        it stabilizes, or chunk concatenation diverges from the full
+        completion.
+        """
+        import json as jsonlib
+        import time as timelib
+
+        prompts = payload.get("prompt", "")
+        if isinstance(prompts, str):
+            prompts = [prompts]
+        max_tokens = payload.get("max_tokens")
+        reqs = [
+            self.engine.submit(self.tokenizer.encode(str(p)), max_tokens)
+            for p in prompts
+        ]
+        sent = [""] * len(reqs)
+        finished = [False] * len(reqs)
+        model = payload.get("model", self.name)
+        while not all(finished):
+            progressed = False
+            for i, req in enumerate(reqs):
+                if finished[i]:
+                    continue
+                done = req.done.is_set()
+                full = self.tokenizer.decode(list(req.tokens))
+                if done:
+                    # final decode is authoritative; flush everything
+                    delta = full[len(sent[i]):] if full.startswith(sent[i]) \
+                        else full
+                    finished[i] = True
+                    if req.error is not None:
+                        raise req.error
+                elif full.startswith(sent[i]):
+                    delta = full[len(sent[i]):]
+                else:
+                    continue  # tail not stable yet: hold
+                if delta:
+                    sent[i] = sent[i] + delta if not done else full
+                    progressed = True
+                    yield ("data: " + jsonlib.dumps({
+                        "object": "text_completion.chunk",
+                        "model": model,
+                        "choices": [{"index": i, "text": delta}],
+                    }) + "\n\n").encode()
+            if not all(finished) and not progressed:
+                timelib.sleep(0.02)
+        yield b"data: [DONE]\n\n"
 
     def openai_completions(self, payload: dict) -> dict:
         """``POST /openai/v1/completions`` body -> response (text

@@ -113,3 +113,42 @@ class TestTextGenerator:
             server._models.pop("textgen", None)
             server._specs.pop("textgen", None)
             server.stop()
+
+
+class TestStreaming:
+    def test_stream_completions_sse(self, text_model):
+        """stream: true — SSE chunks arrive progressively and concatenate
+        to exactly the non-streamed completion."""
+        from kubeflow_tpu.serving.server import ModelServer
+
+        server = ModelServer().start()
+        try:
+            server.register(text_model)
+            ref = text_model.openai_completions(
+                {"prompt": "stream me", "max_tokens": 6})
+            body = {"model": "textgen", "prompt": "stream me",
+                    "max_tokens": 6, "stream": True}
+            req = urllib.request.Request(
+                f"{server.url}/openai/v1/completions",
+                data=json.dumps(body).encode(),
+                headers={"Content-Type": "application/json"})
+            chunks = []
+            with urllib.request.urlopen(req, timeout=60) as r:
+                assert r.headers.get("Content-Type") == "text/event-stream"
+                for raw in r:
+                    line = raw.decode().strip()
+                    if not line.startswith("data: "):
+                        continue
+                    data = line[len("data: "):]
+                    if data == "[DONE]":
+                        break
+                    chunks.append(json.loads(data)["choices"][0]["text"])
+            assert chunks, "no streamed chunks"
+            # the streaming contract: chunk concatenation == the full
+            # completion (chunk COUNT is timing-dependent — a warm engine
+            # can finish all decode chunks before the first poll)
+            assert "".join(chunks) == ref["choices"][0]["text"]
+        finally:
+            server._models.pop("textgen", None)
+            server._specs.pop("textgen", None)
+            server.stop()
