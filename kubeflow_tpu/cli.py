@@ -31,7 +31,14 @@ import yaml
 
 
 class CliError(RuntimeError):
-    pass
+    """API error carrying the server's structured ``reason`` (and, for
+    watch-cursor expiry, the server's resync ``cursor``) — callers branch
+    on ``reason``, never on message text."""
+
+    def __init__(self, msg: str, reason: str = "", cursor: Optional[int] = None):
+        super().__init__(msg)
+        self.reason = reason
+        self.cursor = cursor
 
 
 def _request(method: str, url: str, body: Optional[dict] = None) -> Any:
@@ -45,11 +52,16 @@ def _request(method: str, url: str, body: Optional[dict] = None) -> Any:
             ctype = resp.headers.get("Content-Type", "")
             return raw.decode() if "text/plain" in ctype else json.loads(raw)
     except urllib.error.HTTPError as e:
+        reason, cursor = "", None
         try:
-            msg = json.loads(e.read()).get("error", str(e))
+            payload = json.loads(e.read())
+            msg = payload.get("error", str(e))
+            reason = payload.get("reason", "")
+            cursor = payload.get("cursor")
         except Exception:  # noqa: BLE001
             msg = str(e)
-        raise CliError(f"{method} {url}: {msg}") from None
+        raise CliError(f"{method} {url}: {msg}", reason=reason,
+                       cursor=cursor) from None
     except OSError as e:
         raise CliError(f"cannot reach API server at {url}: {e}") from None
 
@@ -91,7 +103,10 @@ def cmd_apply(server: str, args) -> int:
             _request("POST", f"{server}/apis/{kind}", doc)
             print(f"{kind.lower()}/{name} created")
         except CliError as e:
-            if "exists" not in str(e):
+            # branch on the server's structured reason: a 422 admission
+            # rejection whose MESSAGE contains "exists" must surface
+            # as-is, not trigger a confusing GET+PUT
+            if e.reason != "AlreadyExists":
                 raise
             # create-or-update: refresh spec onto the live object (kubectl
             # apply semantics, optimistic concurrency handled by re-read)
@@ -139,14 +154,25 @@ def _watch_loop(server: str, args) -> int:
     cursor = 0
     while time.time() < deadline:
         poll = max(1.0, min(30.0, deadline - time.time()))
-        out = _request(
-            "GET",
-            f"{server}/apis/{args.kind}?watch=true&timeout={poll}"
-            f"&cursor={cursor}&namespace={args.namespace}"
-            if args.namespace != "_all"
-            else f"{server}/apis/{args.kind}?watch=true&timeout={poll}"
-                 f"&cursor={cursor}",
-        )
+        try:
+            out = _request(
+                "GET",
+                f"{server}/apis/{args.kind}?watch=true&timeout={poll}"
+                f"&cursor={cursor}&namespace={args.namespace}"
+                if args.namespace != "_all"
+                else f"{server}/apis/{args.kind}?watch=true&timeout={poll}"
+                     f"&cursor={cursor}",
+            )
+        except CliError as e:
+            if e.reason != "Expired" or e.cursor is None:
+                raise
+            # 410 Gone: the buffer rolled past our cursor — announce the
+            # gap and resync to the server's current cursor (the kubectl
+            # relist-and-rewatch analog)
+            print(f"WATCH-RESYNC\t(events lost; resuming at {e.cursor})",
+                  flush=True)
+            cursor = e.cursor
+            continue
         cursor = out["cursor"]
         for ev in out["items"]:
             md = ev["object"].get("metadata", {}) or {}

@@ -127,6 +127,11 @@ class ApiServer:
         self._events: "deque[tuple[int, object]]" = deque(maxlen=2048)
         self._events_cond = threading.Condition()
         self._event_seq = 0
+        #: highest seq EVICTED from the bounded buffer (0 = nothing yet):
+        #: a watch cursor at or below this has lost events and must relist
+        #: — signalled with 410 Gone, kube-apiserver style, instead of
+        #: silently skipping the gap
+        self._evicted_seq = 0
         self._store_watch = store.watch(list(KIND_REGISTRY))
         self._pump = threading.Thread(
             target=self._pump_events, name="apiserver-watch-pump", daemon=True)
@@ -155,18 +160,24 @@ class ApiServer:
     # -- request handling --------------------------------------------------
 
     def _handle(self, h, method: str) -> None:
+        # errors carry a structured ``reason`` (kube-apiserver Status.reason
+        # analog) so clients branch on it, never on message text — substring
+        # matching misclassified a 422 whose message contained "exists"
         try:
             self._route(h, method)
         except NotFound as e:
-            h._send(404, {"error": str(e)})
-        except (AlreadyExists, Conflict) as e:
-            h._send(409, {"error": str(e)})
+            h._send(404, {"error": str(e), "reason": "NotFound"})
+        except AlreadyExists as e:
+            h._send(409, {"error": str(e), "reason": "AlreadyExists"})
+        except Conflict as e:
+            h._send(409, {"error": str(e), "reason": "Conflict"})
         except Rejected as e:
-            h._send(422, {"error": str(e)})
+            h._send(422, {"error": str(e), "reason": "Invalid"})
         except KeyError as e:
-            h._send(404, {"error": f"unknown kind {e}"})
+            h._send(404, {"error": f"unknown kind {e}", "reason": "NotFound"})
         except Exception as e:  # noqa: BLE001 — surface as 400
-            h._send(400, {"error": f"{type(e).__name__}: {e}"})
+            h._send(400, {"error": f"{type(e).__name__}: {e}",
+                          "reason": "BadRequest"})
 
     def _pump_events(self) -> None:
         import queue as queuelib
@@ -180,6 +191,8 @@ class ApiServer:
                 continue
             with self._events_cond:
                 self._event_seq += 1
+                if len(self._events) == self._events.maxlen:
+                    self._evicted_seq = self._events[0][0]
                 self._events.append((self._event_seq, ev))
                 self._events_cond.notify_all()
 
@@ -195,9 +208,28 @@ class ApiServer:
         with the cursor recovers everything that happened between polls
         (up to the buffer's retention)."""
         deadline = time.monotonic() + min(max(timeout, 0.0), 300.0)
-        if after is None:
-            with self._events_cond:
+        expired = None
+        with self._events_cond:
+            if after is None:
                 after = self._event_seq  # "now": only future events
+            elif after < self._evicted_seq:
+                # the buffer (shared across kinds) rolled past the
+                # client's cursor: some events are GONE — tell the client
+                # (kube-apiserver's 410 Gone) rather than silently
+                # resuming with a hole.  The resync cursor is the
+                # EVICTION BOUNDARY, not the head: re-polling with it
+                # still delivers the whole retained window.
+                expired = {
+                    "error": "watch cursor expired: events up to "
+                             f"seq {self._evicted_seq} were evicted",
+                    "reason": "Expired",
+                    "cursor": self._evicted_seq,
+                }
+        if expired is not None:
+            # socket write happens OUTSIDE the condition: a slow client
+            # must not stall the pump and every other watcher
+            h._send(410, expired)
+            return
 
         def collect():
             return [
@@ -208,13 +240,28 @@ class ApiServer:
 
         with self._events_cond:
             matched = collect()
-            while not matched:
+            while not matched and after >= self._evicted_seq:
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     break
                 self._events_cond.wait(timeout=remaining)
                 matched = collect()
+            if after < self._evicted_seq:
+                # eviction can also happen DURING the wait (a burst rolls
+                # the buffer past our cursor while we park, with or
+                # without retained matches left) — same 410 contract as
+                # at entry; returning retained events here would silently
+                # skip the evicted gap
+                expired = {
+                    "error": "watch cursor expired during poll: events "
+                             f"up to seq {self._evicted_seq} were evicted",
+                    "reason": "Expired",
+                    "cursor": self._evicted_seq,
+                }
             cursor = matched[-1][0] if matched else after
+        if expired is not None:
+            h._send(410, expired)
+            return
         h._send(200, {
             "cursor": cursor,
             "items": [

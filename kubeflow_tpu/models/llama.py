@@ -540,6 +540,38 @@ class Llama(nn.Module):
         return Head(cfg, name="head")(x, table)
 
 
+def block_apply_with_aux(cfg: LlamaConfig, positions):
+    """``block_apply(lp, h) -> (h, aux)`` for the pipeline executors:
+    one Block forward that also surfaces the layer's sown ``moe_aux_loss``
+    (0 for dense layers) — how the Switch balancing loss flows through
+    GPipe/1F1B, where the single-mesh ``mutable=["intermediates"]``
+    collection cannot reach inside the schedule."""
+
+    def apply(layer_params, h):
+        y, mut = Block(cfg).apply(
+            {"params": layer_params}, h, positions,
+            mutable=["intermediates"])
+        leaves = [
+            jnp.sum(v.astype(jnp.float32))
+            for path, v in _flatten(mut.get("intermediates", {}))
+            if "moe_aux_loss" in path
+        ]
+        aux = sum(leaves, jnp.zeros((), jnp.float32))
+        return y, aux
+
+    def _flatten(tree, prefix=()):
+        if isinstance(tree, dict):
+            for k, v in tree.items():
+                yield from _flatten(v, prefix + (k,))
+        elif isinstance(tree, (list, tuple)):
+            for v in tree:
+                yield from _flatten(v, prefix)
+        else:
+            yield prefix, tree
+
+    return apply
+
+
 def pipelined_apply(
     cfg: LlamaConfig,
     params: Any,
@@ -547,7 +579,8 @@ def pipelined_apply(
     *,
     mesh=None,
     num_microbatches: Optional[int] = None,
-) -> jax.Array:
+    with_aux: bool = False,
+):
     """Forward pass with the block stack run as a GPipe microbatch pipeline.
 
     Embedding and head run data-parallel on every device (they are cheap
@@ -556,6 +589,11 @@ def pipelined_apply(
     rule — executes through ``parallel.pipeline.gpipe``.  Numerically
     identical to ``Llama.__call__`` (same blocks, same order), so loss
     trajectories match the single-mesh run.
+
+    ``with_aux=True`` returns ``(logits, aux_mean)`` where ``aux_mean`` is
+    the per-layer-mean MoE load-balancing loss (matching the trainer's
+    single-mesh ``_sum_aux_losses`` normalization: sum over layers and
+    microbatches / (num_layers * num_microbatches)).
     """
     from ..parallel import pipeline as pipelib
 
@@ -565,15 +603,80 @@ def pipelined_apply(
     positions = jnp.arange(tokens.shape[-1])[None, :]
     x = Embedder(cfg).apply({"params": params["embedder"]}, tokens)
 
-    def block_apply(layer_params, h):
-        return Block(cfg).apply({"params": layer_params}, h, positions)
+    if with_aux:
+        block_apply = block_apply_with_aux(cfg, positions)
+    else:
+        def block_apply(layer_params, h):
+            return Block(cfg).apply({"params": layer_params}, h, positions)
 
-    x = pipelib.gpipe(
+    out = pipelib.gpipe(
         block_apply, params["layers"]["block"], x,
         mesh=mesh, num_microbatches=num_microbatches, remat=cfg.remat,
+        with_aux=with_aux,
     )
     table = params["embedder"]["embedding"] if cfg.tie_embeddings else None
-    return Head(cfg).apply({"params": params["head"]}, x, table)
+    if with_aux:
+        x, aux_sum = out
+        # normalization must match how many passes actually contributed:
+        # the degree-1 fallback runs ONE pass regardless of the requested
+        # microbatch count (dividing by it would silently under-weight
+        # the balancing loss)
+        deg = pipelib.pipeline_degree(mesh or pipelib.current_mesh())
+        m = (num_microbatches or deg) if deg > 1 else 1
+        aux = aux_sum / (cfg.num_layers * m)
+        return Head(cfg).apply({"params": params["head"]}, x, table), aux
+    return Head(cfg).apply({"params": params["head"]}, out, table)
+
+
+def save_pretrained(path: str, cfg: LlamaConfig, params: Any) -> None:
+    """Write an HF-layout snapshot: ``config.json`` + ``weights.msgpack``
+    (flax serialization) — the same layout ``models/bert.py`` uses and
+    what ``hf://`` snapshots under $KFT_HF_HOME contain.  This is the
+    publish side of the north-star fine-tune UX [upstream:
+    training-operator -> sdk train() v1.9 LLM path, SURVEY.md §3.5]:
+    ``load_pretrained`` (or ``KFT_INIT_FROM``) reads it back."""
+    import json
+    import os
+
+    from flax import serialization
+    from flax import linen as fnn
+
+    os.makedirs(path, exist_ok=True)
+    d = dataclasses.asdict(cfg)
+    d["dtype"] = jnp.dtype(cfg.dtype).name
+    d["param_dtype"] = jnp.dtype(cfg.param_dtype).name
+    with open(os.path.join(path, "config.json"), "w") as f:
+        json.dump(d, f, indent=1)
+    with open(os.path.join(path, "weights.msgpack"), "wb") as f:
+        f.write(serialization.msgpack_serialize(
+            jax.tree.map(jax.device_get, fnn.meta.unbox(params))))
+
+
+def load_pretrained_config(path: str) -> LlamaConfig:
+    """The snapshot's architecture, without touching the weights (cheap on
+    every process; weight loading happens once per host at init)."""
+    import json
+    import os
+
+    with open(os.path.join(path, "config.json")) as f:
+        d = json.load(f)
+    d["dtype"] = jnp.dtype(d["dtype"])
+    d["param_dtype"] = jnp.dtype(d["param_dtype"])
+    return LlamaConfig(**d)
+
+
+def load_pretrained(path: str) -> tuple[LlamaConfig, Any]:
+    """Read a snapshot written by ``save_pretrained`` (or any directory in
+    that layout) into (config, params) — params are plain host arrays,
+    ready for ``jax.device_put`` onto any mesh's shardings."""
+    import os
+
+    from flax import serialization
+
+    cfg = load_pretrained_config(path)
+    with open(os.path.join(path, "weights.msgpack"), "rb") as f:
+        params = serialization.msgpack_restore(f.read())
+    return cfg, params
 
 
 def num_params(cfg: LlamaConfig) -> int:

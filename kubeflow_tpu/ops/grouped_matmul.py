@@ -50,17 +50,50 @@ def _block(dim: int, want: int) -> int:
     return max(b, 1)
 
 
+#: (tm, tk, tn) tile REQUEST for the megablox kernels (clamped per-shape
+#: by _block); tune via set_gmm_tiling or $KFT_GMM_TILING="tm,tk,tn" —
+#: scripts/moe_bench.py --sweep measures the candidates on the real chip
+#: and PERF.md records the chosen default.
+_TILING = (128, 128, 128)
+#: accumulator dtype for the gmm products.  f32 is the safe default; the
+#: bf16 lever halves accumulator traffic but loses mantissa on long
+#: k-reductions — measured, not assumed (moe_bench --sweep).
+_ACC_DTYPE = jnp.float32
+
+
+def set_gmm_tiling(tm: int, tk: int, tn: int, acc_dtype=None) -> None:
+    """Override the grouped-GEMM tile request (and optionally the
+    accumulator dtype) — the tuning surface the MoE bench sweeps."""
+    global _TILING, _ACC_DTYPE
+    _TILING = (int(tm), int(tk), int(tn))
+    if acc_dtype is not None:
+        _ACC_DTYPE = acc_dtype
+
+
+def _env_tiling() -> None:
+    import os
+
+    spec = os.environ.get("KFT_GMM_TILING")
+    if spec:
+        tm, tk, tn = (int(v) for v in spec.split(","))
+        set_gmm_tiling(tm, tk, tn)
+
+
+_env_tiling()
+
+
 def _gmm(x, w, offsets):
     """Raw megablox call + the no-group-row contract: the kernel never
     visits tiles past the last group, so those output rows come back as
     uninitialized memory — pin them to zeros."""
     b, h = x.shape
     m = w.shape[-1]
+    tm, tk, tn = _TILING
     sizes = jnp.diff(offsets).astype(jnp.int32)
     out = _mb.gmm(
         x, w, sizes,
-        preferred_element_type=jnp.float32,
-        tiling=(_block(b, 128), _block(h, 128), _block(m, 128)),
+        preferred_element_type=_ACC_DTYPE,
+        tiling=(_block(b, tm), _block(h, tk), _block(m, tn)),
         interpret=_interpret(),
     )
     rows = jnp.arange(b, dtype=jnp.int32)
@@ -97,10 +130,11 @@ def _vjp_bwd(res, g):
     dx = _gmm(g.astype(x.dtype), jnp.swapaxes(w, 1, 2), offsets)
     # dw[e] = x_e^T @ g_e (the transposed grouped matmul); empty groups'
     # blocks are unvisited -> pin to zero
+    tm, tk, tn = _TILING
     dw = _mb.tgmm(
         x.swapaxes(0, 1), g.astype(x.dtype), sizes,
-        preferred_element_type=jnp.float32,
-        tiling=(_block(h, 128), _block(b, 128), _block(m, 128)),
+        preferred_element_type=_ACC_DTYPE,
+        tiling=(_block(h, tk), _block(b, tm), _block(m, tn)),
         interpret=_interpret(),
     )
     dw = jnp.where(sizes[:, None, None] > 0, dw, 0.0).astype(w.dtype)

@@ -49,7 +49,8 @@ def gpipe(
     mesh: Optional[Mesh] = None,
     num_microbatches: Optional[int] = None,
     remat: bool = True,
-) -> jax.Array:
+    with_aux: bool = False,
+):
     """Run ``num_layers`` blocks over ``x`` as a P-stage microbatch pipeline.
 
     ``block_apply(layer_params, x) -> x`` applies ONE block given one
@@ -59,22 +60,36 @@ def gpipe(
     ``x``: [batch, ...] activations, batch divisible by the microbatch
     count (default: the pipeline degree).
 
+    With ``with_aux=True``, ``block_apply(lp, x) -> (x, aux_scalar)`` and
+    the call returns ``(out, aux_sum)`` where ``aux_sum`` is the sum of
+    every block's aux over all layers and microbatches — garbage
+    fill/drain ticks are masked out, and the sum is differentiable, so a
+    MoE load-balancing loss collected this way trains exactly like the
+    single-mesh path (SURVEY §2.5 EP x PP composition).
+
     Falls back to a plain sequential scan when no pipeline axis is active,
     so callers can use it unconditionally.
     """
     mesh = mesh or current_mesh()
     p_size = pipeline_degree(mesh)
 
+    if not with_aux:
+        plain = block_apply
+        block_apply = lambda lp, h: (plain(lp, h), jnp.zeros((), jnp.float32))  # noqa: E731
+
     one = jax.checkpoint(block_apply) if remat else block_apply
 
     def apply_stage(layers, h):
-        def body(h, lp):
-            return one(lp, h), None
-        h, _ = lax.scan(body, h, layers)
-        return h
+        def body(carry, lp):
+            h, aux = carry
+            h, a = one(lp, h)
+            return (h, aux + a.astype(jnp.float32)), None
+        (h, aux), _ = lax.scan(body, (h, jnp.zeros((), jnp.float32)), layers)
+        return h, aux
 
     if p_size == 1:
-        return apply_stage(stacked_params, x)
+        out, aux = apply_stage(stacked_params, x)
+        return (out, aux) if with_aux else out
 
     m = num_microbatches or p_size
     batch = x.shape[0]
@@ -97,12 +112,16 @@ def gpipe(
         out_buf = jnp.zeros_like(x_mb)
 
         def tick(carry, t):
-            state, out_buf = carry
+            state, out_buf, aux_acc = carry
             # stage 0 ingests microbatch t during the fill/steady phase
             inp = lax.dynamic_index_in_dim(
                 x_mb, jnp.clip(t, 0, m - 1), 0, keepdims=False)
             cur = jnp.where(stage == 0, inp, state)
-            y = apply_stage(local_layers, cur)
+            y, aux = apply_stage(local_layers, cur)
+            # a stage's tick t processes microbatch t - stage; outside
+            # [0, m) it is fill/drain garbage whose aux must not count
+            real = jnp.logical_and(t - stage >= 0, t - stage < m)
+            aux_acc = aux_acc + jnp.where(real, aux, 0.0)
             # last stage emits microbatch t-(P-1) once the fill completes
             widx = t - (p_size - 1)
             upd = lax.dynamic_update_index_in_dim(
@@ -110,27 +129,52 @@ def gpipe(
             emit = jnp.logical_and(widx >= 0, stage == p_size - 1)
             out_buf = jnp.where(emit, upd, out_buf)
             nxt = lax.ppermute(y, AXIS, perm)
-            return (nxt, out_buf), None
+            return (nxt, out_buf, aux_acc), None
 
-        (_, out_buf), _ = lax.scan(
-            tick, (state, out_buf), jnp.arange(m + p_size - 1))
+        (_, out_buf, aux_acc), _ = lax.scan(
+            tick, (state, out_buf, jnp.zeros((), jnp.float32)),
+            jnp.arange(m + p_size - 1))
         # broadcast the finished buffer from the last stage to every rank
-        # (the head/loss run data-parallel on all devices afterwards)
+        # (the head/loss run data-parallel on all devices afterwards);
+        # aux sums over stages (each stage owns its layers' aux)
         out_buf = lax.psum(
             jnp.where(stage == p_size - 1, out_buf, jnp.zeros_like(out_buf)),
             AXIS,
         )
-        return out_buf
+        return out_buf, lax.psum(aux_acc, AXIS)
 
-    out = jax.shard_map(
+    out, aux = jax.shard_map(
         body,
         mesh=mesh,
         in_specs=(layer_specs, P()),
-        out_specs=P(),
+        out_specs=(P(), P()),
         axis_names={AXIS},
         check_vma=False,
     )(stacked_params, x_mb)
-    return out.reshape(batch, *x.shape[1:])
+    out = out.reshape(batch, *x.shape[1:])
+    return (out, aux) if with_aux else out
+
+
+def interleave_permutation(num_layers: int, p: int, v: int) -> np.ndarray:
+    """Layer-axis permutation for the interleaved executor.
+
+    ``perm[new] = canonical`` such that taking the canonical stacked
+    layers at ``perm`` yields device-contiguous storage: device d's slice
+    holds model chunks {d, P+d, ..., (V-1)P+d} in local order.  The
+    inverse (for gradients) is ``np.argsort(perm)``.  On TPU this is one
+    weight reshard per step (cheap over ICI; over DCN it is charged in
+    the projection model — BASELINE.md).
+    """
+    if num_layers <= 0 or num_layers % (p * v):
+        raise ValueError(
+            f"{num_layers} layers not divisible by {p} stages x {v} chunks")
+    cl = num_layers // (p * v)
+    order = []
+    for d in range(p):
+        for lv in range(v):
+            c = lv * p + d
+            order.extend(range(c * cl, (c + 1) * cl))
+    return np.asarray(order, np.int32)
 
 
 # -- 1F1B (perf-grade schedule) ---------------------------------------------
@@ -138,23 +182,43 @@ def gpipe(
 
 @dataclasses.dataclass(frozen=True)
 class Schedule1F1B:
-    """Static 1F1B tick tables for ``p`` stages x ``m`` microbatches.
+    """Static 1F1B tick tables for ``p`` devices x ``m`` microbatches x
+    ``v`` virtual stages per device (Megatron interleaving; v=1 is the
+    classic non-interleaved schedule).
 
-    Each tick is one fwd slot + one bwd slot per stage (the steady-state
-    1F1B pattern).  ``fwd[t, s]`` / ``bwd[t, s]`` give the microbatch index
-    each stage processes at tick ``t`` (-1 = idle slot); ``recv_act`` /
-    ``recv_grad`` give the microbatch whose activation/cotangent arrives
-    over the ppermute ring that tick.  ``act_slots`` / ``grad_slots`` are
-    the stash capacities the schedule provably needs — the 1F1B memory
-    bound (≈ P in-flight microbatches per stage, vs GPipe's M).
+    The model's ``p*v`` chunks are assigned round-robin: chunk ``c`` runs
+    on device ``c % p`` as its local chunk ``c // p`` — consecutive chunks
+    sit on consecutive devices, so activations ride the same +1 ppermute
+    ring (with a wraparound edge for the chunk-(kP-1) -> chunk-(kP)
+    transition).  Each tick is one chunk-fwd slot + one chunk-bwd slot
+    per device; tables give, per [tick, device]:
+
+    - ``fwd``/``fwd_lv``: microbatch + local chunk of the fwd slot (-1 idle)
+    - ``fwd_slot``: act-stash slot holding the input (-1 = read x_mb,
+      i.e. model chunk 0)
+    - ``fwd_seed_slot``: grad-stash slot to seed with the loss cotangent
+      (>=0 only when the slot forwards the LAST model chunk)
+    - ``bwd``/``bwd_lv``/``bwd_slot``/``bwd_gslot``: same for the bwd slot
+    - ``ra_slot``/``rg_slot``: stash slot the activation/cotangent
+      arriving over the ring this tick is written to (-1 = ignore)
+
+    ``act_slots``/``grad_slots`` are exact stash high-waters from the
+    simulation — the schedule's memory bound, reported (not assumed).
     """
 
     p: int
     m: int
-    fwd: np.ndarray        # [T, P] int32
-    bwd: np.ndarray        # [T, P] int32
-    recv_act: np.ndarray   # [T, P] int32
-    recv_grad: np.ndarray  # [T, P] int32
+    v: int
+    fwd: np.ndarray           # [T, P] microbatch (-1 idle)
+    fwd_lv: np.ndarray        # [T, P] local chunk index
+    fwd_slot: np.ndarray      # [T, P] act slot (-1 = x_mb)
+    fwd_seed_slot: np.ndarray  # [T, P] grad slot to seed (-1 = not last)
+    bwd: np.ndarray
+    bwd_lv: np.ndarray
+    bwd_slot: np.ndarray
+    bwd_gslot: np.ndarray
+    ra_slot: np.ndarray
+    rg_slot: np.ndarray
     act_slots: int
     grad_slots: int
 
@@ -164,113 +228,201 @@ class Schedule1F1B:
 
     @property
     def useful_fraction(self) -> float:
-        """Filled fwd+bwd slots over total slots (1 - bubble fraction)."""
+        """Filled fwd+bwd slots over total slots (1 - bubble fraction).
+        Slot units are CHUNK work items: at v>1 a device fills m*v of
+        each direction, so fractions compare across v."""
         filled = int((self.fwd >= 0).sum() + (self.bwd >= 0).sum())
         return filled / (2 * self.ticks * self.p)
 
 
-def schedule_1f1b(p: int, m: int) -> Schedule1F1B:
-    """Simulate the 1F1B schedule event-by-event and emit static tables.
+class _SlotPool:
+    """Exact slot allocator: reuse the lowest free slot, track high-water."""
 
-    Rules (classic non-interleaved 1F1B, Megatron-style, adapted to a
-    lockstep SPMD program with a 1-tick ppermute latency):
+    def __init__(self):
+        self.free: list[int] = []
+        self.next = 0
+        self.high = 0
 
-    - a stage forwards microbatches in order as their activations arrive,
-      but holds at most ``P - s + 2`` in flight (the 1F1B throttle — this
-      is what bounds activation memory; the +2 absorbs the two-tick
-      send/receive round trip, reaching the zero-latency schedule length
-      T = M + 2(P-1) at a stash cost of ~2 extra microbatches);
-    - a stage backwards microbatches in order as cotangents arrive; the
-      last stage seeds its own cotangent from the loss at forward time,
-      so it can run fwd(m) and bwd(m) in the same tick;
-    - within a tick, the fwd slot runs before the bwd slot, and a
+    def alloc(self) -> int:
+        if self.free:
+            return self.free.pop()
+        s = self.next
+        self.next += 1
+        self.high = max(self.high, self.next)
+        return s
+
+    def release(self, s: int) -> None:
+        self.free.append(s)
+
+
+def schedule_1f1b(p: int, m: int, v: int = 1,
+                  cap: Optional[int] = None) -> Schedule1F1B:
+    """Simulate the (interleaved) 1F1B schedule event-by-event and emit
+    static tick tables plus exact stash-slot assignments.
+
+    Rules (Megatron-style, adapted to a lockstep SPMD program with a
+    1-tick ppermute latency):
+
+    - fwd work follows the Megatron interleaved order — rounds of P
+      microbatches per chunk, lower chunks first within a round — as
+      activations arrive, throttled so a device holds at most ``cap``
+      forwarded-not-yet-backwarded chunk inputs (the memory throttle;
+      default p+2 at v=1, p+2+(v-1) interleaved);
+    - bwd work follows the mirrored order (higher chunks first within a
+      round) as cotangents arrive; the device owning the LAST model chunk
+      seeds that cotangent from the loss at forward time, so it can run
+      fwd and bwd of the same microbatch in one tick;
+    - within a tick the fwd slot runs before the bwd slot, and a
       bwd-completing-this-tick frees its in-flight slot for the fwd
       admission check.
     """
-    if p < 1 or m < 1:
-        raise ValueError("need p >= 1 and m >= 1")
-    cap = [min(p - s + 2, m) for s in range(p)]
-    next_f, next_b = [0] * p, [0] * p
-    recv_act = [set() for _ in range(p)]
-    recv_grad = [set() for _ in range(p)]
-    fwd_tick = [[-1] * m for _ in range(p)]
-    bwd_tick = [[-1] * m for _ in range(p)]
-    frows, brows = [], []
+    if p < 1 or m < 1 or v < 1:
+        raise ValueError("need p >= 1, m >= 1, v >= 1")
+    C = p * v
+    # default throttle = the warmup depth the latency-optimal schedule
+    # needs (p*v + 2; p + 2 at v=1, the classic 1F1B bound).  The
+    # simulator achieves the model's exact lower bound T = mv + p + pv - 2
+    # at this cap (see PERF.md "interleaved 1F1B" for the bound's proof).
+    cap = cap if cap is not None else min(p * v + 2, m * v)
+
+    next_f = [0] * C
+    next_b = [0] * C
+    recv_act = [set() for _ in range(C)]   # mb whose input arrived
+    recv_grad = [set() for _ in range(C)]  # mb whose cotangent arrived
+    fwd_done = [set() for _ in range(C)]
+    act_slot_of: dict[tuple[int, int], int] = {}
+    grad_slot_of: dict[tuple[int, int], int] = {}
+    act_pool = [_SlotPool() for _ in range(p)]
+    grad_pool = [_SlotPool() for _ in range(p)]
+
+    def fkey(c: int, mb: int) -> tuple:
+        # Megatron interleaved fwd order: rounds of p microbatches per
+        # chunk, chunk-major within the round
+        return (mb // p, c // p, mb % p, c)
+
+    def bkey(c: int, mb: int) -> tuple:
+        # mirrored for bwd: higher chunks drain first within a round
+        return (mb // p, (v - 1) - c // p, mb % p, c)
+
+    rows: dict[str, list] = {k: [] for k in (
+        "fwd", "fwd_lv", "fwd_slot", "fwd_seed_slot",
+        "bwd", "bwd_lv", "bwd_slot", "bwd_gslot", "ra", "rg")}
+    # deliveries computed at tick t land in the tables at t+1
+    pending_ra = [-1] * p
+    pending_rg = [-1] * p
+
     t = 0
     while any(nb < m for nb in next_b):
-        frow, brow = [-1] * p, [-1] * p
-        for s in range(p):
-            f, b = next_f[s], next_b[s]
-            # tentative bwd readiness (ignoring this tick's own fwd)
-            ready0 = b < m and (
-                (s < p - 1 and b in recv_grad[s])
-                or (s == p - 1 and fwd_tick[s][b] >= 0)
-            )
-            in_flight = f - b
-            if (
-                f < m
-                and (s == 0 or f in recv_act[s])
-                and in_flight - (1 if ready0 else 0) < cap[s]
-            ):
-                frow[s] = f
-            ready = b < m and (
-                (s < p - 1 and b in recv_grad[s])
-                or (s == p - 1 and (fwd_tick[s][b] >= 0 or frow[s] == b))
-            )
-            if ready:
-                brow[s] = b
-        for s in range(p):
-            if frow[s] >= 0:
-                fwd_tick[s][frow[s]] = t
-                next_f[s] += 1
-            if brow[s] >= 0:
-                bwd_tick[s][brow[s]] = t
-                next_b[s] += 1
-        # deliveries land next tick (decisions above read pre-tick state)
-        for s in range(p):
-            if frow[s] >= 0 and s + 1 < p:
-                recv_act[s + 1].add(frow[s])
-            if brow[s] >= 0 and s - 1 >= 0:
-                recv_grad[s - 1].add(brow[s])
-        frows.append(frow)
-        brows.append(brow)
+        frow = [-1] * p
+        flv = [-1] * p
+        fslot = [-1] * p
+        fseed = [-1] * p
+        brow = [-1] * p
+        blv = [-1] * p
+        bslot = [-1] * p
+        bgslot = [-1] * p
+        rows["ra"].append(list(pending_ra))
+        rows["rg"].append(list(pending_rg))
+        pending_ra = [-1] * p
+        pending_rg = [-1] * p
+
+        fwd_chosen: list[Optional[tuple[int, int]]] = [None] * p
+        bwd_chosen: list[Optional[tuple[int, int]]] = [None] * p
+        for d in range(p):
+            chunks = [c for c in range(d, C, p)]
+            # tentative bwd readiness (ignoring this tick's own fwd seed)
+            ready0 = [
+                (c, next_b[c]) for c in chunks
+                if next_b[c] < m and (
+                    (c < C - 1 and next_b[c] in recv_grad[c])
+                    or (c == C - 1 and next_b[c] in fwd_done[c]))
+            ]
+            in_flight = sum(next_f[c] - next_b[c] for c in chunks)
+            fcands = [
+                (c, next_f[c]) for c in chunks
+                if next_f[c] < m and next_f[c] < next_b[c] + m  # sanity
+                and (c == 0 or next_f[c] in recv_act[c])
+            ]
+            if fcands and in_flight - (1 if ready0 else 0) < cap:
+                c, mb = min(fcands, key=lambda cm: fkey(*cm))
+                fwd_chosen[d] = (c, mb)
+            # bwd: include a same-tick seed from this tick's fwd
+            bcands = list(ready0)
+            fc = fwd_chosen[d]
+            if (fc is not None and fc[0] == C - 1
+                    and next_b[C - 1] == fc[1]
+                    and all((cc, mm) != fc for cc, mm in bcands)):
+                bcands.append(fc)
+            if bcands:
+                bwd_chosen[d] = min(bcands, key=lambda cm: bkey(*cm))
+
+        for d in range(p):
+            fc = fwd_chosen[d]
+            if fc is not None:
+                c, mb = fc
+                frow[d], flv[d] = mb, c // p
+                fslot[d] = act_slot_of.get((c, mb), -1) if c > 0 else -1
+                next_f[c] += 1
+                fwd_done[c].add(mb)
+                if c == C - 1:
+                    s = grad_pool[d].alloc()
+                    grad_slot_of[(c, mb)] = s
+                    fseed[d] = s
+                    recv_grad[c].add(mb)
+            bc = bwd_chosen[d]
+            if bc is not None:
+                c, mb = bc
+                brow[d], blv[d] = mb, c // p
+                bslot[d] = act_slot_of.get((c, mb), -1) if c > 0 else -1
+                bgslot[d] = grad_slot_of[(c, mb)]
+                next_b[c] += 1
+                # frees happen at end of tick (slot read during the tick)
+
+        # deliveries (land next tick) + slot frees
+        for d in range(p):
+            fc = fwd_chosen[d]
+            if fc is not None:
+                c, mb = fc
+                if c + 1 < C:
+                    d2 = (c + 1) % p
+                    s = act_pool[d2].alloc()
+                    act_slot_of[(c + 1, mb)] = s
+                    pending_ra[d2] = s
+                    recv_act[c + 1].add(mb)
+            bc = bwd_chosen[d]
+            if bc is not None:
+                c, mb = bc
+                if c - 1 >= 0:
+                    d2 = (c - 1) % p
+                    s = grad_pool[d2].alloc()
+                    grad_slot_of[(c - 1, mb)] = s
+                    pending_rg[d2] = s
+                    recv_grad[c - 1].add(mb)
+                # free the consumed stash entries
+                if c > 0:
+                    act_pool[d].release(act_slot_of.pop((c, mb)))
+                grad_pool[d].release(grad_slot_of.pop((c, mb)))
+
+        for key, row in (("fwd", frow), ("fwd_lv", flv), ("fwd_slot", fslot),
+                         ("fwd_seed_slot", fseed), ("bwd", brow),
+                         ("bwd_lv", blv), ("bwd_slot", bslot),
+                         ("bwd_gslot", bgslot)):
+            rows[key].append(row)
         t += 1
-        if t > 4 * (m + p) + 16:
-            raise RuntimeError(f"1F1B schedule deadlocked at p={p} m={m}")
+        if t > 4 * (m * v + p) + 16 * v:
+            raise RuntimeError(
+                f"1F1B schedule deadlocked at p={p} m={m} v={v} cap={cap}")
 
-    T = len(frows)
-    fwd = np.array(frows, np.int32)
-    bwd = np.array(brows, np.int32)
-    ra = np.full((T, p), -1, np.int32)
-    rg = np.full((T, p), -1, np.int32)
-    for tt in range(1, T):
-        for s in range(p):
-            if s > 0:
-                ra[tt, s] = fwd[tt - 1, s - 1]
-            if s < p - 1:
-                rg[tt, s] = bwd[tt - 1, s + 1]
-
-    def max_overlap(intervals: list[tuple[int, int]]) -> int:
-        best = 0
-        for i, (lo, _) in enumerate(intervals):
-            live = sum(1 for lo2, hi2 in intervals if lo2 <= lo <= hi2)
-            best = max(best, live)
-        return best
-
-    act_slots = 1
-    grad_slots = 1
-    for s in range(p):
-        if s > 0:
-            ivs = [(fwd_tick[s - 1][mb] + 1, bwd_tick[s][mb]) for mb in range(m)]
-            act_slots = max(act_slots, max_overlap(ivs))
-        if s < p - 1:
-            ivs = [(bwd_tick[s + 1][mb] + 1, bwd_tick[s][mb]) for mb in range(m)]
-        else:
-            ivs = [(fwd_tick[s][mb], bwd_tick[s][mb]) for mb in range(m)]
-        grad_slots = max(grad_slots, max_overlap(ivs))
+    arr = {k: np.array(rows[k], np.int32) for k in rows}
     return Schedule1F1B(
-        p=p, m=m, fwd=fwd, bwd=bwd, recv_act=ra, recv_grad=rg,
-        act_slots=act_slots, grad_slots=grad_slots,
+        p=p, m=m, v=v,
+        fwd=arr["fwd"], fwd_lv=arr["fwd_lv"], fwd_slot=arr["fwd_slot"],
+        fwd_seed_slot=arr["fwd_seed_slot"],
+        bwd=arr["bwd"], bwd_lv=arr["bwd_lv"], bwd_slot=arr["bwd_slot"],
+        bwd_gslot=arr["bwd_gslot"],
+        ra_slot=arr["ra"], rg_slot=arr["rg"],
+        act_slots=max(1, max(pl.high for pl in act_pool)),
+        grad_slots=max(1, max(pl.high for pl in grad_pool)),
     )
 
 
@@ -285,6 +437,9 @@ def one_f_one_b(
     mesh: Optional[Mesh] = None,
     num_microbatches: Optional[int] = None,
     remat: bool = True,
+    with_aux: bool = False,
+    aux_weight: float = 0.0,
+    interleave: int = 1,
 ):
     """Loss **and grads** of a staged block stack under the 1F1B schedule.
 
@@ -307,21 +462,43 @@ def one_f_one_b(
     microbatch the head overhead multiplies).  ``loss_args`` is a pytree
     whose leaves lead with the batch dim (e.g. targets), microbatched
     like ``x``.
+
+    ``interleave=V`` runs the Megatron interleaved schedule: each device
+    owns V non-contiguous model chunks (chunk c on device c % P), cutting
+    the fill/drain bubble from P-1 stage-times to P-1 CHUNK-times —
+    useful fraction MV/(MV+2(P-1)) vs M/(M+2(P-1)).  NOTE the layer
+    assignment: the executor interprets each device's contiguous
+    ``stacked_params`` slice as its V chunks in local order, i.e. device
+    d's layers serve model chunks {d, P+d, ..., (V-1)P+d}.  Callers that
+    need canonical model order (the trainer) must permute the stacked
+    layer axis accordingly before the call and unpermute the gradients
+    after (``interleave_permutation``).
+
+    ``with_aux=True``: ``block_apply(lp, h) -> (h, aux_scalar)`` and the
+    total loss gains ``aux_weight * sum(aux over layers, microbatches)``;
+    the aux gradient rides the schedule's own backward VJPs.
     """
     mesh = mesh or current_mesh()
     p_size = pipeline_degree(mesh)
 
+    if not with_aux:
+        plain = block_apply
+        block_apply = lambda lp, h: (plain(lp, h), jnp.zeros((), jnp.float32))  # noqa: E731
+
     one = jax.checkpoint(block_apply) if remat else block_apply
 
     def apply_stage(layers, h):
-        def body(h, lp):
-            return one(lp, h), None
-        h, _ = lax.scan(body, h, layers)
-        return h
+        def body(carry, lp):
+            h, aux = carry
+            h, a = one(lp, h)
+            return (h, aux + a.astype(jnp.float32)), None
+        (h, aux), _ = lax.scan(body, (h, jnp.zeros((), jnp.float32)), layers)
+        return h, aux
 
     if p_size == 1:
         def seq_loss(sp, hp, xx):
-            return loss_fn(hp, apply_stage(sp, xx), loss_args)
+            y, aux = apply_stage(sp, xx)
+            return loss_fn(hp, y, loss_args) + aux_weight * aux
         loss, grads = jax.value_and_grad(seq_loss, argnums=(0, 1, 2))(
             stacked_params, head_params, x)
         return loss, grads
@@ -335,31 +512,44 @@ def one_f_one_b(
         lambda a: a.reshape(m, batch // m, *a.shape[1:]), loss_args)
 
     num_layers = jax.tree.leaves(stacked_params)[0].shape[0]
-    if num_layers % p_size:
+    if num_layers % (p_size * interleave):
         raise ValueError(
-            f"{num_layers} layers not divisible by {p_size} pipeline stages")
+            f"{num_layers} layers not divisible by {p_size} stages x "
+            f"{interleave} virtual chunks")
 
-    sched = schedule_1f1b(p_size, m)
+    sched = schedule_1f1b(p_size, m, v=interleave)
     C, Cg = sched.act_slots, sched.grad_slots
-    fwd_tbl = jnp.asarray(sched.fwd)
-    bwd_tbl = jnp.asarray(sched.bwd)
-    ra_tbl = jnp.asarray(sched.recv_act)
-    rg_tbl = jnp.asarray(sched.recv_grad)
+    cl = num_layers // (p_size * interleave)  # layers per chunk
+    tbls = tuple(jnp.asarray(a) for a in (
+        sched.fwd, sched.fwd_lv, sched.fwd_slot, sched.fwd_seed_slot,
+        sched.bwd, sched.bwd_lv, sched.bwd_slot, sched.bwd_gslot,
+        sched.ra_slot, sched.rg_slot))
 
     layer_specs = jax.tree.map(lambda _: P(AXIS), stacked_params)
-    perm_fwd = [(i, i + 1) for i in range(p_size - 1)]
-    perm_bwd = [(i + 1, i) for i in range(p_size - 1)]
+    # full +1 / -1 rings: the wraparound edges carry the interleaved
+    # chunk-(kP-1) -> chunk-(kP) handoff; at v=1 the wrap value is simply
+    # ignored by the recv tables
+    perm_fwd = [(i, (i + 1) % p_size) for i in range(p_size)]
+    perm_bwd = [((i + 1) % p_size, i) for i in range(p_size)]
 
     def body(local_layers, head_p, x_mb, args_mb):
         stage = lax.axis_index(AXIS)
-        is_last = stage == p_size - 1
         mb_shape = x_mb.shape[1:]
+
+        def chunk_apply(layers_full, h, lv):
+            """One model CHUNK (cl layers at local offset lv) — the unit
+            the interleaved schedule executes; v=1 makes it the stage."""
+            layers_c = jax.tree.map(
+                lambda a: lax.dynamic_slice_in_dim(a, lv * cl, cl, axis=0),
+                layers_full)
+            return apply_stage(layers_c, h)
 
         acts_buf = jnp.zeros((C, *mb_shape), x_mb.dtype)
         grads_buf = jnp.zeros((Cg, *mb_shape), x_mb.dtype)
         y_prev = jnp.zeros(mb_shape, x_mb.dtype)
         dh_prev = jnp.zeros(mb_shape, x_mb.dtype)
         loss_acc = jnp.zeros((), jnp.float32)
+        aux_acc = jnp.zeros((), jnp.float32)
         dlayers_acc = jax.tree.map(
             lambda l: jnp.zeros(l.shape, l.dtype), local_layers)
         dhead_acc = jax.tree.map(
@@ -370,74 +560,80 @@ def one_f_one_b(
 
         def tick(carry, rows):
             (acts_buf, grads_buf, y_prev, dh_prev,
-             loss_acc, dlayers_acc, dhead_acc, dx_buf) = carry
-            f_row, b_row, ra_row, rg_row = rows
-            f = jnp.take(f_row, stage)
-            b = jnp.take(b_row, stage)
-            ra = jnp.take(ra_row, stage)
-            rg = jnp.take(rg_row, stage)
+             loss_acc, aux_acc, dlayers_acc, dhead_acc, dx_buf) = carry
+            (f, f_lv, f_slot, f_seed, b, b_lv, b_slot, b_gslot,
+             ra, rg) = (jnp.take(r, stage) for r in rows)
 
-            # 1. receive activation sent by upstream last tick
+            # 1. receive activation sent over the ring last tick
             in_act = lax.ppermute(y_prev, AXIS, perm_fwd)
-            slot_ra = jnp.maximum(ra, 0) % C
-            acts_buf = acts_buf.at[slot_ra].set(
-                jnp.where(ra >= 0, in_act, acts_buf[slot_ra]))
-            # 2. receive cotangent sent by downstream last tick
+            sra = jnp.maximum(ra, 0)
+            acts_buf = acts_buf.at[sra].set(
+                jnp.where(ra >= 0, in_act, acts_buf[sra]))
+            # 2. receive cotangent sent over the reverse ring last tick
             in_grad = lax.ppermute(dh_prev, AXIS, perm_bwd)
-            slot_rg = jnp.maximum(rg, 0) % Cg
-            grads_buf = grads_buf.at[slot_rg].set(
-                jnp.where(rg >= 0, in_grad, grads_buf[slot_rg]))
+            srg = jnp.maximum(rg, 0)
+            grads_buf = grads_buf.at[srg].set(
+                jnp.where(rg >= 0, in_grad, grads_buf[srg]))
 
-            # 3. forward slot (masked garbage when f == -1)
-            fidx = jnp.maximum(f, 0)
+            # 3. forward slot (masked garbage when f == -1);
+            #    f_slot == -1 means "input is x_mb" (model chunk 0)
+            fidx = jnp.clip(jnp.maximum(f, 0), 0, m - 1)
             h_in_f = jnp.where(
-                stage == 0, x_mb[jnp.clip(fidx, 0, m - 1)], acts_buf[fidx % C])
-            y = apply_stage(local_layers, h_in_f)
-            # last stage seeds its own cotangent from the loss
-            a_f = jax.tree.map(lambda a: a[jnp.clip(fidx, 0, m - 1)], args_mb)
+                f_slot < 0, x_mb[fidx], acts_buf[jnp.maximum(f_slot, 0)])
+            y, aux_f = chunk_apply(local_layers, h_in_f, jnp.maximum(f_lv, 0))
+            # aux counts only real forward slots (f == -1 is bubble junk)
+            aux_acc = aux_acc + jnp.where(f >= 0, aux_f, 0.0)
+            # the LAST model chunk seeds its own cotangent from the loss
+            a_f = jax.tree.map(lambda a: a[fidx], args_mb)
             loss_f, (dy_f, dhead_f) = loss_vag(head_p, y, a_f)
-            seed = jnp.logical_and(is_last, f >= 0)
-            slot_f = fidx % Cg
-            grads_buf = grads_buf.at[slot_f].set(
+            seed = f_seed >= 0
+            sfs = jnp.maximum(f_seed, 0)
+            grads_buf = grads_buf.at[sfs].set(
                 jnp.where(seed, (dy_f / m).astype(grads_buf.dtype),
-                          grads_buf[slot_f]))
+                          grads_buf[sfs]))
             loss_acc = loss_acc + jnp.where(seed, loss_f / m, 0.0)
             dhead_acc = jax.tree.map(
                 lambda a, g: a + jnp.where(seed, g / m, 0.0).astype(a.dtype),
                 dhead_acc, dhead_f)
 
-            # 4. backward slot: re-run the stage fwd from the stashed input
-            bidx = jnp.maximum(b, 0)
+            # 4. backward slot: re-run the chunk fwd from the stashed input
+            bidx = jnp.clip(jnp.maximum(b, 0), 0, m - 1)
             h_in_b = jnp.where(
-                stage == 0, x_mb[jnp.clip(bidx, 0, m - 1)], acts_buf[bidx % C])
-            dy_b = grads_buf[bidx % Cg]
-            _, stage_vjp = jax.vjp(apply_stage, local_layers, h_in_b)
-            dlayers_b, dh_b = stage_vjp(dy_b)
+                b_slot < 0, x_mb[bidx], acts_buf[jnp.maximum(b_slot, 0)])
+            dy_b = grads_buf[jnp.maximum(b_gslot, 0)]
+            blv = jnp.maximum(b_lv, 0)
+            _, chunk_vjp = jax.vjp(
+                lambda L, h: chunk_apply(L, h, blv), local_layers, h_in_b)
             b_ok = b >= 0
+            # cotangents: (d loss/d y, d loss/d aux) — the aux term's
+            # gradient rides the same within-chunk VJP
+            aux_ct = jnp.where(b_ok, jnp.float32(aux_weight), 0.0)
+            dlayers_b, dh_b = chunk_vjp((dy_b, aux_ct))
             dlayers_acc = jax.tree.map(
                 lambda a, g: a + jnp.where(b_ok, g, 0.0).astype(a.dtype),
                 dlayers_acc, dlayers_b)
-            bslot = jnp.clip(bidx, 0, m - 1)
-            wx = jnp.logical_and(b_ok, stage == 0)
-            dx_buf = dx_buf.at[bslot].set(
-                jnp.where(wx, dh_b.astype(dx_buf.dtype), dx_buf[bslot]))
+            # model chunk 0's input-cotangent is d loss / d x_mb[mb]
+            wx = jnp.logical_and(b_ok, b_slot < 0)
+            dx_buf = dx_buf.at[bidx].set(
+                jnp.where(wx, dh_b.astype(dx_buf.dtype), dx_buf[bidx]))
 
             # 5. what this tick sends (consumed next tick per the tables)
             return (acts_buf, grads_buf, y, dh_b,
-                    loss_acc, dlayers_acc, dhead_acc, dx_buf), None
+                    loss_acc, aux_acc, dlayers_acc, dhead_acc, dx_buf), None
 
         carry = (acts_buf, grads_buf, y_prev, dh_prev,
-                 loss_acc, dlayers_acc, dhead_acc, dx_buf)
-        carry, _ = lax.scan(tick, carry, (fwd_tbl, bwd_tbl, ra_tbl, rg_tbl))
-        (_, _, _, _, loss_acc, dlayers_acc, dhead_acc, dx_buf) = carry
+                 loss_acc, aux_acc, dlayers_acc, dhead_acc, dx_buf)
+        carry, _ = lax.scan(tick, carry, tbls)
+        (_, _, _, _, loss_acc, aux_acc, dlayers_acc, dhead_acc,
+         dx_buf) = carry
 
-        # only the owning stage's accumulators are real; psum-mask them to
-        # every rank (loss/head: last stage; dx: first stage)
-        loss = lax.psum(jnp.where(is_last, loss_acc, 0.0), AXIS)
-        dhead = jax.tree.map(
-            lambda g: lax.psum(jnp.where(is_last, g, 0.0), AXIS), dhead_acc)
-        dx = lax.psum(
-            jnp.where(stage == 0, dx_buf, jnp.zeros_like(dx_buf)), AXIS)
+        # accumulators are nonzero only on their owning device (loss/head:
+        # wherever the last chunk seeded; dx: the chunk-0 device); psum
+        # broadcasts them to every rank.  Aux sums over ALL devices.
+        loss = lax.psum(loss_acc, AXIS)
+        loss = loss + aux_weight * lax.psum(aux_acc, AXIS)
+        dhead = jax.tree.map(lambda g: lax.psum(g, AXIS), dhead_acc)
+        dx = lax.psum(dx_buf, AXIS)
         return loss, dlayers_acc, dhead, dx
 
     head_specs = jax.tree.map(lambda _: P(), head_params)

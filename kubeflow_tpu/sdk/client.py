@@ -125,13 +125,23 @@ class TrainingClient:
         chips_per_worker: int = 0,
         env: Optional[dict[str, str]] = None,
         mesh: Optional[dict[str, int]] = None,
+        model: Optional[str] = None,
         backoff_limit: int = 0,
         namespace: str = "default",
         wait: bool = True,
         timeout: float = 300.0,
     ) -> JaxJob:
         """Build + submit a JaxJob in one call [reference analog:
-        TrainingClient.train, the north-star fine-tune UX]."""
+        TrainingClient.train, the north-star fine-tune UX].
+
+        ``model``: pretrained snapshot URI (``hf://org/name[@rev]`` or
+        ``file:///path``) to fine-tune from — the literal v1.9 LLM path:
+        the trainer resolves it through the storage initializer, takes the
+        architecture from the snapshot's config.json, and loads the
+        weights before step 0 (train/llm.py KFT_INIT_FROM).
+        """
+        if model:
+            env = {**(env or {}), "KFT_INIT_FROM": model}
         job = JaxJob(
             metadata=ObjectMeta(name=name, namespace=namespace),
             spec={

@@ -49,6 +49,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..models import llama as llamalib
+from . import sharded as shardedlib
 from .model import Model
 from .storage import fetch_mem
 
@@ -83,6 +84,154 @@ class Request:
         return self.first_token_at - self.submitted_at
 
 
+def cache_shapes(cfg: llamalib.LlamaConfig, batch: int):
+    """Abstract KV-cache pytree for a ``batch``-row cache (eval_shape — no
+    allocation, no dispatch)."""
+    model = llamalib.Llama(cfg)
+    return jax.eval_shape(
+        lambda k, t, p: model.init(k, t, p, decode=True),
+        jax.ShapeDtypeStruct((2,), jnp.uint32),
+        jax.ShapeDtypeStruct((batch, 1), jnp.int32),
+        jax.ShapeDtypeStruct((batch, 1), jnp.int32),
+    )["cache"]
+
+
+def make_prefill_program(cfg, attend: int, mesh=None):
+    """[g, bucket] ragged prefill -> (last-token logits [g, v], row cache),
+    attending only over cache slots [0, attend).
+
+    Module-level (not an engine closure) so the AOT artifact path
+    (scripts/aot_7b_serving.py) compiles the EXACT program the live engine
+    dispatches — the HBM-fit evidence covers the real serving program, not
+    a stand-in.
+    """
+    wmodel = llamalib.Llama(cfg, decode_attend_len=attend)
+
+    def prefill(params, prompt, lengths):
+        b, length = prompt.shape
+        cache = jax.tree.map(
+            lambda s: jnp.zeros(s.shape, s.dtype), cache_shapes(cfg, b))
+        positions = jnp.broadcast_to(
+            jnp.arange(length, dtype=jnp.int32)[None, :], (b, length))
+        logits_all, mutated = wmodel.apply(
+            {"params": params, "cache": cache}, prompt, positions,
+            decode=True, mutable=["cache"])
+        last = jnp.take_along_axis(
+            logits_all, (lengths - 1)[:, None, None], axis=1)[:, 0]
+        return (shardedlib.constrain_logits(last, mesh),
+                shardedlib.constrain_cache(mutated["cache"], mesh))
+
+    return shardedlib.mesh_jit(mesh, prefill)
+
+
+def make_prefix_admit_program(cfg, attend: int, suffix_bucket: int,
+                              batch_axes=None, mesh=None):
+    """Admission with PREFIX REUSE, fused into one dispatch.
+
+    A new request whose prompt shares a long prefix with what some slot's
+    KV already holds (same conversation re-sent, shared system prompt,
+    N-best fan-out) must not pay prefill FLOPs for the shared part —
+    vLLM-class engines make this a core serving economy [upstream:
+    kserve huggingfaceserver vLLM backend; SURVEY §2.2].  The slot-pool
+    design supports it without paging:
+
+      pool[dst, :lp]  <- pool[src, :lp]        (masked row copy, on-device)
+      suffix forward at positions [lp, lp+sl)  (attends the copied prefix)
+      pool[dst] <- updated row; logits[dst] <- last-token logits
+
+    ``batch_axes``: per-leaf slot-axis tree (the engine's ``_batch_axes``
+    probe — the slot axis sits AFTER the scanned layer axis).  Signature:
+    (params, pool_cache, pool_logits, src, dst, lp, suffix, slen) ->
+    (pool_cache, pool_logits); pool buffers donated.
+    """
+    from jax import lax
+
+    wmodel = llamalib.Llama(cfg, decode_attend_len=attend)
+
+    def admit(params, pool_cache, pool_logits, src, dst, lp, suffix, slen):
+        def copy_leaf(c, a):
+            if a is None:  # cache_index bookkeeping: untouched
+                return c
+            src_row = jnp.take(c, src, axis=a)   # slot axis removed
+            dst_row = jnp.take(c, dst, axis=a)
+            seq_len = c.shape[a + 1]             # seq follows the slot axis
+            mask = (jnp.arange(seq_len) < lp).reshape(
+                *([1] * a), seq_len, *([1] * (c.ndim - a - 2)))
+            merged = jnp.where(mask, src_row, dst_row)
+            idx = (slice(None),) * a + (dst,)
+            return c.at[idx].set(merged)
+
+        pool_cache = jax.tree.map(copy_leaf, pool_cache, batch_axes)
+        # suffix forward against the copied prefix: slice the dst row
+        # (batch 1), run a [1, bucket] decode-mode forward at positions
+        # lp+arange, scatter the mutated row back
+        row = jax.tree.map(
+            lambda c, a: c if a is None
+            else lax.dynamic_slice_in_dim(c, dst, 1, axis=a),
+            pool_cache, batch_axes)
+        positions = (lp + jnp.arange(suffix_bucket, dtype=jnp.int32))[None, :]
+        logits_all, mutated = wmodel.apply(
+            {"params": params, "cache": row}, suffix[None], positions,
+            decode=True, mutable=["cache"])
+        last = jnp.take_along_axis(
+            logits_all, (slen - 1)[None, None, None], axis=1)[:, 0]
+
+        def scatter_leaf(c, r, a):
+            if a is None:
+                return c
+            idx = (slice(None),) * a + (dst,)
+            return c.at[idx].set(jnp.take(r, 0, axis=a))
+
+        pool_cache = shardedlib.constrain_cache(
+            jax.tree.map(scatter_leaf, pool_cache, mutated["cache"],
+                         batch_axes), mesh)
+        pool_logits = shardedlib.constrain_logits(
+            pool_logits.at[dst].set(last[0]), mesh)
+        return pool_cache, pool_logits
+
+    return shardedlib.mesh_jit(mesh, admit, donate_argnums=(1, 2))
+
+
+def make_decode_program(cfg, attend: int, chunk: int, temperature: float,
+                        mesh=None):
+    """``chunk`` sampling steps for the whole slot pool in one program,
+    attending only over cache slots [0, attend).
+
+    Inactive slots still compute (the price of a static pool) but their
+    cache writes drop: position is pinned to max_seq_len, where the
+    per-row scatter's mode="drop" discards the write and the causal mask
+    hides the slot from every live row.  Pool cache + logits are donated —
+    the pool exists in HBM exactly once.
+    """
+    wmodel = llamalib.Llama(cfg, decode_attend_len=attend)
+
+    def decode(params, cache, logits, positions, active, key):
+        safe = jnp.where(active, positions, cfg.max_seq_len)
+
+        def step(carry, key):
+            cache, logits, pos = carry
+            if temperature > 0:
+                tok = jax.random.categorical(
+                    key, logits.astype(jnp.float32) / temperature, axis=-1)
+            else:
+                tok = jnp.argmax(logits, axis=-1)
+            tok = tok.astype(jnp.int32)
+            l, mutated = wmodel.apply(
+                {"params": params, "cache": cache}, tok[:, None],
+                pos[:, None], decode=True, mutable=["cache"])
+            nxt = jnp.where(active, pos + 1, cfg.max_seq_len)
+            return (shardedlib.constrain_cache(mutated["cache"], mesh),
+                    shardedlib.constrain_logits(l[:, -1, :], mesh),
+                    nxt), tok
+
+        keys = jax.random.split(key, chunk)
+        (cache, logits, pos), toks = jax.lax.scan(
+            step, (cache, logits, safe), keys)
+        return cache, logits, toks.T  # toks: [slots, chunk]
+
+    return shardedlib.mesh_jit(mesh, decode, donate_argnums=(1, 2))
+
+
 class ContinuousEngine:
     """Slot-pool continuous-batching decode engine over a Llama model.
 
@@ -94,6 +243,17 @@ class ContinuousEngine:
                     dispatches (1 = admit at every token boundary).
     temperature:    0 = greedy; >0 = categorical sampling.
     eos_id:         optional stop token (host-checked between chunks).
+    mesh_axes:      optional serving mesh, e.g. {"model": 8}: weights and
+                    the slot-pool KV cache shard over the chips (TP over
+                    ICI), serving models bigger than one chip's HBM —
+                    the pool stays ONE jit program spanning the mesh
+                    (serving/sharded.py).
+    prefix_cache:   reuse KV across requests sharing a prompt prefix
+                    (min_prefix tokens or more) with any slot's current
+                    content: admission becomes an on-device prefix copy +
+                    suffix-only prefill (make_prefix_admit_program) —
+                    repeated system prompts / conversation re-sends skip
+                    their shared prefill entirely.
     """
 
     def __init__(
@@ -108,6 +268,9 @@ class ContinuousEngine:
         seq_buckets: Optional[list[int]] = None,
         default_max_new_tokens: int = 16,
         pipeline_depth: int = 2,
+        mesh_axes: Optional[dict[str, int]] = None,
+        prefix_cache: bool = True,
+        min_prefix: int = 32,
     ):
         if num_slots < 1:
             raise ValueError("num_slots must be >= 1")
@@ -116,6 +279,10 @@ class ContinuousEngine:
         if pipeline_depth < 1:
             raise ValueError("pipeline_depth must be >= 1")
         self.cfg = cfg
+        self.mesh = (
+            shardedlib.build_serving_mesh(mesh_axes) if mesh_axes else None)
+        if self.mesh is not None:
+            params = shardedlib.place_params(cfg, params, self.mesh)
         self.params = params
         self.num_slots = num_slots
         self.decode_chunk = decode_chunk
@@ -145,6 +312,18 @@ class ContinuousEngine:
         # host-side scheduler state
         self._queue: "queue.Queue[Request]" = queue.Queue()
         self._slots: list[Optional[Request]] = [None] * num_slots
+        self.prefix_cache = prefix_cache
+        self.min_prefix = int(min_prefix)
+        #: tokens whose KV each physical slot currently holds at positions
+        #: [0, len) — survives retirement (the KV stays in HBM) and resets
+        #: on reuse; the prefix matcher's ground truth
+        self._slot_content: list[list[int]] = [[] for _ in range(num_slots)]
+        #: the request whose tokens may still append to a slot's content
+        #: record (cleared on REUSE, not on retirement — late-arriving
+        #: chunks of a retired request still wrote real KV)
+        self._slot_owner: list[Optional[Request]] = [None] * num_slots
+        self.prefix_hits = 0
+        self.prefix_tokens_saved = 0
         self._active = np.zeros(num_slots, dtype=bool)
         self._positions = np.zeros(num_slots, dtype=np.int32)
         self._remaining = np.zeros(num_slots, dtype=np.int64)
@@ -155,22 +334,28 @@ class ContinuousEngine:
         self._gate = threading.Lock()
         self._wake = threading.Event()
         self._base_key = jax.random.PRNGKey(int.from_bytes(os.urandom(4), "little"))
-        self._thread = threading.Thread(
-            target=self._loop, name="continuous-engine", daemon=True)
-        self._thread.start()
+        # The scheduler thread starts LAZILY on first submit(), not here:
+        # warmup() mutates and donates the pool buffers, and an already-
+        # running scheduler could race it over the same donated buffers
+        # (two threads dispatching against one donated pool).  Deferred
+        # start makes pool ownership single-threaded until real traffic.
+        self._thread: Optional[threading.Thread] = None
+
+    def _ensure_running(self) -> None:
+        """Start the scheduler thread once (idempotent, called by submit
+        under the gate)."""
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop, name="continuous-engine", daemon=True)
+            self._thread.start()
 
     # -- compiled programs -------------------------------------------------
 
     def _build_programs(self) -> None:
-        cfg, model, temperature = self.cfg, self.model, self.temperature
+        cfg, temperature = self.cfg, self.temperature
         chunk = self.decode_chunk
         slots = self.num_slots
-
-        def forward(params, cache, tok, positions):
-            logits, mutated = model.apply(
-                {"params": params, "cache": cache}, tok, positions,
-                decode=True, mutable=["cache"])
-            return logits, mutated["cache"]
+        mesh = self.mesh
 
         #: decode-attention window buckets: each decode dispatch attends
         #: only over cache slots below the smallest bucket covering every
@@ -181,20 +366,12 @@ class ContinuousEngine:
             [b for b in (128, 256, 512, 1024, 2048) if b < cfg.max_seq_len]
             + [cfg.max_seq_len])
 
-        def cache_shapes(batch: int):
-            return jax.eval_shape(
-                lambda k, t, p: model.init(k, t, p, decode=True),
-                jax.ShapeDtypeStruct((2,), jnp.uint32),
-                jax.ShapeDtypeStruct((batch, 1), jnp.int32),
-                jax.ShapeDtypeStruct((batch, 1), jnp.int32),
-            )["cache"]
-
-        pool_proto = cache_shapes(slots)
-        row_proto = cache_shapes(1)
+        pool_proto = cache_shapes(cfg, slots)
+        row_proto = cache_shapes(cfg, 1)
         # per-leaf batch axis, probed with batch=2 vs batch=1 so it stays
         # well-defined even when num_slots == 1 (cache_index has no batch
         # axis — it is informational and left untouched)
-        probe_proto = cache_shapes(2)
+        probe_proto = cache_shapes(cfg, 2)
 
         def batch_axis(p, r):
             diff = [i for i, (a, b) in enumerate(zip(p.shape, r.shape)) if a != b]
@@ -208,47 +385,16 @@ class ContinuousEngine:
         self._pool_shapes = pool_proto
         self._batch_axes = jax.tree.map(batch_axis, probe_proto, row_proto)
 
-        def make_prefill(attend: int):
-            wmodel = llamalib.Llama(cfg, decode_attend_len=attend)
-
-            def prefill(params, prompt, lengths):
-                """[g, bucket] ragged prefill -> (last-token logits [g,v],
-                row cache), attending only over [0, attend)."""
-                b, length = prompt.shape
-                cache = jax.tree.map(
-                    lambda s: jnp.zeros(s.shape, s.dtype), cache_shapes(b))
-                positions = jnp.broadcast_to(
-                    jnp.arange(length, dtype=jnp.int32)[None, :], (b, length))
-                logits_all, mutated = wmodel.apply(
-                    {"params": params, "cache": cache}, prompt, positions,
-                    decode=True, mutable=["cache"])
-                last = jnp.take_along_axis(
-                    logits_all, (lengths - 1)[:, None, None], axis=1)[:, 0]
-                return last, mutated["cache"]
-
-            return jax.jit(prefill)
-
         self._prefill_programs: dict[int, Any] = {}
 
         def prefill_for(bucket: int):
             attend = next(b for b in self.attend_buckets if b >= bucket)
             if attend not in self._prefill_programs:
-                self._prefill_programs[attend] = make_prefill(attend)
+                self._prefill_programs[attend] = make_prefill_program(
+                    cfg, attend, mesh)
             return self._prefill_programs[attend]
 
         self._prefill_for = prefill_for
-
-        # the plain (windowless) prefill stays for shape probing
-        def prefill(params, prompt, lengths):
-            b, length = prompt.shape
-            cache = jax.tree.map(
-                lambda s: jnp.zeros(s.shape, s.dtype), cache_shapes(b))
-            positions = jnp.broadcast_to(
-                jnp.arange(length, dtype=jnp.int32)[None, :], (b, length))
-            logits_all, cache = forward(params, cache, prompt, positions)
-            last = jnp.take_along_axis(
-                logits_all, (lengths - 1)[:, None, None], axis=1)[:, 0]
-            return last, cache
 
         axes = self._batch_axes
 
@@ -264,45 +410,10 @@ class ContinuousEngine:
                 return pool.at[idx].set(row, mode="drop")
 
             merged = jax.tree.map(leaf, pool_cache, row_cache, axes)
-            return merged, pool_logits.at[slots].set(row_logits, mode="drop")
-
-        def make_decode(attend: int):
-            wmodel = llamalib.Llama(cfg, decode_attend_len=attend)
-
-            def decode(params, cache, logits, positions, active, key):
-                """``chunk`` sampling steps for the whole pool in one
-                program, attending only over cache slots [0, attend).
-
-                Inactive slots still compute (the price of a static pool)
-                but their cache writes drop: position is pinned to
-                max_seq_len, where the per-row scatter's mode="drop"
-                discards the write and the causal mask hides the slot from
-                every live row.
-                """
-                safe = jnp.where(active, positions, cfg.max_seq_len)
-
-                def step(carry, key):
-                    cache, logits, pos = carry
-                    if temperature > 0:
-                        tok = jax.random.categorical(
-                            key, logits.astype(jnp.float32) / temperature,
-                            axis=-1)
-                    else:
-                        tok = jnp.argmax(logits, axis=-1)
-                    tok = tok.astype(jnp.int32)
-                    l, mutated = wmodel.apply(
-                        {"params": params, "cache": cache}, tok[:, None],
-                        pos[:, None], decode=True, mutable=["cache"])
-                    nxt = jnp.where(active, pos + 1, cfg.max_seq_len)
-                    return (mutated["cache"], l[:, -1, :], nxt), tok
-
-                keys = jax.random.split(key, chunk)
-                (cache, logits, pos), toks = jax.lax.scan(
-                    step, (cache, logits, safe), keys)
-                return cache, logits, toks.T  # toks: [slots, chunk]
-
-            # donate pool buffers: the pool cache must exist in HBM once
-            return jax.jit(decode, donate_argnums=(1, 2))
+            return (shardedlib.constrain_cache(merged, mesh),
+                    shardedlib.constrain_logits(
+                        pool_logits.at[slots].set(row_logits, mode="drop"),
+                        mesh))
 
         self._decode_programs: dict[int, Any] = {}
 
@@ -311,30 +422,53 @@ class ContinuousEngine:
                 (b for b in self.attend_buckets if b >= needed),
                 cfg.max_seq_len)
             if attend not in self._decode_programs:
-                self._decode_programs[attend] = make_decode(attend)
+                self._decode_programs[attend] = make_decode_program(
+                    cfg, attend, chunk, temperature, mesh)
             return self._decode_programs[attend]
 
         self._decode_for = decode_for
+
+        self._prefix_programs: dict[tuple[int, int], Any] = {}
+
+        def prefix_admit_for(total_needed: int, suffix_bucket: int):
+            attend = next(
+                (b for b in self.attend_buckets if b >= total_needed),
+                cfg.max_seq_len)
+            key = (attend, suffix_bucket)
+            if key not in self._prefix_programs:
+                self._prefix_programs[key] = make_prefix_admit_program(
+                    cfg, attend, suffix_bucket, self._batch_axes, mesh)
+            return self._prefix_programs[key]
+
+        self._prefix_admit_for = prefix_admit_for
 
         # logits dtype follows the model's activation dtype (bf16 on TPU;
         # the pool logits buffer must match or the decode scan carry
         # type-mismatches)
         self._logits_dtype = jax.eval_shape(
-            prefill,
-            self.params,
+            lambda p, t: self.model.apply(
+                {"params": p}, t), self.params,
             jax.ShapeDtypeStruct((1, self.seq_buckets[0]), jnp.int32),
-            jax.ShapeDtypeStruct((1,), jnp.int32),
-        )[0].dtype
+        ).dtype
 
         # donate pool buffers: the pool cache must exist in HBM once, not
         # once per in-flight dispatch
-        self._merge = jax.jit(merge, donate_argnums=(0, 1))
+        self._merge = shardedlib.mesh_jit(mesh, merge, donate_argnums=(0, 1))
 
     def _init_pool(self) -> None:
-        self._pool_cache = jax.jit(lambda: jax.tree.map(
-            lambda s: jnp.zeros(s.shape, s.dtype), self._pool_shapes))()
-        self._pool_logits = jnp.zeros(
-            (self.num_slots, self.cfg.vocab_size), self._logits_dtype)
+        mesh = self.mesh
+        self._pool_cache, self._pool_logits = shardedlib.mesh_jit(
+            mesh,
+            lambda: (
+                shardedlib.constrain_cache(
+                    jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype),
+                                 self._pool_shapes),
+                    mesh),
+                shardedlib.constrain_logits(
+                    jnp.zeros((self.num_slots, self.cfg.vocab_size),
+                              self._logits_dtype),
+                    mesh),
+            ))()
 
     # -- public API --------------------------------------------------------
 
@@ -350,7 +484,23 @@ class ContinuousEngine:
         sizes 1 and num_slots at the smallest bucket.  ``attend_buckets``
         (optional): decode-window buckets to precompile; default = the
         windows the warmed prompt buckets will first decode in.
+
+        Must run BEFORE the first submit(): the scheduler thread (started
+        lazily by submit) and warmup would otherwise race over the same
+        donated pool buffers.  The gate is held for the WHOLE body — a
+        check-then-release would let a concurrent submit() start the
+        scheduler mid-warmup and recreate the race; concurrent submitters
+        instead block until warmup finishes, then proceed safely.
         """
+        with self._gate:
+            if self._thread is not None:
+                raise RuntimeError(
+                    "warmup() must run before the first submit(): the "
+                    "scheduler thread owns the donated pool buffers once "
+                    "traffic starts")
+            self._warmup_locked(groups)
+
+    def _warmup_locked(self, groups) -> None:
         if groups is None:
             groups = [(1, self.seq_buckets[0]),
                       (self.num_slots, self.seq_buckets[0])]
@@ -392,6 +542,7 @@ class ContinuousEngine:
             if self._stop.is_set():
                 raise RuntimeError("engine is shutting down")
             self._queue.put(req)
+            self._ensure_running()
         self._wake.set()
         return req
 
@@ -403,7 +554,8 @@ class ContinuousEngine:
         with self._gate:
             self._stop.set()
         self._wake.set()
-        self._thread.join(timeout=10)
+        if self._thread is not None:
+            self._thread.join(timeout=10)
         while True:
             try:
                 req = self._queue.get_nowait()
@@ -448,8 +600,24 @@ class ContinuousEngine:
             taken.append((req, prompt, free.pop(0)))
         if not taken:
             return
-        groups: dict[int, list[tuple[Request, list[int], int]]] = {}
+        # prefix-cache routing: a prompt sharing >= min_prefix tokens with
+        # some slot's live KV admits via on-device copy + suffix prefill
+        # (src == dst is the conversation-continues case: the prefix is
+        # already in place and only the suffix runs)
+        grouped: list[tuple[Request, list[int], int]] = []
         for req, prompt, slot in taken:
+            src, lp = (self._best_prefix(prompt)
+                       if self.prefix_cache else (-1, 0))
+            if src < 0 or lp < self.min_prefix:
+                grouped.append((req, prompt, slot))
+                continue
+            try:
+                self._admit_with_prefix(req, prompt, slot, src, lp)
+            except Exception as e:  # noqa: BLE001 — fail this request only
+                req.error = e
+                req.done.set()
+        groups: dict[int, list[tuple[Request, list[int], int]]] = {}
+        for req, prompt, slot in grouped:
             bucket = next(b for b in self.seq_buckets if b >= len(prompt))
             groups.setdefault(bucket, []).append((req, prompt, slot))
         for bucket, members in groups.items():
@@ -474,16 +642,53 @@ class ContinuousEngine:
                     self._pool_cache, self._pool_logits,
                     row_cache, row_logits, jnp.asarray(slots))
                 for req, prompt, slot in members:
-                    self._slots[slot] = req
-                    self._active[slot] = True
-                    self._positions[slot] = len(prompt)
-                    self._remaining[slot] = req.max_new_tokens
-                    req.slot = slot
-                    req.admitted_step = self.step_counter
+                    self._occupy(req, prompt, slot)
             except Exception as e:  # noqa: BLE001 — fail this group only
                 for req, _, _ in members:
                     req.error = e
                     req.done.set()
+
+    def _occupy(self, req: Request, prompt: list[int], slot: int) -> None:
+        self._slots[slot] = req
+        self._active[slot] = True
+        self._positions[slot] = len(prompt)
+        self._remaining[slot] = req.max_new_tokens
+        self._slot_content[slot] = list(prompt)
+        self._slot_owner[slot] = req
+        req.slot = slot
+        req.admitted_step = self.step_counter
+
+    def _best_prefix(self, prompt: list[int]) -> tuple[int, int]:
+        """(src_slot, lp): the longest usable prefix of ``prompt`` already
+        present in some slot's KV.  Caps at len(prompt)-1 — at least one
+        suffix token must run to produce the next-token logits."""
+        best_slot, best_lp = -1, 0
+        cap = len(prompt) - 1
+        for s, content in enumerate(self._slot_content):
+            n = 0
+            for a, b in zip(content, prompt):
+                if a != b:
+                    break
+                n += 1
+            n = min(n, cap)
+            if n > best_lp:
+                best_slot, best_lp = s, n
+        return best_slot, best_lp
+
+    def _admit_with_prefix(self, req: Request, prompt: list[int],
+                           slot: int, src: int, lp: int) -> None:
+        suffix = prompt[lp:]
+        bucket = next(b for b in self.seq_buckets if b >= len(suffix))
+        toks = np.zeros(bucket, np.int32)
+        toks[: len(suffix)] = suffix
+        program = self._prefix_admit_for(lp + bucket, bucket)
+        self._pool_cache, self._pool_logits = program(
+            self.params, self._pool_cache, self._pool_logits,
+            np.int32(src), np.int32(slot), np.int32(lp),
+            jnp.asarray(toks), np.int32(len(suffix)))
+        self._occupy(req, prompt, slot)
+        self.prefix_hits += 1
+        self.prefix_tokens_saved += lp
 
     def _loop(self) -> None:
         try:
@@ -566,6 +771,11 @@ class ContinuousEngine:
             if req.done.is_set():
                 continue  # EOS-retired by an earlier chunk
             emitted = toks[slot, :take].tolist()
+            if self._slot_owner[slot] is req:
+                # extend the slot's KV-content record (prefix matcher
+                # ground truth) — the sampled tokens' KV was written by
+                # the decode dispatch that produced them
+                self._slot_content[slot].extend(emitted)
             done = False
             if self.eos_id is not None and self.eos_id in emitted:
                 emitted = emitted[: emitted.index(self.eos_id) + 1]
@@ -597,6 +807,9 @@ def build_engine(cfg, params, config: dict, *, default_eos=None,
         eos_id=config.get("eos_id", default_eos),
         seq_buckets=config.get("seq_buckets"),
         pipeline_depth=int(config.get("pipeline_depth", 2)),
+        mesh_axes=config.get("mesh_axes"),
+        prefix_cache=bool(config.get("prefix_cache", True)),
+        min_prefix=int(config.get("min_prefix", 32)),
         default_max_new_tokens=int(
             config.get("max_new_tokens", default_max_new_tokens)),
     )

@@ -23,6 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..models import llama as llamalib
+from . import sharded as shardedlib
 from .model import Model
 from .storage import download, fetch_mem
 
@@ -90,6 +91,11 @@ class LlamaGenerator(Model):
     config:
       params_ref:   "mem://key" holding (LlamaConfig, params)
       max_new_tokens (default 16), temperature (default 0 = greedy)
+      mesh_axes:    optional sharded-predictor mesh, e.g. {"model": 8} —
+                    weights and KV cache shard over the chips (TP), which
+                    is what serves models bigger than one chip's HBM
+                    (serving/sharded.py; SURVEY §2.2 multi-accelerator
+                    runtimes row)
 
     Instances are token-id lists; predictions are continuation token lists.
     Ragged prompts batch together: the KV cache tracks PER-ROW positions
@@ -103,6 +109,7 @@ class LlamaGenerator(Model):
         super().__init__(name, config)
         self.max_new_tokens = int(self.config.get("max_new_tokens", 16))
         self.temperature = float(self.config.get("temperature", 0.0))
+        self.mesh = None  # set at load() when config carries mesh_axes
         self._cache_protos: dict[int, Any] = {}
 
     def load(self) -> None:
@@ -119,6 +126,15 @@ class LlamaGenerator(Model):
                 lambda x: x.astype(target)
                 if jnp.issubdtype(x.dtype, jnp.floating) else x,
                 self.params)
+        mesh_axes = self.config.get("mesh_axes")
+        self.mesh = (
+            shardedlib.build_serving_mesh(mesh_axes) if mesh_axes else None)
+        if self.mesh is not None:
+            # weights distribute TP-sharded at load: vocab/heads/mlp dims
+            # split over the `model` axis per the shared logical-rule table
+            self.params = shardedlib.place_params(
+                self.cfg, self.params, self.mesh)
+        mesh = self.mesh
         temperature = self.temperature
         n_new = self.max_new_tokens
         cfg = self.cfg
@@ -134,7 +150,10 @@ class LlamaGenerator(Model):
                 logits, mutated = model.apply(
                     {"params": params, "cache": cache}, tok, positions,
                     decode=True, mutable=["cache"])
-                return logits, mutated["cache"]
+                # keep the cache kv_heads-sharded across dispatches on a
+                # serving mesh (no-op when mesh is None)
+                return logits, shardedlib.constrain_cache(
+                    mutated["cache"], mesh)
 
             def prefill(params, cache, prompt, lengths):
                 """Chunked prefill of a RAGGED batch padded to one bucket:
@@ -177,7 +196,8 @@ class LlamaGenerator(Model):
                     step, (cache, logits, lengths), keys)
                 return toks.T  # [b, n_new]
 
-            return jax.jit(prefill), jax.jit(sample)
+            return (shardedlib.mesh_jit(mesh, prefill),
+                    shardedlib.mesh_jit(mesh, sample))
 
         self._programs: dict[int, tuple] = {}
 
@@ -222,8 +242,12 @@ class LlamaGenerator(Model):
                 jax.ShapeDtypeStruct((batch, 1), jnp.int32),
                 jax.ShapeDtypeStruct((batch, 1), jnp.int32),
             )["cache"]
-            proto = jax.jit(lambda: jax.tree.map(
-                lambda s: jnp.zeros(s.shape, s.dtype), shapes))()
+            proto = shardedlib.mesh_jit(
+                self.mesh,
+                lambda: shardedlib.constrain_cache(
+                    jax.tree.map(
+                        lambda s: jnp.zeros(s.shape, s.dtype), shapes),
+                    self.mesh))()
             self._cache_protos[batch] = proto
         return proto
 

@@ -377,6 +377,7 @@ class ModelServer:
             t0 = time.perf_counter()
             with self.metrics.lock:  # inflight gauge covers completions too
                 self.metrics.inflight += 1
+            streaming = False  # SSE headers already on the wire?
             try:
                 if payload.get("stream") and hasattr(m, "openai_stream"):
                     # SSE: tokens stream as the engine emits decode chunks
@@ -384,6 +385,7 @@ class ModelServer:
                     h.send_header("Content-Type", "text/event-stream")
                     h.send_header("Cache-Control", "no-cache")
                     h.end_headers()
+                    streaming = True
                     for chunk in m.openai_stream(payload):
                         h.wfile.write(chunk)
                         h.wfile.flush()
@@ -398,10 +400,23 @@ class ModelServer:
                 self.metrics.observe(name, time.perf_counter() - t0, error=False)
             except Exception as e:  # noqa: BLE001
                 self.metrics.observe(name, time.perf_counter() - t0, error=True)
-                try:
-                    h._send(500, {"error": f"{type(e).__name__}: {e}"})
-                except (BrokenPipeError, OSError):
-                    pass  # headers already sent mid-stream
+                if streaming:
+                    # headers are on the wire: a second status line would
+                    # corrupt the event stream.  Emit a terminal SSE error
+                    # event + [DONE] so clients terminate cleanly.
+                    try:
+                        err = json.dumps(
+                            {"error": f"{type(e).__name__}: {e}"})
+                        h.wfile.write(
+                            f"data: {err}\n\ndata: [DONE]\n\n".encode())
+                        h.wfile.flush()
+                    except (BrokenPipeError, OSError):
+                        pass
+                else:
+                    try:
+                        h._send(500, {"error": f"{type(e).__name__}: {e}"})
+                    except (BrokenPipeError, OSError):
+                        pass
             finally:
                 with self.metrics.lock:
                     self.metrics.inflight -= 1

@@ -10,9 +10,16 @@ Schemes:
   mem://<key>        in-process registry (tests, zero-copy handoff)
   hf://org/name[@rev] LOCAL HuggingFace-hub-layout snapshots resolved
                      from $KFT_HF_HOME with revision pinning (resolve_hf)
-  gs:// s3://        recognized but gated: this environment has zero
-                     egress, so they raise with a clear message instead
-                     of hanging.
+  gs:// s3://        PLUGGABLE TRANSPORT (r4): resolved through a
+                     registered transport (register_transport) that
+                     fetches into a staging dir, then published through
+                     the manifest-verified cache — the same pattern that
+                     made hf:// coverable without egress.  With
+                     KFT_REMOTE_TOOLS=1 the builtin transports shell out
+                     to gsutil / aws-cli (egress-enabled deployments);
+                     otherwise, with no registered transport, the scheme
+                     raises the explicit zero-egress error instead of
+                     letting a cloud CLI retry against a blackhole.
 
 Cache tier (the kserve agent's local-model-cache capability): pass
 ``cache_dir`` (or set ``KFT_MODEL_CACHE``) and ``download`` stages the
@@ -54,6 +61,124 @@ def fetch_mem(key: str) -> Any:
         raise StorageError(f"mem://{key} not registered") from None
 
 
+#: scheme ("gs://", "s3://", ...) -> transport(uri, dest_dir) that fetches
+#: the object(s) at uri INTO dest_dir.  Injectable for tests and for
+#: deployments with egress; download() stages the result through the
+#: manifest cache so replicas share one verified copy.
+_TRANSPORTS: dict[str, Any] = {}
+
+
+def register_transport(scheme: str, fn) -> None:
+    """Install (or override) the transport for a remote scheme.  Pass
+    ``None`` to remove."""
+    if fn is None:
+        _TRANSPORTS.pop(scheme, None)
+    else:
+        _TRANSPORTS[scheme] = fn
+
+
+def _tool_transport(tool_argv_prefix: list[str]):
+    """Transport that shells out to a cloud CLI (gsutil / aws s3) when the
+    binary exists on PATH — the reference's storage-initializer behavior
+    [upstream: kserve pkg/agent/storage].  Returns None when absent so the
+    caller falls through to the explicit zero-egress error."""
+    import shutil as _shutil
+    import subprocess
+
+    if _shutil.which(tool_argv_prefix[0]) is None:
+        return None
+
+    def fetch(uri: str, dest_dir: str) -> None:
+        proc = subprocess.run(
+            [*tool_argv_prefix, uri, dest_dir],
+            capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise StorageError(
+                f"{uri}: transfer failed: {proc.stderr.strip()[:500]}")
+
+    return fetch
+
+
+def _remote_transport_for(uri: str):
+    scheme = uri.split("://", 1)[0] + "://"
+    t = _TRANSPORTS.get(scheme)
+    if t is not None:
+        return t
+    # CLI-tool fallbacks require an EXPLICIT opt-in: a gsutil/aws binary
+    # may exist on a zero-egress host, where it retries against the
+    # blackhole for minutes instead of failing fast — the hang the
+    # scheme gating exists to prevent.  Deployments with real egress set
+    # KFT_REMOTE_TOOLS=1 (or register a transport).
+    if os.environ.get("KFT_REMOTE_TOOLS") != "1":
+        return None
+    if scheme == "gs://":
+        return _tool_transport(["gsutil", "-m", "cp", "-r"])
+    if scheme == "s3://":
+        return _s3_tool_transport()
+    return None
+
+
+def _s3_tool_transport():
+    """aws-cli transport.  `aws s3 cp --recursive` treats a single-object
+    key as an (empty) prefix, so try the plain object copy first and fall
+    back to recursive for prefix trees."""
+    import shutil as _shutil
+    import subprocess
+
+    if _shutil.which("aws") is None:
+        return None
+
+    def fetch(uri: str, dest_dir: str) -> None:
+        single = subprocess.run(
+            ["aws", "s3", "cp", uri, dest_dir + "/"],
+            capture_output=True, text=True)
+        if single.returncode == 0 and os.listdir(dest_dir):
+            return
+        tree = subprocess.run(
+            ["aws", "s3", "cp", "--recursive", uri, dest_dir],
+            capture_output=True, text=True)
+        if tree.returncode != 0:
+            raise StorageError(
+                f"{uri}: transfer failed: "
+                f"{(tree.stderr or single.stderr).strip()[:500]}")
+
+    return fetch
+
+
+def _download_remote(uri: str, cache_dir: Optional[str]) -> str:
+    """Fetch via the scheme's transport into a temp dir, then publish
+    through the manifest cache (atomic, shared across replicas)."""
+    import tempfile
+
+    transport = _remote_transport_for(uri)
+    if transport is None:
+        raise StorageError(
+            f"{uri}: remote storage requires network egress, which this "
+            "deployment does not have; stage the model locally and use "
+            "file:// (or register_transport() in an egress-enabled "
+            "deployment)")
+    cache_dir = cache_dir or os.path.join(
+        tempfile.gettempdir(), "kft-remote-cache")
+    # cache hit: a previously-staged, manifest-valid entry skips the
+    # transport entirely (the kserve local-model-cache economy)
+    key = hashlib.sha256(uri.encode()).hexdigest()[:16]
+    entry_dir = os.path.join(cache_dir, key)
+    if os.path.exists(os.path.join(entry_dir, MANIFEST_NAME)) and (
+            verify_manifest(entry_dir)):
+        _verified_entries.add(entry_dir)
+        return os.path.join(entry_dir, "model")
+    with tempfile.TemporaryDirectory(prefix="kft-fetch-") as tmp:
+        dest = os.path.join(tmp, "payload")
+        os.makedirs(dest, exist_ok=True)
+        transport(uri, dest)
+        if not os.listdir(dest):
+            raise StorageError(f"{uri}: transport produced no files")
+        # always stage the payload DIRECTORY: remote downloads resolve to
+        # a model directory (single-file objects become a one-file dir),
+        # which keeps the cache-hit path above unambiguous
+        return stage_to_cache(uri, dest, cache_dir)
+
+
 def download(
     uri: str, cache_dir: Optional[str] = None, hf_root: Optional[str] = None
 ) -> str:
@@ -82,7 +207,9 @@ def download(
         if cache_dir:
             return stage_to_cache(uri, path, cache_dir)
         return path
-    for scheme in ("gs://", "s3://", "http://", "https://"):
+    if uri.startswith(("gs://", "s3://")):
+        return _download_remote(uri, cache_dir)
+    for scheme in ("http://", "https://"):
         if uri.startswith(scheme):
             raise StorageError(
                 f"{uri}: remote storage requires network egress, which this "

@@ -8,6 +8,11 @@ controller injects, same channel the reference uses for MASTER_ADDR et al).
 
 Env knobs (all optional):
   KFT_MODEL_PRESET  llama preset name (default "tiny")
+  KFT_INIT_FROM     pretrained snapshot to fine-tune from: hf://org/name@rev
+                    or file:///path (resolved through the storage
+                    initializer).  The snapshot's config.json defines the
+                    architecture; weights load before step 0; a newer
+                    checkpoint in KFT_CKPT_DIR still wins (resume > init)
   KFT_STEPS, KFT_BATCH, KFT_SEQ_LEN, KFT_LR, KFT_CKPT_DIR, KFT_SAVE_EVERY
   KFT_CORPUS_DIR    tokenized TokenCorpus directory -> train on real data
                     through the native packing pipeline (train/native_data);
@@ -92,8 +97,19 @@ def _pbt_base_step(ckpt_dir: str) -> int:
 
 def config_from_env(ctx: "bootstrap.PodContext") -> trainlib.TrainConfig:
     e = os.environ
-    preset = e.get("KFT_MODEL_PRESET", "tiny")
-    model = llamalib.PRESETS[preset]()
+    init_from = e.get("KFT_INIT_FROM") or None
+    if init_from:
+        # the literal "stock Llama fine-tune" UX (SURVEY §3.5): resolve
+        # hf://org/name@rev (or file://) through the storage initializer
+        # and take the ARCHITECTURE from the snapshot — KFT_MODEL_PRESET
+        # is ignored so the job can never fine-tune a mismatched shape
+        from ..serving.storage import download
+
+        init_from = download(init_from)
+        model = llamalib.load_pretrained_config(init_from)
+    else:
+        preset = e.get("KFT_MODEL_PRESET", "tiny")
+        model = llamalib.PRESETS[preset]()
     ckpt_dir = _pbt_checkpoint_dir(ctx) or e.get("KFT_CKPT_DIR") or None
     steps = int(e.get("KFT_STEPS", "10"))
     if e.get("KFT_PBT_ROOT") and ckpt_dir:
@@ -102,6 +118,7 @@ def config_from_env(ctx: "bootstrap.PodContext") -> trainlib.TrainConfig:
         steps += _pbt_base_step(ckpt_dir)
     return trainlib.TrainConfig(
         model=model,
+        init_from=init_from,
         mesh_axes=dict(ctx.mesh_axes),
         global_batch=int(e.get("KFT_BATCH", "8")),
         seq_len=int(e.get("KFT_SEQ_LEN", "64")),

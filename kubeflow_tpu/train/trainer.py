@@ -23,6 +23,7 @@ from typing import Any, Callable, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 from flax import linen as nn
 
@@ -85,6 +86,14 @@ class TrainConfig:
     accum_steps: int = 1
     checkpoint_dir: Optional[str] = None
     save_interval_steps: int = 100
+    #: pretrained snapshot dir (config.json + weights.msgpack — the
+    #: models/llama.py save_pretrained layout): params initialize from it
+    #: instead of randomly; optimizer state starts fresh.  THE fine-tune
+    #: entry [upstream: training-operator sdk train() v1.9 LLM path,
+    #: SURVEY.md §3.5] — hf:// URIs resolve through serving.storage first
+    #: (train/llm.py KFT_INIT_FROM).  A newer checkpoint in
+    #: checkpoint_dir still wins (resume > init).
+    init_from: Optional[str] = None
     log_every: int = 10
     #: microbatch count for pipeline parallelism (mesh has a ``pipeline``
     #: axis > 1); default = pipeline degree.  Ignored otherwise.
@@ -94,6 +103,13 @@ class TrainConfig:
     #: "1f1b" (fused value-and-grad, ~P in-flight microbatches — the
     #: perf-grade memory profile; see parallel/pipeline.py).
     pipeline_schedule: str = "gpipe"
+    #: virtual stages per device under "1f1b" (Megatron interleaving):
+    #: each device owns V non-contiguous model chunks, shortening the
+    #: fill/drain bubble (wall ticks T = MV+P+PV-2 chunk-ticks = fewer
+    #: stage-times as V grows).  The stacked layer axis is permuted to
+    #: the interleaved layout inside the step (one weight reshard —
+    #: cheap over ICI; charged for DCN in the projection model).
+    pipeline_interleave: int = 1
     #: when set, capture a jax.profiler trace (XPlane, TensorBoard-loadable)
     #: of steps [profile_start, profile_stop) into this directory — the
     #: SURVEY §5 tracing-subsystem hook (reconcile metrics stay Prometheus-
@@ -200,13 +216,71 @@ class Trainer:
 
     def init_state(self, seed: int = 0) -> Any:
         """Initialize sharded: weights are born on the mesh (no host round
-        trip — a 7B state never materializes on one host)."""
-        shardings = jax.tree.map(lambda a: a.sharding, self.abstract_state())
+        trip — a 7B state never materializes on one host).  With
+        ``cfg.init_from``, params then load from the pretrained snapshot
+        (optimizer state stays fresh — zeros/step-0, the standard
+        fine-tune start)."""
+        abstract = self.abstract_state()
+        shardings = jax.tree.map(lambda a: a.sharding, abstract)
+        if self.cfg.init_from:
+            # snapshot weights replace random init entirely — running the
+            # full jitted param init just to discard it would compile and
+            # execute a 7B random initialization for nothing; only the
+            # optimizer state (zeros) is built on-mesh here
+            params = self._pretrained_params(abstract["params"])
+            with shardlib.shard_context(self.mesh):
+                rest = jax.jit(
+                    lambda p: {"step": jnp.zeros((), jnp.int32),
+                               "opt_state": self.tx.init(p)},
+                    out_shardings={"step": shardings["step"],
+                                   "opt_state": shardings["opt_state"]},
+                )(params)
+            return {"step": rest["step"], "params": params,
+                    "opt_state": rest["opt_state"]}
         with shardlib.shard_context(self.mesh):
             state = jax.jit(
                 self._init_fn, out_shardings=shardings
             )(jax.random.PRNGKey(seed))
         return nn.meta.unbox(state)
+
+    def _pretrained_params(self, abstract_params: Any) -> Any:
+        """Snapshot weights placed onto the mesh's param shardings.
+
+        Loads host-side once per process and shards via
+        ``make_array_from_callback`` (works identically single- and
+        multi-host: each process materializes only its addressable
+        shards).  The snapshot's architecture must match the training
+        config — silent shape coercion would "fine-tune" a different
+        model than the one named."""
+        snap_cfg, loaded = llamalib.load_pretrained(self.cfg.init_from)
+        mcfg = self.cfg.model
+        for f in ("vocab_size", "hidden_size", "intermediate_size",
+                  "num_layers", "num_heads", "num_kv_heads", "head_dim",
+                  "tie_embeddings", "moe_experts", "scan_layers"):
+            if getattr(snap_cfg, f) != getattr(mcfg, f):
+                raise ValueError(
+                    f"init_from snapshot {self.cfg.init_from}: {f}="
+                    f"{getattr(snap_cfg, f)} != model config "
+                    f"{getattr(mcfg, f)}; the snapshot defines the "
+                    "architecture — build TrainConfig.model from "
+                    "load_pretrained_config")
+
+        def put(sds, host):
+            host = np.asarray(host)
+            if host.shape != sds.shape:
+                raise ValueError(
+                    f"init_from: param shape {host.shape} != expected "
+                    f"{sds.shape}")
+            return jax.make_array_from_callback(
+                sds.shape, sds.sharding,
+                lambda idx: host[idx].astype(sds.dtype))
+
+        try:
+            return jax.tree.map(put, abstract_params, loaded)
+        except ValueError as e:
+            raise ValueError(
+                f"init_from snapshot {self.cfg.init_from} does not match "
+                f"the model's parameter tree: {e}") from None
 
     def restore_or_init(self, seed: int = 0) -> Any:
         """Resume from the newest checkpoint if one exists — onto the
@@ -221,16 +295,17 @@ class Trainer:
         inputs, targets = tokens[:, :-1], tokens[:, 1:]
         aux = None
         if self.mesh.shape.get("pipeline", 1) > 1:
-            if self.cfg.model.moe_experts > 0 and self.cfg.aux_loss_coef > 0:
-                raise NotImplementedError(
-                    "MoE aux-loss collection is not plumbed through the "
-                    "pipelined executor; set aux_loss_coef=0 explicitly to "
-                    "train MoE under pipeline parallelism without balancing")
-            logits = llamalib.pipelined_apply(
+            collect = (self.cfg.model.moe_experts > 0
+                       and self.cfg.aux_loss_coef > 0)
+            out = llamalib.pipelined_apply(
                 self.cfg.model, params, inputs,
                 mesh=self.mesh,
                 num_microbatches=self.cfg.num_microbatches,
+                with_aux=collect,
             )
+            # MoE x PP: the balancing loss rides the schedule itself
+            # (gpipe with_aux — masked per-tick sums, differentiable)
+            logits, aux = out if collect else (out, None)
         elif self.cfg.model.moe_experts > 0 and self.cfg.aux_loss_coef > 0.0:
             # collect the sown Switch load-balancing loss — without this the
             # router has no balancing gradient and can collapse onto one
@@ -255,14 +330,15 @@ class Trainer:
         dtype and are averaged back to the param dtype at the end."""
         if (self.mesh.shape.get("pipeline", 1) > 1
                 and self.cfg.pipeline_schedule == "1f1b"):
-            if self.cfg.accum_steps > 1:
-                raise NotImplementedError(
-                    "1f1b already microbatches the step; combine via "
-                    "num_microbatches instead of accum_steps")
-            return self._pipeline_1f1b_grads(params, tokens)
+            # accum x 1F1B composes: each accum chunk runs the full 1F1B
+            # round over its microbatches; grads average across chunks in
+            # the same f32 scan as the non-pipelined path below
+            grad_fn = self._pipeline_1f1b_grads
+        else:
+            grad_fn = jax.value_and_grad(self._loss_fn)
         accum = self.cfg.accum_steps
         if accum <= 1:
-            return jax.value_and_grad(self._loss_fn)(params, tokens)
+            return grad_fn(params, tokens)
         b = tokens.shape[0]
         if b % accum:
             raise ValueError(
@@ -284,7 +360,6 @@ class Trainer:
         micro = tokens.reshape(b // accum, accum, -1).swapaxes(0, 1)
         micro = shardlib.constrain_microbatches(
             micro, self.mesh, self.batch_sharding)
-        grad_fn = jax.value_and_grad(self._loss_fn)
 
         def body(carry, mb):
             acc_loss, acc = carry
@@ -311,13 +386,15 @@ class Trainer:
 
         mcfg = self.cfg.model
         if mcfg.tie_embeddings:
+            # documented hole (r4): 1F1B's last stage would need the embed
+            # table (owned by the data-parallel embedder) for the tied
+            # unembedding AND its gradient psum'd back across the schedule
+            # boundary — use pipeline_schedule='gpipe' for tied-embedding
+            # models (GPipe differentiates the whole graph, so the tie
+            # costs nothing there).
             raise NotImplementedError(
                 "tie_embeddings under 1f1b needs the embed table at the last "
                 "stage; use pipeline_schedule='gpipe'")
-        if mcfg.moe_experts > 0 and self.cfg.aux_loss_coef > 0:
-            raise NotImplementedError(
-                "MoE aux-loss collection is not plumbed through the "
-                "pipelined executor; set aux_loss_coef=0 explicitly")
         if not mcfg.scan_layers:
             raise ValueError("pipeline schedules require scan_layers=True")
         inputs, targets = tokens[:, :-1], tokens[:, 1:]
@@ -326,20 +403,46 @@ class Trainer:
         x, embed_vjp = jax.vjp(
             lambda ep: embed.apply({"params": ep}, inputs), params["embedder"])
 
-        def block_apply(layer_params, h):
-            return llamalib.Block(mcfg).apply(
-                {"params": layer_params}, h, positions)
+        collect = mcfg.moe_experts > 0 and self.cfg.aux_loss_coef > 0
+        if collect:
+            # MoE x 1F1B: the balancing loss + its gradient ride the
+            # schedule's own fused backward (one_f_one_b with_aux)
+            block_apply = llamalib.block_apply_with_aux(mcfg, positions)
+            m = self.cfg.num_microbatches or self.mesh.shape["pipeline"]
+            aux_weight = self.cfg.aux_loss_coef / (mcfg.num_layers * m)
+        else:
+            aux_weight = 0.0
+
+            def block_apply(layer_params, h):
+                return llamalib.Block(mcfg).apply(
+                    {"params": layer_params}, h, positions)
 
         def loss_fn(head_params, y, tgt):
             logits = llamalib.Head(mcfg).apply({"params": head_params}, y)
             return optax.softmax_cross_entropy_with_integer_labels(
                 logits.astype(jnp.float32), tgt).mean()
 
+        stacked = params["layers"]["block"]
+        V = self.cfg.pipeline_interleave
+        if V > 1:
+            # interleaved layout: device d must hold model chunks
+            # {d, P+d, ...}; permute the canonical layer axis to the
+            # executor's device-contiguous order (and unpermute grads)
+            perm = pipelib.interleave_permutation(
+                mcfg.num_layers, self.mesh.shape["pipeline"], V)
+            inv = jnp.asarray(np.argsort(perm))
+            perm = jnp.asarray(perm)
+            stacked = jax.tree.map(
+                lambda a: jnp.take(a, perm, axis=0), stacked)
         loss, (dlayers, dhead, dx) = pipelib.one_f_one_b(
-            block_apply, loss_fn, params["layers"]["block"], params["head"],
+            block_apply, loss_fn, stacked, params["head"],
             x, targets,
             mesh=self.mesh, num_microbatches=self.cfg.num_microbatches,
-            remat=mcfg.remat)
+            remat=mcfg.remat, with_aux=collect, aux_weight=aux_weight,
+            interleave=V)
+        if V > 1:
+            dlayers = jax.tree.map(
+                lambda a: jnp.take(a, inv, axis=0), dlayers)
         (dembed,) = embed_vjp(dx)
         return loss, {
             "embedder": dembed,

@@ -71,7 +71,40 @@ def bench(name: str, **cfg_kw) -> dict:
     }
 
 
+def sweep() -> None:
+    """Grouped-GEMM tuning sweep (r3 verdict item 9): tiling x accumulator
+    dtype on the real chip.  Each candidate re-jits the ragged+grouped
+    layer with the override installed."""
+    from kubeflow_tpu.ops import grouped_matmul as gmmlib
+
+    candidates = [
+        (128, 128, 128, jnp.float32),   # r3 default
+        (512, 512, 512, jnp.float32),
+        (512, 1024, 1024, jnp.float32),
+        (1024, 512, 1408, jnp.float32),
+        (256, 1024, 704, jnp.float32),
+        (512, 1024, 1024, jnp.bfloat16),
+        (128, 128, 128, jnp.bfloat16),
+    ]
+    best = None
+    for tm, tk, tn, acc in candidates:
+        gmmlib.set_gmm_tiling(tm, tk, tn, acc_dtype=acc)
+        r = bench(f"grouped_t{tm}x{tk}x{tn}_{jnp.dtype(acc).name}",
+                  moe_dispatch="ragged", moe_ragged_compute="grouped")
+        r["tiling"] = [tm, tk, tn]
+        r["acc_dtype"] = jnp.dtype(acc).name
+        print(json.dumps(r), flush=True)
+        if best is None or r["ms_per_step"] < best["ms_per_step"]:
+            best = r
+    print(json.dumps({"metric": "moe_gmm_sweep_best", **{
+        k: best[k] for k in ("impl", "ms_per_step", "tiling", "acc_dtype")}}),
+        flush=True)
+
+
 def main() -> None:
+    if "--sweep" in sys.argv:
+        sweep()
+        return
     rows = [
         bench("dense_capacity_1.25", moe_dispatch="dense",
               moe_capacity_factor=1.25),

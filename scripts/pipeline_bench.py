@@ -37,7 +37,7 @@ from kubeflow_tpu.parallel import pipeline as pipelib  # noqa: E402
 from kubeflow_tpu.parallel import sharding as shardlib  # noqa: E402
 
 P_STAGES = 4
-LAYERS = 8
+LAYERS = 16  # divisible by P_STAGES x the largest interleave (4)
 WIDTH = 256
 BATCH = 32
 STEPS = 10
@@ -60,7 +60,7 @@ def problem():
     return block_apply, loss_fn, ws, head, x, tgt
 
 
-def bench(schedule: str, m: int) -> dict:
+def bench(schedule: str, m: int, v: int = 1) -> dict:
     block_apply, loss_fn, ws, head, x, tgt = problem()
     mesh = meshlib.build_mesh({"pipeline": P_STAGES, "data": 8 // P_STAGES})
 
@@ -72,10 +72,17 @@ def bench(schedule: str, m: int) -> dict:
                 return loss_fn(hp, y, tgt)
             return jax.value_and_grad(loss, argnums=(0, 1))(ws, hp)
     else:
+        perm = pipelib.interleave_permutation(LAYERS, P_STAGES, v)
+
         def step(ws, hp, x, tgt):
-            return pipelib.one_f_one_b(
-                block_apply, loss_fn, ws, hp, x, tgt,
-                mesh=mesh, num_microbatches=m)
+            # the interleaved layout permute is part of the step (as in
+            # the trainer) so its cost is measured, not hidden
+            loss, (dws, dhead, dx) = pipelib.one_f_one_b(
+                block_apply, loss_fn,
+                ws if v == 1 else jnp.take(ws, jnp.asarray(perm), axis=0),
+                hp, x, tgt,
+                mesh=mesh, num_microbatches=m, interleave=v)
+            return loss, dws
 
     with shardlib.shard_context(mesh):
         lowered = jax.jit(step).lower(ws, head, x, tgt)
@@ -91,29 +98,45 @@ def bench(schedule: str, m: int) -> dict:
 
     row = {
         "metric": "pipeline_schedule_probe",
-        "schedule": schedule,
+        "schedule": schedule if v == 1 else f"{schedule}-v{v}",
         "stages": P_STAGES,
+        "interleave": v,
         "microbatches": m,
         "step_ms": round(dt * 1e3, 2),
         "temp_bytes_per_device": getattr(mem, "temp_size_in_bytes", None),
         "argument_bytes": getattr(mem, "argument_size_in_bytes", None),
     }
+    # Unified slot accounting (r3 ADVICE: the two schedules' fractions
+    # must use the same units).  A "slot" is one microbatch-direction of
+    # work at one stage; per stage a full step fills exactly 2M slots
+    # (M fwd + M bwd) in EVERY schedule.  Capacity differs: GPipe runs a
+    # fwd sweep then a bwd sweep — 2(M+P-1) one-slot ticks; 1F1B runs
+    # M+2(P-1) two-slot ticks (each tick holds one fwd AND one bwd slot).
+    # useful = filled / capacity = M/(M+P-1) vs M/(M+2(P-1)) — derived
+    # from the same accounting, so the columns compare directly.
     if schedule == "1f1b":
-        s = pipelib.schedule_1f1b(P_STAGES, m)
-        row["ticks"] = s.ticks
-        row["useful_fraction"] = round(s.useful_fraction, 3)
+        s = pipelib.schedule_1f1b(P_STAGES, m, v)
+        ticks, slots_per_tick = s.ticks, 2
+        filled = int((s.fwd >= 0).sum() + (s.bwd >= 0).sum())
         row["act_stash_microbatches"] = s.act_slots
+        # wall ticks in STAGE units (a v-chunk tick is 1/v of a stage)
+        row["stage_ticks"] = round(ticks / v, 2)
     else:
-        row["ticks"] = m + P_STAGES - 1
-        row["useful_fraction"] = round(m / (m + P_STAGES - 1), 3)
+        ticks, slots_per_tick = 2 * (m + P_STAGES - 1), 1
+        filled = 2 * m * P_STAGES
         row["act_stash_microbatches"] = m
+        row["stage_ticks"] = ticks / 2  # fwd+bwd pairs
+    row["ticks"] = ticks
+    row["useful_fraction"] = round(
+        filled / (slots_per_tick * ticks * P_STAGES), 3)
     return row
 
 
 def main() -> None:
     for m in (4, 8, 16):
-        for schedule in ("gpipe", "1f1b"):
-            print(json.dumps(bench(schedule, m)), flush=True)
+        for schedule, v in (("gpipe", 1), ("1f1b", 1), ("1f1b", 2),
+                            ("1f1b", 4)):
+            print(json.dumps(bench(schedule, m, v)), flush=True)
 
 
 if __name__ == "__main__":

@@ -208,3 +208,64 @@ class TestWatch:
         assert rc == 0
         out = capsys.readouterr().out
         assert "ADDED\tdefault/streamed" in out, out
+
+
+class TestStructuredErrors:
+    def test_error_reasons(self, api_cluster):
+        """The apiserver returns a structured ``reason`` (kube Status
+        analog) — clients branch on it, never on message substrings."""
+        import urllib.error
+
+        _, url = api_cluster
+        body = {"kind": "Profile", "metadata": {"name": "reasoned"},
+                "spec": {"owner": "r@corp"}}
+        req = urllib.request.Request(
+            f"{url}/apis/Profile", data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"})
+        urllib.request.urlopen(req, timeout=10)
+        try:
+            urllib.request.urlopen(req, timeout=10)
+            raise AssertionError("expected 409")
+        except urllib.error.HTTPError as e:
+            assert json.loads(e.read())["reason"] == "AlreadyExists"
+        try:
+            urllib.request.urlopen(
+                f"{url}/apis/Profile/default/ghost", timeout=10)
+            raise AssertionError("expected 404")
+        except urllib.error.HTTPError as e:
+            assert json.loads(e.read())["reason"] == "NotFound"
+
+    def test_watch_cursor_expiry_410(self, api_cluster):
+        """A cursor older than the retained window gets 410 Gone with a
+        resync cursor instead of a silent gap (kube-apiserver semantics)."""
+        import urllib.error
+        from collections import deque
+
+        cluster, url = api_cluster
+        api = cluster._apiserver
+        # shrink the buffer so eviction is reachable, then overflow it
+        with api._events_cond:
+            api._events = deque(api._events, maxlen=4)
+        for i in range(8):
+            body = {"kind": "Profile", "metadata": {"name": f"spam-{i}"},
+                    "spec": {"owner": "s@corp"}}
+            req = urllib.request.Request(
+                f"{url}/apis/Profile", data=json.dumps(body).encode(),
+                headers={"Content-Type": "application/json"})
+            urllib.request.urlopen(req, timeout=10)
+        import time as _time
+        deadline = _time.time() + 10
+        while api._evicted_seq == 0 and _time.time() < deadline:
+            _time.sleep(0.05)
+        assert api._evicted_seq > 0
+        try:
+            _get(f"{url}/apis/Profile?watch=true&timeout=0.2&cursor=1")
+            raise AssertionError("expected 410")
+        except urllib.error.HTTPError as e:
+            payload = json.loads(e.read())
+            assert e.code == 410 and payload["reason"] == "Expired"
+            # resync cursor = eviction boundary: re-polling with it must
+            # deliver the RETAINED window, not skip to the head
+            out = _get(f"{url}/apis/Profile?watch=true&timeout=0.2"
+                       f"&cursor={payload['cursor']}")
+            assert out["items"], "retained events lost on resync"

@@ -148,3 +148,94 @@ class TestContinuousRuntime:
             assert [results[i] for i in range(len(prompts))] == expected
         finally:
             m.stop()
+
+
+class TestPrefixCache:
+    """r3 verdict item 7: KV prefix reuse at admission — repeated prompts
+    skip the shared prefill via an on-device slot-to-slot copy."""
+
+    LONG = list(range(1, 49))  # 48-token shared prefix
+
+    def test_prefix_hit_output_parity(self, tiny_llama):
+        """A prefix-cached admission must produce EXACTLY the tokens a
+        cold admission produces (greedy)."""
+        cold = make_engine(tiny_llama, prefix_cache=False)
+        try:
+            first = cold.generate(self.LONG, max_new_tokens=5)
+            again = cold.generate(self.LONG, max_new_tokens=5)
+        finally:
+            cold.stop()
+        assert first == again
+
+        warm = make_engine(tiny_llama, prefix_cache=True, min_prefix=8)
+        try:
+            a = warm.generate(self.LONG, max_new_tokens=5)
+            assert warm.prefix_hits == 0  # nothing to match yet
+            b = warm.generate(self.LONG, max_new_tokens=5)
+            assert warm.prefix_hits == 1
+            assert warm.prefix_tokens_saved >= len(self.LONG) - 1
+        finally:
+            warm.stop()
+        assert a == first and b == first
+
+    def test_conversation_continuation_prefix(self, tiny_llama):
+        """prompt + generated-turn resent (the chat pattern): the whole
+        previous conversation matches as prefix, only the new turn
+        prefills."""
+        eng = make_engine(tiny_llama, prefix_cache=True, min_prefix=8)
+        try:
+            turn1 = eng.generate(self.LONG, max_new_tokens=4)
+            followup = self.LONG + turn1 + [7, 8, 9]
+            cold = make_engine(tiny_llama, prefix_cache=False)
+            try:
+                want = cold.generate(followup, max_new_tokens=4)
+            finally:
+                cold.stop()
+            got = eng.generate(followup, max_new_tokens=4)
+            assert eng.prefix_hits == 1
+            # the saved prefix covers at least the original prompt
+            assert eng.prefix_tokens_saved >= len(self.LONG)
+        finally:
+            eng.stop()
+        assert got == want
+
+    def test_short_common_prefix_not_matched(self, tiny_llama):
+        eng = make_engine(tiny_llama, prefix_cache=True, min_prefix=32)
+        try:
+            eng.generate(self.LONG[:8] + [100, 101], max_new_tokens=3)
+            eng.generate(self.LONG[:8] + [102, 103], max_new_tokens=3)
+            assert eng.prefix_hits == 0  # 8 < min_prefix
+        finally:
+            eng.stop()
+
+    def test_divergent_suffix_correct(self, tiny_llama):
+        """Shared prefix, different suffix: outputs must match cold runs
+        for BOTH suffixes."""
+        p1 = self.LONG + [60, 61, 62]
+        p2 = self.LONG + [70, 71]
+        cold = make_engine(tiny_llama, prefix_cache=False)
+        try:
+            w1 = cold.generate(p1, max_new_tokens=4)
+            w2 = cold.generate(p2, max_new_tokens=4)
+        finally:
+            cold.stop()
+        eng = make_engine(tiny_llama, prefix_cache=True, min_prefix=8)
+        try:
+            g1 = eng.generate(p1, max_new_tokens=4)
+            g2 = eng.generate(p2, max_new_tokens=4)
+            assert eng.prefix_hits == 1
+        finally:
+            eng.stop()
+        assert g1 == w1 and g2 == w2
+
+    def test_prefix_cache_on_sharded_mesh(self, tiny_llama):
+        """Prefix copy + suffix prefill compose with the TP pool."""
+        eng = make_engine(tiny_llama, prefix_cache=True, min_prefix=8,
+                          mesh_axes={"model": 2})
+        try:
+            a = eng.generate(self.LONG, max_new_tokens=4)
+            b = eng.generate(self.LONG, max_new_tokens=4)
+            assert eng.prefix_hits == 1
+        finally:
+            eng.stop()
+        assert a == b

@@ -247,3 +247,24 @@ spec:
         assert open(os.path.join(root, "pbt-b", "pbt_base_step")).read() == "4"
         steps = [l for l in logs.splitlines() if l.startswith("loss=")]
         assert steps  # trained past the fork
+
+
+@pytest.mark.e2e
+class TestCompileCache:
+    def test_compile_cache_populated(self, platform, tmp_path):
+        """KFT_COMPILE_CACHE wires jax's persistent compilation cache into
+        the pod runtime (warm gang restarts, BASELINE metric #2): after a
+        job runs with it, the cache dir holds compiled entries."""
+        cache = tmp_path / "xla-cache"
+        client = TrainingClient(platform)
+        job = client.train(
+            name="cachejob",
+            entrypoint="kubeflow_tpu.models.mnist:train_main",
+            num_workers=1,
+            env={"KFT_STEPS": "2", "KFT_BATCH": "8",
+                 "KFT_COMPILE_CACHE": str(cache)},
+            timeout=120,
+        )
+        assert has_condition(job.status.conditions, JobConditionType.SUCCEEDED)
+        entries = list(cache.glob("*")) if cache.exists() else []
+        assert entries, "persistent compile cache stayed empty"

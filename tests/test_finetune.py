@@ -1,0 +1,168 @@
+"""Pretrained-snapshot fine-tune UX — the literal north-star example.
+
+SURVEY.md §3.5: ``TrainingClient.train()`` fine-tuning a published model is
+the reference SDK's v1.9 LLM path.  Here: ``llama.save_pretrained`` writes
+the snapshot, ``KFT_INIT_FROM=hf://org/name[@rev]`` (resolved through the
+storage initializer) initializes a JaxJob's trainer from it, and
+``TrainingClient.train(model=...)`` is the one-call UX.
+"""
+
+import os
+import re
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubeflow_tpu.models import llama as llamalib
+from kubeflow_tpu.train import trainer as trainlib
+
+
+def _trees_equal(a, b):
+    ok = True
+    for la, lb in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        ok = ok and np.allclose(np.asarray(la), np.asarray(lb))
+    return ok
+
+
+class TestSnapshotRoundtrip:
+    def test_save_load_roundtrip(self, tmp_path):
+        cfg = llamalib.tiny()
+        params = llamalib.Llama(cfg).init(
+            jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32))["params"]
+        path = str(tmp_path / "snap")
+        llamalib.save_pretrained(path, cfg, params)
+        cfg2, params2 = llamalib.load_pretrained(path)
+        assert cfg2 == cfg
+        from flax import linen as nn
+
+        assert _trees_equal(nn.meta.unbox(params), params2)
+
+    def test_load_config_only(self, tmp_path):
+        cfg = llamalib.tiny(num_layers=3)
+        path = str(tmp_path / "snap")
+        llamalib.save_pretrained(
+            path, cfg,
+            llamalib.Llama(cfg).init(
+                jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32))["params"])
+        got = llamalib.load_pretrained_config(path)
+        assert got.num_layers == 3 and got == cfg
+
+
+class TestTrainerInitFrom:
+    def _snapshot(self, tmp_path, cfg, seed=0):
+        params = llamalib.Llama(cfg).init(
+            jax.random.PRNGKey(seed), jnp.ones((1, 8), jnp.int32))["params"]
+        path = str(tmp_path / "snap")
+        llamalib.save_pretrained(path, cfg, params)
+        from flax import linen as nn
+
+        return path, nn.meta.unbox(params)
+
+    def test_params_load_and_optimizer_fresh(self, tmp_path):
+        cfg = llamalib.tiny()
+        path, want = self._snapshot(tmp_path, cfg, seed=7)
+        t = trainlib.Trainer(trainlib.TrainConfig(
+            model=cfg, steps=1, global_batch=8, seq_len=16, init_from=path))
+        state = t.init_state()
+        assert _trees_equal(state["params"], want)
+        assert int(state["step"]) == 0
+
+    def test_init_from_on_sharded_mesh(self, tmp_path):
+        """Weights must land correctly when params shard over fsdp+model —
+        the 7B-over-v5e-16 layout in miniature."""
+        cfg = llamalib.tiny()
+        path, want = self._snapshot(tmp_path, cfg, seed=3)
+        t = trainlib.Trainer(trainlib.TrainConfig(
+            model=cfg, steps=1, global_batch=8, seq_len=16, init_from=path,
+            mesh_axes={"fsdp": 2, "model": 2, "data": 2}))
+        state = t.init_state()
+        wg = state["params"]["layers"]["block"]["mlp"]["w_gate"]["kernel"]
+        assert not wg.sharding.is_fully_replicated  # actually sharded
+        assert _trees_equal(state["params"], want)  # and still the snapshot
+
+    def test_arch_mismatch_raises(self, tmp_path):
+        path, _ = self._snapshot(tmp_path, llamalib.tiny(num_layers=2))
+        t = trainlib.Trainer(trainlib.TrainConfig(
+            model=llamalib.tiny(num_layers=3), steps=1, global_batch=8,
+            seq_len=16, init_from=path))
+        with pytest.raises(ValueError, match="num_layers"):
+            t.init_state()
+
+    def test_resume_wins_over_init(self, tmp_path, tmp_ckpt_dir):
+        """A newer checkpoint beats the pretrained snapshot: a gang restart
+        mid-fine-tune must resume, not re-load the base model."""
+        cfg = llamalib.tiny()
+        path, want = self._snapshot(tmp_path, cfg)
+        base = trainlib.TrainConfig(
+            model=cfg, steps=3, global_batch=8, seq_len=16,
+            checkpoint_dir=tmp_ckpt_dir, save_interval_steps=1)
+        t1 = trainlib.Trainer(base)
+        t1.train()
+        import dataclasses
+
+        t2 = trainlib.Trainer(dataclasses.replace(base, init_from=path))
+        state = t2.restore_or_init()
+        assert int(jax.device_get(state["step"])) == 3
+        assert not _trees_equal(state["params"], want)
+
+
+@pytest.mark.e2e
+class TestFinetuneE2E:
+    def test_hf_snapshot_finetune_two_workers(self, tmp_path):
+        """The full north-star loop: pretrain -> publish as an hf:// hub
+        snapshot -> TrainingClient.train(model="hf://...") fine-tunes it as
+        a 2-process JaxJob whose first logged loss is FAR below the scratch
+        start (ln 256 ~ 5.55) — proof the weights actually loaded."""
+        from kubeflow_tpu.api.common import JobConditionType, has_condition
+        from kubeflow_tpu.runtime.platform import LocalPlatform
+        from kubeflow_tpu.sdk import TrainingClient
+
+        # -- pretrain in-process and capture the trained params
+        cfg = llamalib.tiny()
+        ck = str(tmp_path / "pre-ckpt")
+        pre = trainlib.Trainer(trainlib.TrainConfig(
+            model=cfg, steps=80, learning_rate=1e-2, global_batch=8,
+            seq_len=32, warmup_steps=5, log_every=20, checkpoint_dir=ck,
+            save_interval_steps=80))
+        final = pre.train()
+        assert final.loss < 3.0, f"pretrain did not converge: {final.loss}"
+        state = pre.ckpt.restore(pre.abstract_state())
+
+        # -- publish as a hub-layout snapshot with a pinned revision
+        hub = tmp_path / "hub"
+        repo = hub / "models--acme--tiny-llama"
+        snap = repo / "snapshots" / "c0ffee12"
+        llamalib.save_pretrained(str(snap), cfg, state["params"])
+        (repo / "refs").mkdir(parents=True)
+        (repo / "refs" / "main").write_text("c0ffee12")
+
+        # -- fine-tune as a 2-worker gang via the one-call SDK UX
+        with LocalPlatform(num_hosts=2, chips_per_host=4,
+                           root_dir=str(tmp_path / "cluster")) as platform:
+            client = TrainingClient(platform)
+            job = client.train(
+                name="finetune",
+                entrypoint="kubeflow_tpu.train.llm:train_main",
+                num_workers=2,
+                model="hf://acme/tiny-llama@main",
+                env={
+                    "KFT_HF_HOME": str(hub),
+                    "KFT_STEPS": "4",
+                    "KFT_BATCH": "8",
+                    "KFT_SEQ_LEN": "32",
+                    "KFT_LOG_EVERY": "1",
+                    "KFT_LR": "1e-4",
+                },
+                timeout=240,
+            )
+            assert has_condition(
+                job.status.conditions, JobConditionType.SUCCEEDED)
+            log = client.get_job_logs("finetune")["finetune-worker-0"]
+        losses = [float(m) for m in re.findall(r"loss=([0-9.]+)", log)]
+        assert losses, log
+        # scratch would start at ~ln(256)=5.55; the snapshot left off ~2.1
+        assert losses[0] < 3.5, losses
+        assert abs(losses[0] - final.loss) < 1.0, (losses[0], final.loss)

@@ -218,3 +218,133 @@ def test_1f1b_trainer_matches_single_mesh_loss_trajectory():
                  num_microbatches=4, pipeline_schedule="1f1b")
     assert len(ref) == len(pp) == 3
     np.testing.assert_allclose(pp, ref, atol=1e-4)
+
+
+class TestMoeAuxUnderPipeline:
+    """r3 verdict item 3: the Switch balancing loss must train through the
+    pipeline executors — a 7B-class MoE over two DCN slices is the natural
+    composition of 1F1B and dropless MoE."""
+
+    MOE = dict(num_layers=4, remat=True, moe_experts=2, moe_top_k=1)
+
+    def test_gpipe_collects_aux(self):
+        """Pipelined MoE loss includes the aux term: turning the coef off
+        lowers the objective by exactly a positive aux contribution."""
+        with_aux = _losses({"pipeline": 2, "data": 4}, steps=2,
+                           model=llamalib.tiny(**self.MOE),
+                           aux_loss_coef=0.01)
+        without = _losses({"pipeline": 2, "data": 4}, steps=2,
+                          model=llamalib.tiny(**self.MOE),
+                          aux_loss_coef=0.0)
+        assert all(np.isfinite(l) for l in with_aux + without)
+        # aux >= 1.0 by construction (Switch: balanced routing gives 1)
+        assert with_aux[0] > without[0] + 0.005
+
+    def test_1f1b_loss_matches_gpipe(self):
+        """Same microbatching, same aux normalization: the two schedules
+        compute the same objective."""
+        g = _losses({"pipeline": 2, "data": 4}, steps=2, num_microbatches=4,
+                    model=llamalib.tiny(**self.MOE), aux_loss_coef=0.01)
+        f = _losses({"pipeline": 2, "data": 4}, steps=2, num_microbatches=4,
+                    model=llamalib.tiny(**self.MOE), aux_loss_coef=0.01,
+                    pipeline_schedule="1f1b")
+        np.testing.assert_allclose(f, g, atol=1e-4)
+
+    def test_1f1b_aux_matches_single_mesh_trajectory(self):
+        """MoE + aux under 1F1B descends in lockstep with the plain-mesh
+        run (same per-microbatch aux: single-mesh computes aux on the full
+        batch, so allow a loose tolerance on the regularizer term)."""
+        ref = _losses({"data": 8}, steps=3,
+                      model=llamalib.tiny(**self.MOE), aux_loss_coef=0.01)
+        pp = _losses({"pipeline": 2, "data": 4}, steps=3, num_microbatches=4,
+                     model=llamalib.tiny(**self.MOE), aux_loss_coef=0.01,
+                     pipeline_schedule="1f1b")
+        np.testing.assert_allclose(pp, ref, atol=0.02)
+
+
+class TestAccumUnder1F1B:
+    def test_accum_1f1b_matches_unaccumulated(self):
+        """accum x 1F1B: each accum chunk runs a full 1F1B round; the
+        averaged step must match the single-shot step."""
+        ref = _losses({"pipeline": 2, "data": 4}, steps=2,
+                      num_microbatches=2, pipeline_schedule="1f1b")
+        acc = _losses({"pipeline": 2, "data": 4}, steps=2,
+                      num_microbatches=2, pipeline_schedule="1f1b",
+                      accum_steps=2)
+        np.testing.assert_allclose(acc, ref, atol=1e-4)
+
+    def test_tie_embeddings_1f1b_still_documented(self):
+        """The one remaining guard (tie_embeddings x 1F1B) stays an
+        explicit, documented error — not a silent wrong answer."""
+        cfg = trainlib.TrainConfig(
+            model=llamalib.tiny(num_layers=4, tie_embeddings=True),
+            mesh_axes={"pipeline": 2, "data": 4},
+            global_batch=8, seq_len=32, steps=1, log_every=1,
+            pipeline_schedule="1f1b")
+        t = trainlib.Trainer(cfg, devices=jax.devices())
+        with pytest.raises(NotImplementedError, match="tie_embeddings"):
+            t.train()
+
+
+class TestInterleaved1F1B:
+    """Megatron virtual-stage interleaving (r3 verdict item 4): each
+    device owns V non-contiguous chunks; wall ticks hit the model's exact
+    lower bound T = MV+P+PV-2 chunk-ticks (= fewer stage-times than
+    non-interleaved's (M+2P-2) x V as V grows)."""
+
+    @pytest.mark.parametrize("p,m,v", [(2, 4, 2), (4, 8, 2), (2, 4, 4)])
+    def test_interleaved_matches_sequential(self, p, m, v):
+        """Interleaved fused value-and-grad == sequential autodiff, given
+        the documented layer permutation."""
+        block_apply, loss_fn, ws, head, x, tgt, seq_ref = _mlp_problem()
+        mesh = meshlib.build_mesh({"pipeline": p, "data": 8 // p})
+        ref_loss, ref_grads = jax.jit(
+            jax.value_and_grad(seq_ref, argnums=(0, 1, 2)))(ws, head, x)
+
+        perm = pipelib.interleave_permutation(ws.shape[0], p, v)
+        inv = np.argsort(perm)
+        with shardlib.shard_context(mesh):
+            loss, (dws, dhead, dx) = jax.jit(
+                lambda ws, hp, x, tgt: pipelib.one_f_one_b(
+                    block_apply, loss_fn, ws, hp, x, tgt,
+                    mesh=mesh, num_microbatches=m, interleave=v)
+            )(ws[perm], head, x, tgt)
+        dws = np.asarray(dws)[inv]
+
+        np.testing.assert_allclose(float(loss), float(ref_loss), atol=1e-6)
+        np.testing.assert_allclose(dws, np.asarray(ref_grads[0]), atol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(dhead), np.asarray(ref_grads[1]), atol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(dx), np.asarray(ref_grads[2]), atol=1e-5)
+
+    def test_schedule_hits_lower_bound(self):
+        for p, m, v in [(2, 4, 2), (4, 8, 2), (4, 8, 4), (4, 16, 4)]:
+            s = pipelib.schedule_1f1b(p, m, v)
+            assert s.ticks == m * v + p + p * v - 2
+            # per-chunk order: every chunk forwards/backwards its
+            # microbatches in order, exactly once
+            assert (s.fwd >= 0).sum() == m * v * p
+            assert (s.bwd >= 0).sum() == m * v * p
+            # stash bound: ~P*V chunk inputs, never M*V
+            assert s.act_slots <= p * v + 2
+
+    def test_interleaved_wall_ticks_beat_non_interleaved(self):
+        """In equal work units (chunk-ticks / V), interleaving shortens
+        the step: T(V)/V = M + P + (P-2)/V, strictly below T(1) for P>2
+        (at P=2 the (P-2)/V term vanishes and it ties)."""
+        for p, m in [(2, 8), (4, 8), (4, 16)]:
+            t1 = pipelib.schedule_1f1b(p, m, 1).ticks
+            for v in (2, 4):
+                tv = pipelib.schedule_1f1b(p, m, v).ticks
+                if p > 2:
+                    assert tv / v < t1, (p, m, v, tv, t1)
+                else:
+                    assert tv / v <= t1, (p, m, v, tv, t1)
+
+    def test_trainer_interleaved_matches_single_mesh(self):
+        ref = _losses({"data": 8}, steps=2)
+        il = _losses({"pipeline": 2, "data": 4}, steps=2,
+                     num_microbatches=4, pipeline_schedule="1f1b",
+                     pipeline_interleave=2)
+        np.testing.assert_allclose(il, ref, atol=1e-4)

@@ -1,0 +1,153 @@
+"""Sharded (multi-chip) serving parity on the 8-device CPU mesh.
+
+The reference's serving tier spans accelerators natively (TP vLLM/Triton
+instances; SURVEY.md §2.2).  These tests prove the TP serving path —
+weights and KV pool sharded over a ``{"model": N}`` mesh — produces the
+SAME tokens as the single-device path, on the same virtual-device SPMD
+backend the trainer parity tests use.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubeflow_tpu.models import llama as llamalib
+from kubeflow_tpu.serving import sharded as shardedlib
+from kubeflow_tpu.serving.continuous import ContinuousEngine
+from kubeflow_tpu.serving.runtimes import LlamaGenerator
+from kubeflow_tpu.serving.storage import register_mem
+
+
+def _tiny():
+    cfg = llamalib.tiny()
+    model = llamalib.Llama(cfg)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32))["params"]
+    return cfg, params
+
+
+PROMPTS = [[1, 2, 3], [4, 5, 6, 7, 8], [9]]
+
+
+class TestShardedGenerator:
+    def test_tp_parity_with_single_device(self):
+        cfg, params = _tiny()
+        ref = register_mem("shardllama", (cfg, params))
+        single = LlamaGenerator("g1", {"params_ref": ref, "max_new_tokens": 4})
+        single.start()
+        want = single.predict_batch(PROMPTS)
+
+        tp = LlamaGenerator(
+            "g2", {"params_ref": ref, "max_new_tokens": 4,
+                   "mesh_axes": {"model": 2}})
+        tp.start()
+        got = tp.predict_batch(PROMPTS)
+        assert got == want
+
+    def test_params_and_cache_actually_sharded(self):
+        cfg, params = _tiny()
+        ref = register_mem("shardllama2", (cfg, params))
+        g = LlamaGenerator(
+            "g", {"params_ref": ref, "max_new_tokens": 2,
+                  "mesh_axes": {"model": 2}})
+        g.start()
+        # weights: the mlp kernel's hidden dim must be split over 2 devices
+        wg = g.params["layers"]["block"]["mlp"]["w_gate"]["kernel"]
+        assert len(wg.sharding.device_set) == 2
+        shard_shapes = {s.data.shape for s in wg.addressable_shards}
+        full = wg.shape
+        assert all(sh[-1] == full[-1] // 2 for sh in shard_shapes)
+        # KV cache: kv_heads axis split
+        cache = g._init_cache(2)
+        leaf = jax.tree.leaves(
+            {k: v for k, v in cache.items()})  # any collection layout
+        big = [x for x in leaf if x.ndim >= 4]
+        assert big, "expected tensor cache leaves"
+        for x in big:
+            assert len(x.sharding.device_set) == 2
+            assert {s.data.shape[-2] for s in x.addressable_shards} == {
+                x.shape[-2] // 2}
+
+    def test_tp4_parity(self):
+        """model axis 4 needs q_per_kv grouping to still work: tiny has 2
+        kv heads, so TP=4 would split heads below kv groups — use a config
+        with 4 kv heads instead."""
+        cfg = llamalib.tiny(num_heads=4, num_kv_heads=4)
+        model = llamalib.Llama(cfg)
+        params = model.init(
+            jax.random.PRNGKey(1), jnp.ones((1, 8), jnp.int32))["params"]
+        ref = register_mem("shardllama4", (cfg, params))
+        single = LlamaGenerator("s", {"params_ref": ref, "max_new_tokens": 3})
+        single.start()
+        want = single.predict_batch(PROMPTS)
+        tp = LlamaGenerator(
+            "t", {"params_ref": ref, "max_new_tokens": 3,
+                  "mesh_axes": {"model": 4}})
+        tp.start()
+        assert tp.predict_batch(PROMPTS) == want
+
+
+class TestShardedContinuousEngine:
+    def test_tp_engine_parity(self):
+        cfg, params = _tiny()
+        single = ContinuousEngine(
+            cfg, params, num_slots=4, decode_chunk=2, eos_id=None)
+        try:
+            want = [single.generate(p, max_new_tokens=5) for p in PROMPTS]
+        finally:
+            single.stop()
+
+        tp = ContinuousEngine(
+            cfg, params, num_slots=4, decode_chunk=2, eos_id=None,
+            mesh_axes={"model": 2})
+        try:
+            # pool buffers must be sharded over the mesh
+            big = [x for x in jax.tree.leaves(tp._pool_cache) if x.ndim >= 4]
+            assert big and all(len(x.sharding.device_set) == 2 for x in big)
+            got = [tp.generate(p, max_new_tokens=5) for p in PROMPTS]
+        finally:
+            tp.stop()
+        assert got == want
+
+    def test_tp_engine_concurrent_burst(self):
+        cfg, params = _tiny()
+        eng = ContinuousEngine(
+            cfg, params, num_slots=4, decode_chunk=2, eos_id=None,
+            mesh_axes={"model": 2})
+        try:
+            eng.warmup()
+            reqs = [eng.submit(p, max_new_tokens=4) for p in PROMPTS * 2]
+            outs = [r.wait(timeout=120) for r in reqs]
+        finally:
+            eng.stop()
+        assert all(len(o) == 4 for o in outs)
+        # same prompt -> same greedy continuation regardless of slot
+        assert outs[0] == outs[3] and outs[1] == outs[4] and outs[2] == outs[5]
+
+    def test_warmup_after_traffic_rejected(self):
+        cfg, params = _tiny()
+        eng = ContinuousEngine(cfg, params, num_slots=2, decode_chunk=1)
+        try:
+            eng.generate([1, 2], max_new_tokens=1)
+            with pytest.raises(RuntimeError, match="warmup"):
+                eng.warmup()
+        finally:
+            eng.stop()
+
+
+class TestServingMeshHelpers:
+    def test_build_mesh_uses_subset_of_devices(self):
+        mesh = shardedlib.build_serving_mesh({"model": 2})
+        assert mesh.devices.size == 2
+
+    def test_too_many_devices_raises(self):
+        with pytest.raises(ValueError, match="needs"):
+            shardedlib.build_serving_mesh({"model": 64})
+
+    def test_cache_sharding_replicates_scalars(self):
+        mesh = shardedlib.build_serving_mesh({"model": 2})
+        s = shardedlib.cache_leaf_sharding(mesh, 1)
+        assert s.is_fully_replicated
+        s5 = shardedlib.cache_leaf_sharding(mesh, 5)
+        assert not s5.is_fully_replicated

@@ -1,0 +1,91 @@
+"""gs:// and s3:// through the pluggable transport (r3 verdict item 8).
+
+The reference's storage initializer downloads cloud URIs into /mnt/models
+[upstream: kserve pkg/agent/storage]; this deployment has zero egress, so
+the capability is carried by an injectable transport staged through the
+same manifest-verified cache hf:// uses.  Real network stays refused.
+"""
+
+import os
+
+import pytest
+
+from kubeflow_tpu.serving import storage
+
+
+@pytest.fixture(autouse=True)
+def _clean_transports():
+    yield
+    storage.register_transport("gs://", None)
+    storage.register_transport("s3://", None)
+
+
+def _fake_transport(payload: dict, calls: list):
+    def fetch(uri, dest_dir):
+        calls.append(uri)
+        for rel, content in payload.items():
+            p = os.path.join(dest_dir, rel)
+            os.makedirs(os.path.dirname(p), exist_ok=True)
+            with open(p, "w") as f:
+                f.write(content)
+    return fetch
+
+
+class TestRemoteTransports:
+    @pytest.mark.parametrize("scheme", ["gs", "s3"])
+    def test_download_stages_through_manifest_cache(self, scheme, tmp_path):
+        calls = []
+        storage.register_transport(
+            f"{scheme}://",
+            _fake_transport({"config.json": "{}", "weights.msgpack": "W"},
+                            calls))
+        uri = f"{scheme}://bucket/models/demo"
+        path = storage.download(uri, cache_dir=str(tmp_path / "cache"))
+        assert sorted(os.listdir(path)) == ["config.json", "weights.msgpack"]
+        # manifest exists and validates
+        entry = os.path.dirname(path)
+        assert storage.verify_manifest(entry)
+        assert calls == [uri]
+
+    def test_cache_hit_skips_transport(self, tmp_path):
+        calls = []
+        storage.register_transport(
+            "gs://", _fake_transport({"m.bin": "data"}, calls))
+        cache = str(tmp_path / "cache")
+        p1 = storage.download("gs://b/m", cache_dir=cache)
+        p2 = storage.download("gs://b/m", cache_dir=cache)
+        assert p1 == p2 and calls == ["gs://b/m"]  # one fetch, two serves
+
+    def test_corrupted_entry_refetches(self, tmp_path):
+        calls = []
+        storage.register_transport(
+            "gs://", _fake_transport({"m.bin": "data"}, calls))
+        cache = str(tmp_path / "cache")
+        p1 = storage.download("gs://b/m", cache_dir=cache)
+        with open(os.path.join(p1, "m.bin"), "w") as f:
+            f.write("CORRUPTED")
+        p2 = storage.download("gs://b/m", cache_dir=cache)
+        assert len(calls) == 2
+        with open(os.path.join(p2, "m.bin")) as f:
+            assert f.read() == "data"
+
+    def test_no_transport_no_tool_raises_zero_egress(self, tmp_path,
+                                                     monkeypatch):
+        # guarantee the CLI-tool fallbacks are absent
+        monkeypatch.setenv("PATH", str(tmp_path))
+        with pytest.raises(storage.StorageError, match="egress"):
+            storage.download("gs://bucket/model")
+        with pytest.raises(storage.StorageError, match="egress"):
+            storage.download("s3://bucket/model")
+
+    def test_transport_failure_surfaces(self, tmp_path):
+        def broken(uri, dest):
+            raise storage.StorageError(f"{uri}: access denied")
+        storage.register_transport("gs://", broken)
+        with pytest.raises(storage.StorageError, match="access denied"):
+            storage.download("gs://b/m", cache_dir=str(tmp_path / "c"))
+
+    def test_empty_fetch_rejected(self, tmp_path):
+        storage.register_transport("gs://", lambda uri, dest: None)
+        with pytest.raises(storage.StorageError, match="no files"):
+            storage.download("gs://b/empty", cache_dir=str(tmp_path / "c"))
