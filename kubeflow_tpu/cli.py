@@ -41,11 +41,18 @@ class CliError(RuntimeError):
         self.cursor = cursor
 
 
+#: bearer token attached to every request (set by main() from --token /
+#: $KFT_TOKEN; the apiserver's single-admin-credential authn)
+_TOKEN: Optional[str] = None
+
+
 def _request(method: str, url: str, body: Optional[dict] = None) -> Any:
     data = json.dumps(body).encode() if body is not None else None
+    headers = {"Content-Type": "application/json"}
+    if _TOKEN:
+        headers["Authorization"] = f"Bearer {_TOKEN}"
     req = urllib.request.Request(
-        url, data=data, method=method,
-        headers={"Content-Type": "application/json"})
+        url, data=data, method=method, headers=headers)
     try:
         with urllib.request.urlopen(req, timeout=30) as resp:
             raw = resp.read()
@@ -224,6 +231,9 @@ def build_parser() -> argparse.ArgumentParser:
         prog="kft", description="kubectl-style CLI for the TPU platform")
     p.add_argument("--server", default=os.environ.get("KFT_SERVER"),
                    help="API server URL (or $KFT_SERVER)")
+    p.add_argument("--token", default=os.environ.get("KFT_TOKEN"),
+                   help="bearer token for a token-protected API server "
+                        "(or $KFT_TOKEN)")
     sub = p.add_subparsers(dest="cmd", required=True)
 
     sp = sub.add_parser("apply", help="create or update from a manifest")
@@ -262,7 +272,9 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def main(argv: Optional[list[str]] = None) -> int:
+    global _TOKEN
     args = build_parser().parse_args(argv)
+    _TOKEN = args.token
     if not args.server:
         print("kft: no API server (--server or $KFT_SERVER)", file=sys.stderr)
         return 2

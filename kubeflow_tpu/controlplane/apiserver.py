@@ -23,6 +23,17 @@ Routes (JSON bodies; YAML accepted on writes):
 
 Error mapping follows the apiserver conventions: 404 NotFound, 409
 AlreadyExists/Conflict, 422 admission-rejected.
+
+Authn (an explicit scoping decision, not an accident): the server
+supports ONE cluster-admin bearer token (``token=`` / $KFT_API_TOKEN) —
+every route except ``/healthz`` requires ``Authorization: Bearer <t>``
+when set, else 401 Unauthorized.  That is the whole story by design:
+the reference's RBAC lives in kube-apiserver + Profile-namespace
+bindings; here Profiles (ux/profiles.py) own namespace quotas while the
+HTTP surface is flat admin — per-user tokens/RBAC would need an
+identity provider this environment doesn't have, so the boundary is
+"one platform-admin credential", stated rather than implied.  Default
+(no token) preserves the open local-dev surface.
 """
 
 from __future__ import annotations
@@ -80,10 +91,15 @@ class ApiServer:
     """HTTP facade over a Store (one per cluster)."""
 
     def __init__(self, store: Store, port: Optional[int] = None,
-                 log_path_for: Optional[Callable[[str, str], str]] = None):
+                 log_path_for: Optional[Callable[[str, str], str]] = None,
+                 token: Optional[str] = None):
+        import os
+
         self.store = store
         self.log_path_for = log_path_for
         self.port = port or allocate_port()
+        self.token = token if token is not None else os.environ.get(
+            "KFT_API_TOKEN") or None
         api = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -163,6 +179,16 @@ class ApiServer:
         # errors carry a structured ``reason`` (kube-apiserver Status.reason
         # analog) so clients branch on it, never on message text — substring
         # matching misclassified a 422 whose message contained "exists"
+        if self.token and urlparse(h.path).path != "/healthz":
+            import hmac
+
+            got = h.headers.get("Authorization", "")
+            # constant-time compare: a plain != short-circuits at the
+            # first differing byte — a timing oracle on the credential
+            if not hmac.compare_digest(got, f"Bearer {self.token}"):
+                h._send(401, {"error": "missing or invalid bearer token",
+                              "reason": "Unauthorized"})
+                return
         try:
             self._route(h, method)
         except NotFound as e:

@@ -103,15 +103,18 @@ class Cluster:
         self.add_controller(ProfileController(self.store))
         self.add_controller(NotebookController(self.store))
 
-    def serve_api(self, port: int = 0) -> str:
+    def serve_api(self, port: int = 0, token: "str | None" = None) -> str:
         """Start the REST API server (kube-apiserver analog) over this
         cluster's store; returns its URL for the kft CLI ($KFT_SERVER).
-        Stopped with the cluster."""
+        Stopped with the cluster.  ``token`` (or $KFT_API_TOKEN) turns on
+        bearer-token authn — the documented single-admin-credential
+        scoping (apiserver.py module docstring)."""
         from .apiserver import ApiServer
 
         self._apiserver = ApiServer(
             self.store, port=port or None,
-            log_path_for=getattr(self, "_log_path_for", None))
+            log_path_for=getattr(self, "_log_path_for", None),
+            token=token)
         return self._apiserver.url
 
     def serve_dashboard(self, port: int = 0) -> str:

@@ -185,15 +185,16 @@ def _ragged_moe(x, idx, gates, w_gate, w_up, w_down, *, dtype,
     m_dim = w_up.shape[-1]
     e_local_static = e // max(d, 1)
     if compute == "auto":
-        # measured on v5e (scripts/moe_bench.py, PERF.md): the MegaBlox
-        # grouped GEMM runs at ~20% of plain-matmul efficiency at MoE
-        # shapes, so the masked path (E_local x full-buffer matmuls that
-        # XLA fuses at full MXU rate) wins until the expert count per
-        # device is large; sharded EP keeps e_local small, so auto
-        # defaults to masked and flips only for fat local expert sets
+        # measured on v5e (scripts/moe_bench.py --sweep, PERF.md): with
+        # the r4 tile sizes (512,1024,1024) the grouped GEMM runs the
+        # E=8 top-2 layer at 13.3 ms vs masked's 43.6 — the r3 "masked
+        # until >12 experts/device" threshold was an artifact of the old
+        # 128^3 tiling (69.4 ms).  Masked's E_local x full-buffer FLOP
+        # overhead loses as soon as there is more than one local expert;
+        # at e_local == 1 there is nothing to group.
         use_grouped = (
             jax.default_backend() == "tpu"
-            and e_local_static > 12
+            and e_local_static > 1
             and h % 128 == 0 and m_dim % 128 == 0)
     else:
         use_grouped = compute == "grouped"

@@ -51,10 +51,14 @@ def _block(dim: int, want: int) -> int:
 
 
 #: (tm, tk, tn) tile REQUEST for the megablox kernels (clamped per-shape
-#: by _block); tune via set_gmm_tiling or $KFT_GMM_TILING="tm,tk,tn" —
-#: scripts/moe_bench.py --sweep measures the candidates on the real chip
-#: and PERF.md records the chosen default.
-_TILING = (128, 128, 128)
+#: by _block); tune via set_gmm_tiling or $KFT_GMM_TILING="tm,tk,tn".
+#: Default from the r4 v5e sweep (scripts/moe_bench.py --sweep, PERF.md):
+#: (512,1024,1024) runs the E=8 top-2 bench layer at 13.3 ms/step vs the
+#: old 128^3 tiles' 69.4 — the "grouped GEMM is 20% efficient" r3
+#: finding was a tiling artifact, not a kernel property.  Larger tiles
+#: ((1024,512,1408)) exceed v5e's 16M scoped VMEM and fail to compile;
+#: tn must stay 128-aligned.
+_TILING = (512, 1024, 1024)
 #: accumulator dtype for the gmm products.  f32 is the safe default; the
 #: bf16 lever halves accumulator traffic but loses mantissa on long
 #: k-reductions — measured, not assumed (moe_bench --sweep).

@@ -526,11 +526,17 @@ def one_f_one_b(
         sched.ra_slot, sched.rg_slot))
 
     layer_specs = jax.tree.map(lambda _: P(AXIS), stacked_params)
-    # full +1 / -1 rings: the wraparound edges carry the interleaved
-    # chunk-(kP-1) -> chunk-(kP) handoff; at v=1 the wrap value is simply
-    # ignored by the recv tables
-    perm_fwd = [(i, (i + 1) % p_size) for i in range(p_size)]
-    perm_bwd = [((i + 1) % p_size, i) for i in range(p_size)]
+    # interleaved: full +1 / -1 rings — the wraparound edges carry the
+    # chunk-(kP-1) -> chunk-(kP) handoff.  Non-interleaved: OPEN chains;
+    # a wrap edge would still be executed every tick (recv slots are
+    # traced, so XLA cannot elide it) and at P=2-over-DCN that useless
+    # transfer would double the pipeline's DCN bill.
+    if interleave > 1:
+        perm_fwd = [(i, (i + 1) % p_size) for i in range(p_size)]
+        perm_bwd = [((i + 1) % p_size, i) for i in range(p_size)]
+    else:
+        perm_fwd = [(i, i + 1) for i in range(p_size - 1)]
+        perm_bwd = [(i + 1, i) for i in range(p_size - 1)]
 
     def body(local_layers, head_p, x_mb, args_mb):
         stage = lax.axis_index(AXIS)

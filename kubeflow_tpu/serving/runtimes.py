@@ -113,8 +113,18 @@ class LlamaGenerator(Model):
         self._cache_protos: dict[int, Any] = {}
 
     def load(self) -> None:
-        ref = self.config["params_ref"]
-        self.cfg, self.params = fetch_mem(ref[len("mem://"):])
+        ref = self.config.get("params_ref")
+        if ref:
+            self.cfg, self.params = fetch_mem(ref[len("mem://"):])
+        elif self.config.get("storage_path"):
+            # serve a published snapshot (config.json + weights.msgpack —
+            # what save_pretrained writes and hf://-style storage_uri
+            # resolves to): the train -> publish -> serve loop closes here
+            self.cfg, self.params = llamalib.load_pretrained(
+                self.config["storage_path"])
+        else:
+            raise RuntimeError(
+                f"model {self.name}: need params_ref or storage_uri")
         self.model = llamalib.Llama(self.cfg)
         # decode is HBM-bound on weight reads (every parameter streams per
         # token); serving in bf16 halves that traffic.  Opt-in: training

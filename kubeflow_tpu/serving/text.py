@@ -121,8 +121,17 @@ class TextGenerator(Model):
         from .continuous import build_engine
 
         self.tokenizer = resolve_tokenizer(self.config)
-        cfg, params = fetch_mem(
-            self.config["params_ref"][len("mem://"):])
+        ref = self.config.get("params_ref")
+        if ref:
+            cfg, params = fetch_mem(ref[len("mem://"):])
+        elif self.config.get("storage_path"):
+            from ..models import llama as llamalib
+
+            cfg, params = llamalib.load_pretrained(
+                self.config["storage_path"])
+        else:
+            raise RuntimeError(
+                f"model {self.name}: need params_ref or storage_uri")
         if getattr(self.tokenizer, "vocab_size", 0) > cfg.vocab_size:
             raise ValueError(
                 f"tokenizer needs vocab {self.tokenizer.vocab_size} but the "

@@ -385,16 +385,6 @@ class Trainer:
         from ..parallel import pipeline as pipelib
 
         mcfg = self.cfg.model
-        if mcfg.tie_embeddings:
-            # documented hole (r4): 1F1B's last stage would need the embed
-            # table (owned by the data-parallel embedder) for the tied
-            # unembedding AND its gradient psum'd back across the schedule
-            # boundary — use pipeline_schedule='gpipe' for tied-embedding
-            # models (GPipe differentiates the whole graph, so the tie
-            # costs nothing there).
-            raise NotImplementedError(
-                "tie_embeddings under 1f1b needs the embed table at the last "
-                "stage; use pipeline_schedule='gpipe'")
         if not mcfg.scan_layers:
             raise ValueError("pipeline schedules require scan_layers=True")
         inputs, targets = tokens[:, :-1], tokens[:, 1:]
@@ -417,10 +407,28 @@ class Trainer:
                 return llamalib.Block(mcfg).apply(
                     {"params": layer_params}, h, positions)
 
-        def loss_fn(head_params, y, tgt):
-            logits = llamalib.Head(mcfg).apply({"params": head_params}, y)
-            return optax.softmax_cross_entropy_with_integer_labels(
-                logits.astype(jnp.float32), tgt).mean()
+        # tie_embeddings x 1F1B: the tied unembedding needs the embed
+        # TABLE at the schedule's last stage.  Ride the existing head
+        # machinery: bundle the table with the head params (replicated
+        # over the pipeline axis like the head; its gradient comes back
+        # psum'd through the same dhead path) and fold that gradient into
+        # the embedder's below.
+        if mcfg.tie_embeddings:
+            head_bundle = {"head": params["head"],
+                           "table": params["embedder"]["embedding"]}
+
+            def loss_fn(hp, y, tgt):
+                logits = llamalib.Head(mcfg).apply(
+                    {"params": hp["head"]}, y, hp["table"])
+                return optax.softmax_cross_entropy_with_integer_labels(
+                    logits.astype(jnp.float32), tgt).mean()
+        else:
+            head_bundle = params["head"]
+
+            def loss_fn(hp, y, tgt):
+                logits = llamalib.Head(mcfg).apply({"params": hp}, y)
+                return optax.softmax_cross_entropy_with_integer_labels(
+                    logits.astype(jnp.float32), tgt).mean()
 
         stacked = params["layers"]["block"]
         V = self.cfg.pipeline_interleave
@@ -435,7 +443,7 @@ class Trainer:
             stacked = jax.tree.map(
                 lambda a: jnp.take(a, perm, axis=0), stacked)
         loss, (dlayers, dhead, dx) = pipelib.one_f_one_b(
-            block_apply, loss_fn, stacked, params["head"],
+            block_apply, loss_fn, stacked, head_bundle,
             x, targets,
             mesh=self.mesh, num_microbatches=self.cfg.num_microbatches,
             remat=mcfg.remat, with_aux=collect, aux_weight=aux_weight,
@@ -444,6 +452,13 @@ class Trainer:
             dlayers = jax.tree.map(
                 lambda a: jnp.take(a, inv, axis=0), dlayers)
         (dembed,) = embed_vjp(dx)
+        if mcfg.tie_embeddings:
+            # the tied table earned gradient on BOTH paths: the embedding
+            # lookup (embed_vjp) and the last-stage unembedding (dhead
+            # bundle) — sum them, exactly as single-mesh autodiff would
+            dembed = {**dembed, "embedding":
+                      dembed["embedding"] + dhead["table"]}
+            dhead = dhead["head"]
         return loss, {
             "embedder": dembed,
             "head": dhead,

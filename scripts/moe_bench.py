@@ -89,8 +89,16 @@ def sweep() -> None:
     best = None
     for tm, tk, tn, acc in candidates:
         gmmlib.set_gmm_tiling(tm, tk, tn, acc_dtype=acc)
-        r = bench(f"grouped_t{tm}x{tk}x{tn}_{jnp.dtype(acc).name}",
-                  moe_dispatch="ragged", moe_ragged_compute="grouped")
+        name = f"grouped_t{tm}x{tk}x{tn}_{jnp.dtype(acc).name}"
+        try:
+            r = bench(name, moe_dispatch="ragged",
+                      moe_ragged_compute="grouped")
+        except Exception as e:  # noqa: BLE001 — VMEM OOM etc.: record, go on
+            print(json.dumps({
+                "metric": "moe_layer_fwd_bwd", "impl": name,
+                "tiling": [tm, tk, tn], "acc_dtype": jnp.dtype(acc).name,
+                "error": f"{type(e).__name__}: {str(e)[:200]}"}), flush=True)
+            continue
         r["tiling"] = [tm, tk, tn]
         r["acc_dtype"] = jnp.dtype(acc).name
         print(json.dumps(r), flush=True)

@@ -91,9 +91,13 @@ def bench_continuous(batch: int, prompt_len: int, new_tokens: int,
     # one slot beyond the burst so the straggler measures MID-DECODE
     # admission (with num_slots == batch it would measure queue-wait
     # behind the full burst — batch-drain latency, not admission)
+    # prefix_cache off: this row measures chunked-decode throughput with
+    # grouped admission; with it on, the prime round's KV would turn the
+    # identical-prompt burst into per-request prefix admissions and the
+    # row would measure the prefix path instead (which has its own row)
     eng = ContinuousEngine(
         cfg, params, num_slots=batch + 1, decode_chunk=decode_chunk,
-        pipeline_depth=3)
+        pipeline_depth=3, prefix_cache=False)
     rng = np.random.default_rng(0)
     prompts = rng.integers(1, cfg.vocab_size, size=(batch, prompt_len)).tolist()
     # load-time AOT: the burst admits as one batched prefill (group=batch)
@@ -178,6 +182,56 @@ def bench_prefix_cache(prompt_len: int, new_tokens: int) -> dict:
     }
 
 
+def bench_tiered_window(new_tokens: int = 16) -> dict:
+    """r3 weak #4: one LONG conversation must not tax short requests'
+    decode window.  A long request (prompt 1024) decodes continuously
+    while short requests (prompt 64) arrive; compare short-request
+    latency in a single pool (window dragged to ~1024+) vs the two-tier
+    pool (short pool structurally capped)."""
+    from kubeflow_tpu.serving.continuous import ContinuousEngine, TieredEngine
+
+    cfg = _bench_model()
+    model = llamalib.Llama(cfg)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32))["params"]
+    rng = np.random.default_rng(2)
+    long_prompt = rng.integers(1, cfg.vocab_size, size=960).tolist()
+    shorts = [rng.integers(1, cfg.vocab_size, size=64).tolist()
+              for _ in range(6)]
+
+    def run(engine) -> float:
+        try:
+            # warm the relevant programs with one traffic round
+            engine.generate(shorts[0], max_new_tokens=new_tokens)
+            long_req = engine.submit(long_prompt, max_new_tokens=400)
+            # let the long conversation enter steady decode
+            time.sleep(0.3)
+            lats = []
+            for p in shorts:
+                t0 = time.perf_counter()
+                engine.generate(p, max_new_tokens=new_tokens)
+                lats.append(time.perf_counter() - t0)
+            long_req.wait(600)
+            lats.sort()
+            return lats[len(lats) // 2]
+        finally:
+            engine.stop()
+
+    single = run(ContinuousEngine(
+        cfg, params, num_slots=8, decode_chunk=8, prefix_cache=False))
+    tiered = run(TieredEngine(
+        cfg, params, num_slots=8, short_len=128, short_slots=4,
+        decode_chunk=8, prefix_cache=False))
+    return {
+        "metric": "short_request_latency_vs_long_conversation_ms",
+        "model": "271M", "short_prompt": 64, "new_tokens": new_tokens,
+        "long_prompt": 960, "long_new": 400,
+        "single_pool_p50_ms": round(single * 1e3, 1),
+        "tiered_pool_p50_ms": round(tiered * 1e3, 1),
+        "speedup": round(single / tiered, 2),
+    }
+
+
 def main() -> None:
     print(json.dumps(bench_decode(batch=8, prompt_len=128, new_tokens=64)),
           flush=True)
@@ -185,8 +239,12 @@ def main() -> None:
         print(json.dumps(bench_continuous(
             batch=8, prompt_len=128, new_tokens=64, decode_chunk=chunk)),
             flush=True)
-    print(json.dumps(bench_prefix_cache(prompt_len=512, new_tokens=16)),
+    # long prompt + few new tokens isolates ADMISSION cost (what the
+    # prefix cache removes); with many new tokens the row would mostly
+    # measure decode, which prefix reuse cannot and should not change
+    print(json.dumps(bench_prefix_cache(prompt_len=896, new_tokens=4)),
           flush=True)
+    print(json.dumps(bench_tiered_window()), flush=True)
     print(json.dumps(bench_bert(batch=8, seq=128)), flush=True)
 
 

@@ -269,3 +269,47 @@ class TestStructuredErrors:
             out = _get(f"{url}/apis/Profile?watch=true&timeout=0.2"
                        f"&cursor={payload['cursor']}")
             assert out["items"], "retained events lost on resync"
+
+
+class TestApiAuthn:
+    """Bearer-token authn (the documented single-admin-credential scoping
+    — apiserver.py docstring): with a token set, every route except
+    /healthz requires Authorization; the kft CLI sends --token/$KFT_TOKEN."""
+
+    def test_token_required_and_honored(self, capsys):
+        import urllib.error
+
+        from kubeflow_tpu.controlplane.cluster import Cluster
+
+        cluster = Cluster()
+        cluster.add_tpu_slice("s0", 1, 4)
+        with cluster:
+            url = cluster.serve_api(token="s3cret")
+            # healthz stays open (liveness probes carry no credentials)
+            assert _get(f"{url}/healthz")["ok"] is True
+            try:
+                _get(f"{url}/apis")
+                raise AssertionError("expected 401")
+            except urllib.error.HTTPError as e:
+                assert e.code == 401
+                assert json.loads(e.read())["reason"] == "Unauthorized"
+            req = urllib.request.Request(
+                f"{url}/apis",
+                headers={"Authorization": "Bearer s3cret"})
+            with urllib.request.urlopen(req, timeout=10) as r:
+                assert "JaxJob" in json.loads(r.read())["kinds"]
+            # wrong token is rejected too
+            req = urllib.request.Request(
+                f"{url}/apis",
+                headers={"Authorization": "Bearer wrong"})
+            try:
+                urllib.request.urlopen(req, timeout=10)
+                raise AssertionError("expected 401")
+            except urllib.error.HTTPError as e:
+                assert e.code == 401
+            # the CLI path end to end
+            assert cli.main(
+                ["--server", url, "--token", "s3cret", "api-resources"]) == 0
+            assert "JaxJob" in capsys.readouterr().out
+            assert cli.main(
+                ["--server", url, "--token", "nope", "api-resources"]) == 1

@@ -166,3 +166,52 @@ class TestFinetuneE2E:
         # scratch would start at ~ln(256)=5.55; the snapshot left off ~2.1
         assert losses[0] < 3.5, losses
         assert abs(losses[0] - final.loss) < 1.0, (losses[0], final.loss)
+
+
+class TestServePublishedSnapshot:
+    """The loop closes: train -> save_pretrained -> SERVE the snapshot
+    (storage_path, what an hf:///file:// storage_uri resolves to)."""
+
+    def _snapshot(self, tmp_path):
+        cfg = llamalib.tiny()
+        params = llamalib.Llama(cfg).init(
+            jax.random.PRNGKey(2), jnp.ones((1, 8), jnp.int32))["params"]
+        path = str(tmp_path / "snap")
+        llamalib.save_pretrained(path, cfg, params)
+        return cfg, params, path
+
+    def test_llama_generator_from_snapshot(self, tmp_path):
+        from kubeflow_tpu.serving.runtimes import LlamaGenerator
+        from kubeflow_tpu.serving.storage import register_mem
+
+        cfg, params, path = self._snapshot(tmp_path)
+        ref = register_mem("serve-snap", (cfg, params))
+        via_mem = LlamaGenerator("a", {"params_ref": ref,
+                                       "max_new_tokens": 3})
+        via_mem.start()
+        want = via_mem.predict_batch([[1, 2, 3]])
+        via_snap = LlamaGenerator("b", {"storage_path": path,
+                                        "max_new_tokens": 3})
+        via_snap.start()
+        assert via_snap.predict_batch([[1, 2, 3]]) == want
+
+    def test_continuous_from_snapshot(self, tmp_path):
+        from kubeflow_tpu.serving.continuous import ContinuousLlamaGenerator
+
+        _, _, path = self._snapshot(tmp_path)
+        m = ContinuousLlamaGenerator(
+            "c", {"storage_path": path, "max_new_tokens": 3,
+                  "num_slots": 2, "warmup_groups": []})
+        m.start()
+        try:
+            out = m.predict_batch([[1, 2, 3]])
+            assert len(out[0]) == 3
+        finally:
+            m.stop()
+
+    def test_missing_source_raises(self):
+        from kubeflow_tpu.serving.runtimes import LlamaGenerator
+
+        g = LlamaGenerator("d", {"max_new_tokens": 3})
+        with pytest.raises(RuntimeError, match="params_ref or storage_uri"):
+            g.load()

@@ -152,3 +152,29 @@ class TestStreaming:
             server._models.pop("textgen", None)
             server._specs.pop("textgen", None)
             server.stop()
+
+
+class TestTieredTextServing:
+    def test_completions_over_tiered_engine(self):
+        """TieredEngine must be a drop-in behind TextGenerator: the
+        OpenAI completions path reads engine.eos_id/default_max_new_tokens
+        (caught regression: the tiered router initially lacked them)."""
+        from kubeflow_tpu.serving.continuous import TieredEngine
+
+        cfg = llamalib.tiny()
+        model = llamalib.Llama(cfg)
+        params = model.init(
+            jax.random.PRNGKey(1), jnp.ones((1, 8), jnp.int32))
+        ref = register_mem("text-llama-tiered", (cfg, params["params"]))
+        m = TextGenerator("tieredgen", {
+            "params_ref": ref, "max_new_tokens": 4, "decode_chunk": 2,
+            "num_slots": 4, "short_pool_len": 32, "warmup_groups": []})
+        m.start()
+        try:
+            assert isinstance(m.engine, TieredEngine)
+            out = m.openai_completions(
+                {"prompt": "hi", "max_tokens": 4})
+            assert out["choices"][0]["text"] is not None
+            assert out["usage"]["completion_tokens"] >= 1
+        finally:
+            m.stop()
