@@ -525,13 +525,24 @@ class ContinuousEngine:
                 jax.random.PRNGKey(0))
             jax.block_until_ready(toks)
         if self.prefix_cache:
-            # warm the prefix-admit program for the warmed prompt buckets
+            # warm the prefix-admit programs for the warmed prompt buckets
             # (a repeated prompt otherwise pays this compile mid-request —
-            # exactly the latency the prefix cache exists to remove).  The
-            # warmup targets the out-of-range slot; every scatter drops.
+            # exactly the latency the prefix cache exists to remove).  A
+            # prompt of ANY length L <= bucket admits with total
+            # (L-1) + suffix_bucket, so cover every attend bucket up to
+            # the worst case, not just one key.  The warmup targets the
+            # out-of-range slot; every scatter drops.
             sb = self.seq_buckets[0]
+            warm_totals = set()
             for _, bucket in groups:
-                program = self._prefix_admit_for(bucket + sb, sb)
+                b = next(x for x in self.seq_buckets if x >= bucket)
+                top = b - 1 + sb  # worst-case admission total
+                cover = next((a for a in self.attend_buckets if a >= top),
+                             self.cfg.max_seq_len)
+                warm_totals.update(
+                    a for a in self.attend_buckets if a <= cover)
+            for total in sorted(warm_totals):
+                program = self._prefix_admit_for(total, sb)
                 self._pool_cache, self._pool_logits = program(
                     self.params, self._pool_cache, self._pool_logits,
                     np.int32(self.num_slots), np.int32(self.num_slots),

@@ -109,9 +109,50 @@ def sweep() -> None:
         flush=True)
 
 
+def train_step_bench() -> None:
+    """End-to-end MoE LLM train step on one chip (not just the layer):
+    an 8-layer MoE Llama (every-layer MoE, E=8 top-2; 16 layers crashes
+    the tunnel's compile helper) through the real Trainer, dense capacity
+    vs dropless ragged+grouped dispatch — the number that tells whether
+    dropless is deployable as the default."""
+    from kubeflow_tpu.train import trainer as trainlib
+
+    import time as _time
+
+    for name, kw in (
+        ("dense_capacity", dict(moe_dispatch="dense")),
+        ("ragged_grouped", dict(moe_dispatch="ragged",
+                                moe_ragged_compute="grouped")),
+    ):
+        cfg = llamalib.LlamaConfig(
+            vocab_size=32000, hidden_size=1024, intermediate_size=2816,
+            num_layers=8, num_heads=8, num_kv_heads=8, head_dim=128,
+            max_seq_len=1024, attention_impl="flash", remat=True,
+            moe_experts=8, moe_top_k=2, **kw)
+        tcfg = trainlib.TrainConfig(
+            model=cfg, global_batch=8, seq_len=1024, steps=16,
+            log_every=8, aux_loss_coef=0.01)
+        t = trainlib.Trainer(tcfg)
+        out = []
+        t.train(on_metrics=lambda m: out.append(m))
+        m = out[-1]  # second window: warm steps only
+        print(json.dumps({
+            "metric": "moe_llama_train_tokens_per_sec_per_chip",
+            "impl": name, "layers": 8, "experts": 8, "top_k": 2,
+            "value": round(m.tokens_per_sec_per_chip, 1),
+            "step_ms": round(m.step_time_s * 1e3, 1),
+            "loss": round(m.loss, 3),
+        }), flush=True)
+        del t
+        _time.sleep(1)
+
+
 def main() -> None:
     if "--sweep" in sys.argv:
         sweep()
+        return
+    if "--train" in sys.argv:
+        train_step_bench()
         return
     rows = [
         bench("dense_capacity_1.25", moe_dispatch="dense",
