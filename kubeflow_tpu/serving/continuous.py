@@ -70,6 +70,16 @@ class Request:
     done: threading.Event = field(default_factory=threading.Event)
     error: Optional[Exception] = None
 
+    cancelled: threading.Event = field(default_factory=threading.Event)
+
+    def cancel(self) -> None:
+        """Client-side cancellation (disconnect, timeout): the request
+        resolves immediately with whatever tokens it has; the engine
+        frees its slot at the next chunk boundary — a cancelled request
+        must stop consuming decode slots (the vLLM abort contract)."""
+        self.cancelled.set()
+        self.done.set()
+
     def wait(self, timeout: Optional[float] = None) -> list[int]:
         if not self.done.wait(timeout):
             raise TimeoutError("generation did not complete in time")
@@ -313,6 +323,9 @@ class ContinuousEngine:
 
         # host-side scheduler state
         self._queue: "queue.Queue[Request]" = queue.Queue()
+        #: scheduler-owned waiting list (drained from _queue every cycle
+        #: so cancelled entries are purged even while the pool is full)
+        self._waiting: list[Request] = []
         self._slots: list[Optional[Request]] = [None] * num_slots
         self.prefix_cache = prefix_cache
         self.min_prefix = int(min_prefix)
@@ -575,6 +588,19 @@ class ContinuousEngine:
                  timeout: float = 120.0) -> list[int]:
         return self.submit(prompt, max_new_tokens).wait(timeout)
 
+    def stats(self) -> dict:
+        """Engine observability snapshot (exported as Prometheus gauges
+        by the model server's /metrics)."""
+        return {
+            "slots_capacity": self.num_slots,
+            "slots_live": int(self._active.sum()),
+            "queue_depth": len(self._waiting) + self._queue.qsize(),
+            "decode_steps": self.step_counter,
+            "tokens_emitted": self.tokens_emitted,
+            "prefix_hits": self.prefix_hits,
+            "prefix_tokens_saved": self.prefix_tokens_saved,
+        }
+
     def stop(self) -> None:
         with self._gate:
             self._stop.set()
@@ -588,6 +614,11 @@ class ContinuousEngine:
                 break
             req.error = RuntimeError("engine shut down")
             req.done.set()
+        for req in self._waiting:
+            if not req.done.is_set():
+                req.error = RuntimeError("engine shut down")
+                req.done.set()
+        self._waiting.clear()
         for req in self._slots:
             if req is not None and not req.done.is_set():
                 req.error = RuntimeError("engine shut down")
@@ -602,13 +633,20 @@ class ContinuousEngine:
         each group runs as one multi-row prefill + one multi-slot merge —
         a burst of 8 requests costs 2 dispatches, not 16 (each dispatch
         pays the remote-dispatch latency floor, PERF.md)."""
-        free = [i for i, r in enumerate(self._slots) if r is None]
-        taken: list[tuple[Request, list[int], int]] = []  # (req, prompt, slot)
-        while free:
+        # drain the cross-thread queue into the scheduler-owned waiting
+        # list and purge cancellations NOW — a cancelled entry must not
+        # linger (inflating queue_depth) just because the pool is full
+        while True:
             try:
-                req = self._queue.get_nowait()
+                self._waiting.append(self._queue.get_nowait())
             except queue.Empty:
                 break
+        self._waiting = [r for r in self._waiting
+                         if not r.cancelled.is_set()]
+        free = [i for i, r in enumerate(self._slots) if r is None]
+        taken: list[tuple[Request, list[int], int]] = []  # (req, prompt, slot)
+        while free and self._waiting:
+            req = self._waiting.pop(0)
             # budget the KV cache: prompt + generated tokens must fit
             # max_seq_len — writes past it are silently dropped by the
             # per-row scatter and decode would return garbage from a
@@ -739,12 +777,26 @@ class ContinuousEngine:
                     break
                 req.error = e
                 req.done.set()
+            for req in self._waiting:
+                if not req.done.is_set():
+                    req.error = e
+                    req.done.set()
+            self._waiting.clear()
 
     def _loop_inner(self) -> None:
         # in-flight chunk dispatches: (device tokens, [(slot, req, take)])
         pending: list[tuple[Any, list[tuple[int, Request, int]]]] = []
         while not self._stop.is_set():
             self._admit()
+            # free slots whose request resolved OUT of band (cancel()):
+            # the normal retirements already cleared theirs, so a done-
+            # but-still-active slot can only be a cancellation
+            for slot in range(self.num_slots):
+                req = self._slots[slot]
+                if req is not None and req.done.is_set():
+                    self._slots[slot] = None
+                    self._active[slot] = False
+                    self._remaining[slot] = 0
             if not self._active.any():
                 # drain the tail, then wait for work without spinning
                 while pending:
@@ -926,6 +978,13 @@ class TieredEngine:
     @property
     def prefix_tokens_saved(self) -> int:
         return self.short.prefix_tokens_saved + self.long.prefix_tokens_saved
+
+    def stats(self) -> dict:
+        s, l = self.short.stats(), self.long.stats()
+        merged = {k: s[k] + l[k] for k in s}
+        merged["short_pool"] = s
+        merged["long_pool"] = l
+        return merged
 
 
 def build_engine(cfg, params, config: dict, *, default_eos=None,

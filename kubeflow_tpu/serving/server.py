@@ -322,7 +322,24 @@ class ModelServer:
             h._send(200 if ready else 503, {"ready": ready})
             return
         if path == "/metrics":
-            h._send(200, None, raw=self.metrics.prometheus().encode(),
+            text = self.metrics.prometheus()
+            # engine-backed models export their scheduler gauges too
+            # (slots, queue depth, prefix-cache economy); one TYPE line
+            # per metric family, gauge names without the _total suffix
+            # (OpenMetrics reserves it for counters)
+            families: dict[str, list[str]] = {}
+            for name, model in list(self._models.items()):
+                engine = getattr(model, "engine", None)
+                stats = getattr(engine, "stats", None)
+                if callable(stats):
+                    for k, v in stats().items():
+                        if isinstance(v, (int, float)):
+                            families.setdefault(f"kft_engine_{k}", []).append(
+                                f'kft_engine_{k}{{model="{name}"}} {v}')
+            for fam in sorted(families):
+                text += f"# TYPE {fam} gauge\n" + \
+                    "\n".join(families[fam]) + "\n"
+            h._send(200, None, raw=text.encode(),
                     content_type="text/plain; version=0.0.4")
             return
         if path.startswith("/v1/models/"):

@@ -188,35 +188,43 @@ class TextGenerator(Model):
         sent = [""] * len(reqs)
         finished = [False] * len(reqs)
         model = payload.get("model", self.name)
-        while not all(finished):
-            progressed = False
-            for i, req in enumerate(reqs):
-                if finished[i]:
-                    continue
-                done = req.done.is_set()
-                full = self.tokenizer.decode(list(req.tokens))
-                if done:
-                    # final decode is authoritative; flush everything
-                    delta = full[len(sent[i]):] if full.startswith(sent[i]) \
-                        else full
-                    finished[i] = True
-                    if req.error is not None:
-                        raise req.error
-                elif full.startswith(sent[i]):
-                    delta = full[len(sent[i]):]
-                else:
-                    continue  # tail not stable yet: hold
-                if delta:
-                    sent[i] = sent[i] + delta if not done else full
-                    progressed = True
-                    yield ("data: " + jsonlib.dumps({
-                        "object": "text_completion.chunk",
-                        "model": model,
-                        "choices": [{"index": i, "text": delta}],
-                    }) + "\n\n").encode()
-            if not all(finished) and not progressed:
-                timelib.sleep(0.02)
-        yield b"data: [DONE]\n\n"
+        try:
+            while not all(finished):
+                progressed = False
+                for i, req in enumerate(reqs):
+                    if finished[i]:
+                        continue
+                    done = req.done.is_set()
+                    full = self.tokenizer.decode(list(req.tokens))
+                    if done:
+                        # final decode is authoritative; flush everything
+                        delta = (full[len(sent[i]):]
+                                 if full.startswith(sent[i]) else full)
+                        finished[i] = True
+                        if req.error is not None:
+                            raise req.error
+                    elif full.startswith(sent[i]):
+                        delta = full[len(sent[i]):]
+                    else:
+                        continue  # tail not stable yet: hold
+                    if delta:
+                        sent[i] = sent[i] + delta if not done else full
+                        progressed = True
+                        yield ("data: " + jsonlib.dumps({
+                            "object": "text_completion.chunk",
+                            "model": model,
+                            "choices": [{"index": i, "text": delta}],
+                        }) + "\n\n").encode()
+                if not all(finished) and not progressed:
+                    timelib.sleep(0.02)
+            yield b"data: [DONE]\n\n"
+        finally:
+            # client hung up mid-stream (BrokenPipe -> GeneratorExit) or
+            # a sibling prompt errored: stop spending decode slots on a
+            # stream nobody is reading
+            for req in reqs:
+                if not req.done.is_set():
+                    req.cancel()
 
     def openai_completions(self, payload: dict) -> dict:
         """``POST /openai/v1/completions`` body -> response (text
@@ -229,6 +237,16 @@ class TextGenerator(Model):
             self.engine.submit(self.tokenizer.encode(p), max_tokens)
             for p in prompts
         ]
+        try:
+            return self._collect_completions(payload, prompts, reqs)
+        finally:
+            # one prompt's wait() raising must not leave its siblings
+            # decoding to nobody (same contract as the streaming path)
+            for r in reqs:
+                if not r.done.is_set():
+                    r.cancel()
+
+    def _collect_completions(self, payload, prompts, reqs) -> dict:
         choices = []
         completion_tokens = 0
         for i, r in enumerate(reqs):

@@ -319,3 +319,65 @@ class TestTieredEngine:
             assert len(out) == 2
         finally:
             eng.stop()
+
+
+class TestCancellationAndStats:
+    def test_cancel_queued_request(self, tiny_llama):
+        eng = make_engine(tiny_llama, num_slots=1, decode_chunk=1)
+        try:
+            # occupy the only slot, queue a second request, cancel it
+            first = eng.submit(list(range(1, 20)), max_new_tokens=30)
+            second = eng.submit([9, 9, 9], max_new_tokens=30)
+            second.cancel()
+            out2 = second.wait(timeout=5)  # resolves immediately
+            assert out2 == []
+            out1 = first.wait(timeout=120)
+            assert len(out1) == 30  # the live request is unaffected
+        finally:
+            eng.stop()
+
+    def test_cancel_live_request_frees_slot(self, tiny_llama):
+        eng = make_engine(tiny_llama, num_slots=1, decode_chunk=1)
+        try:
+            import time as _time
+
+            long_req = eng.submit(list(range(1, 20)), max_new_tokens=80)
+            _time.sleep(0.5)  # let it enter decode
+            long_req.cancel()
+            assert long_req.done.is_set()
+            # the freed slot must serve a new request promptly
+            out = eng.generate([1, 2, 3], max_new_tokens=3, timeout=60)
+            assert len(out) == 3
+        finally:
+            eng.stop()
+
+    def test_engine_stats_and_metrics_endpoint(self, tiny_llama):
+        import json as _json
+        import urllib.request
+
+        from kubeflow_tpu.serving.continuous import ContinuousLlamaGenerator
+        from kubeflow_tpu.serving.server import ModelServer
+        from kubeflow_tpu.serving.storage import register_mem
+
+        cfg, params = tiny_llama
+        ref = register_mem("stats-llama", (cfg, params))
+        m = ContinuousLlamaGenerator(
+            "statgen", {"params_ref": ref, "max_new_tokens": 3,
+                        "num_slots": 2, "warmup_groups": []})
+        srv = ModelServer()
+        srv.register(m)
+        srv.start()
+        try:
+            url = f"http://127.0.0.1:{srv.port}"
+            req = urllib.request.Request(
+                f"{url}/v1/models/statgen:predict",
+                data=_json.dumps({"instances": [[1, 2, 3]]}).encode(),
+                headers={"Content-Type": "application/json"})
+            urllib.request.urlopen(req, timeout=60).read()
+            with urllib.request.urlopen(f"{url}/metrics", timeout=10) as r:
+                text = r.read().decode()
+            assert 'kft_engine_tokens_emitted{model="statgen"} 3' in text
+            assert 'kft_engine_slots_capacity{model="statgen"} 2' in text
+            assert "# TYPE kft_engine_slots_capacity gauge" in text
+        finally:
+            srv.stop()
