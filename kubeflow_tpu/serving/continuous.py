@@ -344,6 +344,10 @@ class ContinuousEngine:
         self._remaining = np.zeros(num_slots, dtype=np.int64)
         self.step_counter = 0          # decode dispatches so far
         self.tokens_emitted = 0        # useful (delivered) tokens
+        #: tokens decoded for requests already EOS-retired — the price of
+        #: dispatch-ahead pipelining (retirement lags ≤ pipeline_depth-1
+        #: chunks); a measured cost, not a hidden one (r3 weak #4)
+        self.tokens_discarded = 0
         self._error: Optional[Exception] = None
         self._stop = threading.Event()
         self._gate = threading.Lock()
@@ -597,6 +601,7 @@ class ContinuousEngine:
             "queue_depth": len(self._waiting) + self._queue.qsize(),
             "decode_steps": self.step_counter,
             "tokens_emitted": self.tokens_emitted,
+            "tokens_discarded": self.tokens_discarded,
             "prefix_hits": self.prefix_hits,
             "prefix_tokens_saved": self.prefix_tokens_saved,
         }
@@ -801,7 +806,8 @@ class ContinuousEngine:
                 # drain the tail, then wait for work without spinning
                 while pending:
                     self._process(*pending.pop(0))
-                if self._active.any() or not self._queue.empty():
+                if (self._active.any() or self._waiting
+                        or not self._queue.empty()):
                     continue  # _process freed slots or work arrived
                 self._wake.wait(timeout=0.05)
                 self._wake.clear()
@@ -851,7 +857,10 @@ class ContinuousEngine:
         now = time.perf_counter()
         for slot, req, take in snapshot:
             if req.done.is_set():
-                continue  # EOS-retired by an earlier chunk
+                # EOS-retired (or cancelled) by an earlier chunk: these
+                # tokens were decoded for nobody — count the waste
+                self.tokens_discarded += take
+                continue
             emitted = toks[slot, :take].tolist()
             if self._slot_owner[slot] is req:
                 # extend the slot's KV-content record (prefix matcher
@@ -861,6 +870,7 @@ class ContinuousEngine:
             done = False
             if self.eos_id is not None and self.eos_id in emitted:
                 emitted = emitted[: emitted.index(self.eos_id) + 1]
+                self.tokens_discarded += take - len(emitted)
                 done = True
                 # free the slot unless a new occupant already claimed it
                 # (max_new-tokens freeing happens at dispatch time)

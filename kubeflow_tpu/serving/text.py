@@ -238,7 +238,7 @@ class TextGenerator(Model):
             for p in prompts
         ]
         try:
-            return self._collect_completions(payload, prompts, reqs)
+            return self._collect_completions(payload, reqs)
         finally:
             # one prompt's wait() raising must not leave its siblings
             # decoding to nobody (same contract as the streaming path)
@@ -246,7 +246,7 @@ class TextGenerator(Model):
                 if not r.done.is_set():
                     r.cancel()
 
-    def _collect_completions(self, payload, prompts, reqs) -> dict:
+    def _collect_completions(self, payload, reqs) -> dict:
         choices = []
         completion_tokens = 0
         for i, r in enumerate(reqs):

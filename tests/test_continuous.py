@@ -108,6 +108,12 @@ class TestContinuousEngine:
         try:
             got = eng.generate([1, 2, 3], max_new_tokens=8)
             assert got == [first]  # stopped at EOS, not at max_new_tokens
+            # the dispatch-ahead lag's waste is MEASURED, not hidden:
+            # tokens decoded past the EOS cut land in tokens_discarded
+            import time as _time
+            _time.sleep(0.3)  # let in-flight chunks drain
+            assert eng.tokens_discarded >= 1
+            assert eng.stats()["tokens_discarded"] == eng.tokens_discarded
         finally:
             eng.stop()
 
