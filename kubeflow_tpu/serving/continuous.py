@@ -60,6 +60,9 @@ class Request:
 
     prompt: list[int]
     max_new_tokens: int
+    #: per-request sampling temperature (None = the engine's default;
+    #: 0 = greedy, >0 = categorical) — the OpenAI per-request field
+    temperature: Optional[float] = None
     submitted_at: float = field(default_factory=time.perf_counter)
     #: engine step counter when the request was submitted / admitted
     submitted_step: int = 0
@@ -204,8 +207,7 @@ def make_prefix_admit_program(cfg, attend: int, suffix_bucket: int,
     return shardedlib.mesh_jit(mesh, admit, donate_argnums=(1, 2))
 
 
-def make_decode_program(cfg, attend: int, chunk: int, temperature: float,
-                        mesh=None):
+def make_decode_program(cfg, attend: int, chunk: int, mesh=None):
     """``chunk`` sampling steps for the whole slot pool in one program,
     attending only over cache slots [0, attend).
 
@@ -214,20 +216,26 @@ def make_decode_program(cfg, attend: int, chunk: int, temperature: float,
     per-row scatter's mode="drop" discards the write and the causal mask
     hides the slot from every live row.  Pool cache + logits are donated —
     the pool exists in HBM exactly once.
+
+    ``temps`` is a PER-SLOT f32 array (0 = greedy, >0 = categorical at
+    that temperature): requests carry their own sampling temperature —
+    the OpenAI per-request ``temperature`` field — without recompiling,
+    and mixed greedy/sampled slots ride one dispatch.
     """
     wmodel = llamalib.Llama(cfg, decode_attend_len=attend)
 
-    def decode(params, cache, logits, positions, active, key):
+    def decode(params, cache, logits, positions, active, temps, key):
         safe = jnp.where(active, positions, cfg.max_seq_len)
 
         def step(carry, key):
             cache, logits, pos = carry
-            if temperature > 0:
-                tok = jax.random.categorical(
-                    key, logits.astype(jnp.float32) / temperature, axis=-1)
-            else:
-                tok = jnp.argmax(logits, axis=-1)
-            tok = tok.astype(jnp.int32)
+            greedy = jnp.argmax(logits, axis=-1)
+            sampled = jax.random.categorical(
+                key,
+                logits.astype(jnp.float32)
+                / jnp.maximum(temps, 1e-6)[:, None],
+                axis=-1)
+            tok = jnp.where(temps > 0, sampled, greedy).astype(jnp.int32)
             l, mutated = wmodel.apply(
                 {"params": params, "cache": cache}, tok[:, None],
                 pos[:, None], decode=True, mutable=["cache"])
@@ -342,6 +350,9 @@ class ContinuousEngine:
         self._active = np.zeros(num_slots, dtype=bool)
         self._positions = np.zeros(num_slots, dtype=np.int32)
         self._remaining = np.zeros(num_slots, dtype=np.int64)
+        #: per-slot sampling temperature (0 = greedy) — requests override
+        #: the engine default (the OpenAI per-request temperature field)
+        self._temps = np.zeros(num_slots, dtype=np.float32)
         self.step_counter = 0          # decode dispatches so far
         self.tokens_emitted = 0        # useful (delivered) tokens
         #: tokens decoded for requests already EOS-retired — the price of
@@ -371,7 +382,7 @@ class ContinuousEngine:
     # -- compiled programs -------------------------------------------------
 
     def _build_programs(self) -> None:
-        cfg, temperature = self.cfg, self.temperature
+        cfg = self.cfg
         chunk = self.decode_chunk
         slots = self.num_slots
         mesh = self.mesh
@@ -442,7 +453,7 @@ class ContinuousEngine:
                 cfg.max_seq_len)
             if attend not in self._decode_programs:
                 self._decode_programs[attend] = make_decode_program(
-                    cfg, attend, chunk, temperature, mesh)
+                    cfg, attend, chunk, mesh)
             return self._decode_programs[attend]
 
         self._decode_for = decode_for
@@ -539,6 +550,7 @@ class ContinuousEngine:
                 self.params, self._pool_cache, self._pool_logits,
                 jnp.full(self.num_slots, self.cfg.max_seq_len, jnp.int32),
                 jnp.zeros(self.num_slots, bool),
+                jnp.zeros(self.num_slots, jnp.float32),
                 jax.random.PRNGKey(0))
             jax.block_until_ready(toks)
         if self.prefix_cache:
@@ -566,7 +578,8 @@ class ContinuousEngine:
                     np.int32(1), jnp.zeros(sb, jnp.int32), np.int32(1))
 
     def submit(
-        self, prompt: list[int], max_new_tokens: Optional[int] = None
+        self, prompt: list[int], max_new_tokens: Optional[int] = None,
+        temperature: Optional[float] = None,
     ) -> Request:
         req = Request(
             prompt=list(map(int, prompt)),
@@ -575,6 +588,7 @@ class ContinuousEngine:
             max_new_tokens=int(
                 self.default_max_new_tokens
                 if max_new_tokens is None else max_new_tokens),
+            temperature=(None if temperature is None else float(temperature)),
         )
         req.submitted_step = self.step_counter
         with self._gate:
@@ -589,8 +603,9 @@ class ContinuousEngine:
         return req
 
     def generate(self, prompt: list[int], max_new_tokens: Optional[int] = None,
-                 timeout: float = 120.0) -> list[int]:
-        return self.submit(prompt, max_new_tokens).wait(timeout)
+                 timeout: float = 120.0,
+                 temperature: Optional[float] = None) -> list[int]:
+        return self.submit(prompt, max_new_tokens, temperature).wait(timeout)
 
     def stats(self) -> dict:
         """Engine observability snapshot (exported as Prometheus gauges
@@ -721,6 +736,8 @@ class ContinuousEngine:
         self._active[slot] = True
         self._positions[slot] = len(prompt)
         self._remaining[slot] = req.max_new_tokens
+        self._temps[slot] = (self.temperature if req.temperature is None
+                             else req.temperature)
         self._slot_content[slot] = list(prompt)
         self._slot_owner[slot] = req
         req.slot = slot
@@ -834,7 +851,8 @@ class ContinuousEngine:
             self._pool_cache, self._pool_logits, toks = self._decode_for(
                 needed)(
                 self.params, self._pool_cache, self._pool_logits,
-                self._positions.copy(), self._active.copy(), key)
+                self._positions.copy(), self._active.copy(),
+                self._temps.copy(), key)
             # advance the value-independent schedule NOW so the next chunk
             # can dispatch before this one's tokens are fetched
             for slot, req, take in snapshot:
@@ -941,13 +959,14 @@ class TieredEngine:
         total = len(prompt) + n_new
         return self.short if total < self.short_len else self.long
 
-    def submit(self, prompt, max_new_tokens=None) -> Request:
+    def submit(self, prompt, max_new_tokens=None,
+               temperature=None) -> Request:
         return self._route(prompt, max_new_tokens).submit(
-            prompt, max_new_tokens)
+            prompt, max_new_tokens, temperature)
 
     def generate(self, prompt, max_new_tokens=None,
-                 timeout: float = 120.0) -> list[int]:
-        return self.submit(prompt, max_new_tokens).wait(timeout)
+                 timeout: float = 120.0, temperature=None) -> list[int]:
+        return self.submit(prompt, max_new_tokens, temperature).wait(timeout)
 
     def warmup(self, groups=None) -> None:
         short_groups = groups

@@ -152,9 +152,11 @@ class TextGenerator(Model):
         if isinstance(inst, dict):
             prompt = inst.get("prompt", "")
             max_new = inst.get("max_tokens")
+            temp = inst.get("temperature")
         else:
-            prompt, max_new = str(inst), None
-        return self.engine.submit(self.tokenizer.encode(prompt), max_new)
+            prompt, max_new, temp = str(inst), None, None
+        return self.engine.submit(self.tokenizer.encode(prompt), max_new,
+                                  temperature=temp)
 
     def predict_batch(self, instances):
         assert self.engine is not None, "model not loaded"
@@ -181,8 +183,10 @@ class TextGenerator(Model):
         if isinstance(prompts, str):
             prompts = [prompts]
         max_tokens = payload.get("max_tokens")
+        temp = payload.get("temperature")
         reqs = [
-            self.engine.submit(self.tokenizer.encode(str(p)), max_tokens)
+            self.engine.submit(self.tokenizer.encode(str(p)), max_tokens,
+                               temperature=temp)
             for p in prompts
         ]
         sent = [""] * len(reqs)
@@ -233,8 +237,10 @@ class TextGenerator(Model):
         if isinstance(prompts, str):
             prompts = [prompts]
         max_tokens = payload.get("max_tokens")
+        temp = payload.get("temperature")
         reqs = [
-            self.engine.submit(self.tokenizer.encode(p), max_tokens)
+            self.engine.submit(self.tokenizer.encode(p), max_tokens,
+                               temperature=temp)
             for p in prompts
         ]
         try:

@@ -90,9 +90,10 @@ def compile_candidate(devs, cfg, *, tp, num_slots, decode_chunk=16,
     # -- decode: the steady-state program (full attend window = worst case)
     t0 = time.perf_counter()
     decode = contlib.make_decode_program(
-        cfg, cfg.max_seq_len, decode_chunk, 0.0, mesh)
+        cfg, cfg.max_seq_len, decode_chunk, mesh)
+    temps = jax.ShapeDtypeStruct((num_slots,), jnp.float32)
     compiled = decode.lower(params, pool, logits, positions, active,
-                            key).compile()
+                            temps, key).compile()
     out["decode_compile_seconds"] = round(time.perf_counter() - t0, 1)
     mem = compiled.memory_analysis()
     # donated pool aliases its output; live set = arguments + temps

@@ -387,3 +387,56 @@ class TestCancellationAndStats:
             assert "# TYPE kft_engine_slots_capacity gauge" in text
         finally:
             srv.stop()
+
+
+class TestPerRequestTemperature:
+    def test_greedy_request_unaffected_by_sampling_neighbor(
+            self, tiny_llama, reference_generator):
+        """A temperature=0 request must stay exactly greedy even while a
+        high-temperature request shares the pool dispatch."""
+        eng = make_engine(tiny_llama, temperature=0.0)
+        try:
+            hot = eng.submit(list(range(1, 10)), max_new_tokens=6,
+                             temperature=5.0)
+            cold = eng.submit([1, 2, 3], max_new_tokens=6)
+            got = cold.wait(300)
+            hot_out = hot.wait(300)
+            assert got == reference_generator.predict_batch([[1, 2, 3]])[0]
+            assert len(hot_out) == 6
+            assert all(0 <= t < 256 for t in hot_out)
+        finally:
+            eng.stop()
+
+    def test_request_overrides_engine_default(self, tiny_llama,
+                                              reference_generator):
+        """Engine default temperature > 0, but a per-request temperature=0
+        override must decode greedily."""
+        eng = make_engine(tiny_llama, temperature=2.0)
+        try:
+            got = eng.generate([1, 2, 3], max_new_tokens=6, temperature=0.0)
+            assert got == reference_generator.predict_batch([[1, 2, 3]])[0]
+        finally:
+            eng.stop()
+
+    def test_openai_payload_temperature_reaches_engine(self):
+        from kubeflow_tpu.serving.text import TextGenerator
+        from kubeflow_tpu.serving.storage import register_mem
+
+        cfg = llamalib.tiny()
+        params = llamalib.Llama(cfg).init(
+            jax.random.PRNGKey(3), jnp.ones((1, 8), jnp.int32))["params"]
+        ref = register_mem("temp-llama", (cfg, params))
+        # engine default temperature 3.0: without the per-request
+        # override the two calls would almost surely differ
+        m = TextGenerator("tg", {
+            "params_ref": ref, "max_new_tokens": 6, "num_slots": 2,
+            "decode_chunk": 2, "temperature": 3.0, "warmup_groups": []})
+        m.start()
+        try:
+            a = m.openai_completions(
+                {"prompt": "hello", "max_tokens": 6, "temperature": 0})
+            b = m.openai_completions(
+                {"prompt": "hello", "max_tokens": 6, "temperature": 0})
+            assert a["choices"][0]["text"] == b["choices"][0]["text"]
+        finally:
+            m.stop()
