@@ -29,6 +29,24 @@ class ModelFormat(_Model):
     version: Optional[str] = None
 
 
+class GangSpec(_Model):
+    """Multi-HOST predictor placement (serving/gang.py).
+
+    A TPU pod slice is hosts x chips — a predictor whose tensor-parallel
+    mesh exceeds one host's chips must run as a gang of cooperating
+    processes (the multi-host jit contract), placed and restarted like a
+    JaxJob.  ``mesh_axes`` is the GLOBAL serving mesh (its product must
+    equal hosts * chips_per_host); ``chips_per_host`` doubles as the
+    virtual-device count for the local CPU stand-in runtime.
+    """
+
+    hosts: int = Field(default=2, ge=1)
+    mesh_axes: dict[str, int] = Field(default_factory=dict)
+    chips_per_host: int = Field(default=4, ge=1)
+    #: gang-restart budget (JaxJob run_policy.backoff_limit)
+    backoff_limit: int = 16
+
+
 class ComponentSpec(_Model):
     """One serving component (predictor/transformer/explainer)."""
 
@@ -46,6 +64,9 @@ class ComponentSpec(_Model):
     batch_max_size: int = 8
     batch_timeout_ms: float = 2.0
     config: dict[str, Any] = Field(default_factory=dict)
+    #: place the predictor as a multi-host gang instead of in-process
+    #: replicas (predictor only; see GangSpec)
+    gang: Optional[GangSpec] = None
 
 
 class InferenceServiceSpec(_Model):

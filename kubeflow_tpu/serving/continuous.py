@@ -247,7 +247,9 @@ def make_decode_program(cfg, attend: int, chunk: int, mesh=None):
         keys = jax.random.split(key, chunk)
         (cache, logits, pos), toks = jax.lax.scan(
             step, (cache, logits, safe), keys)
-        return cache, logits, toks.T  # toks: [slots, chunk]
+        # tokens replicate so ANY host of a multi-process serving mesh can
+        # fetch them locally (the gang's rank-0 scheduler does)
+        return cache, logits, shardedlib.constrain_replicated(toks.T, mesh)
 
     return shardedlib.mesh_jit(mesh, decode, donate_argnums=(1, 2))
 
@@ -534,24 +536,28 @@ class ContinuousEngine:
         if groups is None:
             groups = [(1, self.seq_buckets[0]),
                       (self.num_slots, self.seq_buckets[0])]
+        # host args are NUMPY throughout: under a multi-process serving
+        # mesh (the gang) a process-local device array cannot feed a
+        # global-mesh jit — numpy inputs device_put as replicated on every
+        # host identically (single-host: byte-identical behavior)
         warm_attends = set()
         for g, bucket in groups:
             bucket = next(b for b in self.seq_buckets if b >= bucket)
             row_logits, row_cache = self._prefill_for(bucket)(
-                self.params, jnp.zeros((g, bucket), jnp.int32),
-                jnp.ones(g, np.int32))
+                self.params, np.zeros((g, bucket), np.int32),
+                np.ones(g, np.int32))
             self._pool_cache, self._pool_logits = self._merge(
                 self._pool_cache, self._pool_logits, row_cache, row_logits,
-                jnp.full(g, self.num_slots, jnp.int32))
+                np.full(g, self.num_slots, np.int32))
             warm_attends.add(bucket + self.decode_chunk)
         for needed in sorted(warm_attends):
             self._pool_cache, self._pool_logits, toks = self._decode_for(
                 needed)(
                 self.params, self._pool_cache, self._pool_logits,
-                jnp.full(self.num_slots, self.cfg.max_seq_len, jnp.int32),
-                jnp.zeros(self.num_slots, bool),
-                jnp.zeros(self.num_slots, jnp.float32),
-                jax.random.PRNGKey(0))
+                np.full(self.num_slots, self.cfg.max_seq_len, np.int32),
+                np.zeros(self.num_slots, bool),
+                np.zeros(self.num_slots, np.float32),
+                np.asarray(jax.random.PRNGKey(0)))
             jax.block_until_ready(toks)
         if self.prefix_cache:
             # warm the prefix-admit programs for the warmed prompt buckets
@@ -575,7 +581,7 @@ class ContinuousEngine:
                 self._pool_cache, self._pool_logits = program(
                     self.params, self._pool_cache, self._pool_logits,
                     np.int32(self.num_slots), np.int32(self.num_slots),
-                    np.int32(1), jnp.zeros(sb, jnp.int32), np.int32(1))
+                    np.int32(1), np.zeros(sb, np.int32), np.int32(1))
 
     def submit(
         self, prompt: list[int], max_new_tokens: Optional[int] = None,
@@ -720,10 +726,10 @@ class ContinuousEngine:
                     lengths[j] = len(prompt)
                     slots[j] = slot
                 row_logits, row_cache = self._prefill_for(bucket)(
-                    self.params, jnp.asarray(toks), jnp.asarray(lengths))
+                    self.params, toks, lengths)
                 self._pool_cache, self._pool_logits = self._merge(
                     self._pool_cache, self._pool_logits,
-                    row_cache, row_logits, jnp.asarray(slots))
+                    row_cache, row_logits, slots)
                 for req, prompt, slot in members:
                     self._occupy(req, prompt, slot)
             except Exception as e:  # noqa: BLE001 — fail this group only
@@ -775,7 +781,7 @@ class ContinuousEngine:
         self._pool_cache, self._pool_logits = program(
             self.params, self._pool_cache, self._pool_logits,
             np.int32(src), np.int32(slot), np.int32(lp),
-            jnp.asarray(toks), np.int32(len(suffix)))
+            toks, np.int32(len(suffix)))
         self._occupy(req, prompt, slot)
         self.prefix_hits += 1
         self.prefix_tokens_saved += lp
@@ -1016,15 +1022,12 @@ class TieredEngine:
         return merged
 
 
-def build_engine(cfg, params, config: dict, *, default_eos=None,
-                 default_max_new_tokens: int = 16) -> "ContinuousEngine":
-    """Engine from a serving-config dict — the ONE construction site shared
-    by every runtime that fronts the engine (token-level and text), so
-    knobs stay in sync.  Honors "warmup_groups": [] to skip warmup.
-    ``short_pool_len`` (tokens) turns on the two-tier pool (TieredEngine):
-    short conversations decode with windows bounded by it regardless of
-    what the long pool is doing."""
-    kw = dict(
+def engine_kwargs(config: dict, *, default_eos=None,
+                  default_max_new_tokens: int = 16) -> dict:
+    """ContinuousEngine kwargs from a serving-config dict — shared by
+    build_engine AND the serving gang (serving/gang.py), whose follower
+    hosts must construct byte-identical programs from the same config."""
+    return dict(
         num_slots=int(config.get("num_slots", 8)),
         decode_chunk=int(config.get("decode_chunk", 4)),
         temperature=float(config.get("temperature", 0.0)),
@@ -1036,6 +1039,32 @@ def build_engine(cfg, params, config: dict, *, default_eos=None,
         default_max_new_tokens=int(
             config.get("max_new_tokens", default_max_new_tokens)),
     )
+
+
+def resolve_model_source(config: dict, *, name: str = "model"):
+    """(cfg, params) from a serving config's model source — the ONE
+    resolution site shared by the in-process generator and every gang
+    member (serving/gang.py), so ``params_ref``/``storage_path``
+    semantics cannot drift between placements."""
+    ref = config.get("params_ref")
+    if ref:
+        return fetch_mem(ref[len("mem://"):])
+    if config.get("storage_path"):
+        return llamalib.load_pretrained(config["storage_path"])
+    raise RuntimeError(f"model {name}: need params_ref or storage_uri")
+
+
+def build_engine(cfg, params, config: dict, *, default_eos=None,
+                 default_max_new_tokens: int = 16) -> "ContinuousEngine":
+    """Engine from a serving-config dict — the ONE construction site shared
+    by every runtime that fronts the engine (token-level and text), so
+    knobs stay in sync.  Honors "warmup_groups": [] to skip warmup.
+    ``short_pool_len`` (tokens) turns on the two-tier pool (TieredEngine):
+    short conversations decode with windows bounded by it regardless of
+    what the long pool is doing."""
+    kw = engine_kwargs(
+        config, default_eos=default_eos,
+        default_max_new_tokens=default_max_new_tokens)
     short_len = config.get("short_pool_len")
     if short_len:
         engine = TieredEngine(
@@ -1067,20 +1096,18 @@ class ContinuousLlamaGenerator(Model):
 
     self_batching = True
 
-    def __init__(self, name: str, config: Optional[dict[str, Any]] = None):
+    def __init__(self, name: str, config: Optional[dict[str, Any]] = None,
+                 engine: Optional["ContinuousEngine"] = None):
         super().__init__(name, config)
-        self.engine: Optional[ContinuousEngine] = None
+        #: a prebuilt engine (the serving gang's rank-0 GangEngine) —
+        #: load() then skips construction and just marks ready
+        self.engine: Optional[ContinuousEngine] = engine
 
     def load(self) -> None:
-        ref = self.config.get("params_ref")
-        if ref:
-            cfg, params = fetch_mem(ref[len("mem://"):])
-        elif self.config.get("storage_path"):
-            cfg, params = llamalib.load_pretrained(
-                self.config["storage_path"])
-        else:
-            raise RuntimeError(
-                f"model {self.name}: need params_ref or storage_uri")
+        if self.engine is not None:
+            self.ready = True
+            return
+        cfg, params = resolve_model_source(self.config, name=self.name)
         self.engine = build_engine(cfg, params, self.config)
         self.ready = True
 

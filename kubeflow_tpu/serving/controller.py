@@ -47,6 +47,102 @@ SCALE_IDLE_SECONDS = 2.0  # idle window before scale-down (KPA-ish)
 ACTIVATION_TIMEOUT = 15.0
 
 
+class _GangPredictor:
+    """ModelServer-shaped handle for a gang-placed predictor.
+
+    The data plane is N cooperating host processes launched as a JaxJob
+    (serving/gang.py serve_main); rank 0's HTTP frontend lives at a port
+    this handle allocates and freezes into the job's env, so ``url`` is
+    known before the gang is even admitted — readiness is probed, not
+    assumed.  Restarts belong to the JaxJob controller (gang semantics);
+    this handle only creates/deletes the job.
+    """
+
+    def __init__(self, store: Store, isvc, rev: int, gang, cfg: dict):
+        import types
+
+        from ..api.common import (
+            Container, ObjectMeta, ReplicaSpec, Resources, RestartPolicy,
+            RunPolicy,
+        )
+        from ..api.jaxjob import WORKER, JaxJob, JaxJobSpec
+        from .gang import ENV_SERVE_CONFIG
+
+        self.store = store
+        self.namespace = isvc.metadata.namespace
+        self.job_name = f"{isvc.metadata.name}-gang-r{rev}"
+        self.port = allocate_port()
+        self.metrics = types.SimpleNamespace(inflight=0)
+        self._ready_at: float = 0.0
+        import secrets
+
+        conf = dict(cfg)
+        conf["serve_port"] = self.port
+        conf["gang_port"] = allocate_port()
+        # per-job shared secret guarding the gang control stream: only
+        # processes holding this job's env may occupy a follower slot
+        conf["gang_token"] = secrets.token_hex(16)
+        conf["mesh_axes"] = dict(gang.mesh_axes)
+        conf.setdefault("model_name", isvc.metadata.name)
+        env = {ENV_SERVE_CONFIG: json.dumps(conf)}
+        import os as _os
+
+        if _os.environ.get("KFT_POD_JAX_PLATFORMS", "cpu") == "cpu":
+            # local CPU stand-in: each gang pod fakes chips_per_host
+            # devices (real TPU hosts discover their local chips)
+            env["XLA_FLAGS"] = (
+                "--xla_force_host_platform_device_count="
+                f"{gang.chips_per_host}")
+        job = JaxJob(
+            metadata=ObjectMeta(name=self.job_name, namespace=self.namespace),
+            spec=JaxJobSpec(
+                run_policy=RunPolicy(backoff_limit=gang.backoff_limit),
+                replica_specs={
+                    WORKER: ReplicaSpec(
+                        replicas=gang.hosts,
+                        restart_policy=RestartPolicy.ON_FAILURE,
+                        template=Container(
+                            entrypoint="kubeflow_tpu.serving.gang:serve_main",
+                            env=env,
+                            resources=Resources(tpu=gang.chips_per_host),
+                        ),
+                    )
+                },
+            ),
+        )
+        store.create(job)
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    @property
+    def ready(self) -> bool:
+        """Rank 0 frontend answering its readiness probe (cached briefly —
+        the reconcile loop runs at 4 Hz and a gang is not a thing to poll
+        into the ground)."""
+        now = time.monotonic()
+        if now < self._ready_at + 1.0:
+            return True
+        try:
+            with urllib.request.urlopen(
+                    self.url + "/v2/health/ready", timeout=0.5) as resp:
+                ok = resp.status == 200
+        except OSError:
+            ok = False
+        if ok:
+            self._ready_at = now
+        return ok
+
+    def stop(self) -> None:
+        from ..api.jaxjob import KIND_JAXJOB
+
+        try:
+            self.store.delete(KIND_JAXJOB, self.job_name, self.namespace)
+        except NotFound:
+            pass
+
+
 def resolve_class(ref: str) -> type:
     """'pkg.module:Class' -> class object (ServingRuntime.server_class)."""
     mod, _, cls = ref.partition(":")
@@ -331,9 +427,12 @@ class InferenceServiceController(Controller):
             self._scale_predictors(isvc, dep, rev, desired)
         self._wire(isvc, dep)
 
+        def _up(rev: _Revision) -> bool:
+            return any(getattr(s, "ready", True) for s in rev.predictors)
+
         stable_ready = (
-            bool(dep.stable.predictors) or dep.stable.spec.predictor.min_replicas == 0)
-        canary_ready = dep.canary is None or bool(dep.canary.predictors)
+            _up(dep.stable) or dep.stable.spec.predictor.min_replicas == 0)
+        canary_ready = dep.canary is None or _up(dep.canary)
         ready = stable_ready and canary_ready
         stable_spec = dep.stable.spec.model_dump(mode="json")
         stable_spec.pop("canary_traffic_percent", None)
@@ -355,6 +454,11 @@ class InferenceServiceController(Controller):
 
     def _desired_replicas(self, dep: _Deployment, rev: _Revision) -> int:
         pred = rev.spec.predictor
+        if pred.gang is not None:
+            # a gang is a fixed placement unit: one JaxJob, restarts and
+            # sizing owned by the JaxJob controller — concurrency
+            # autoscaling / scale-to-zero don't apply at this tier
+            return 1
         n = len(rev.predictors)
         # during a canary split BOTH revisions must hold the road: a
         # revision idling to zero would silently forfeit its traffic
@@ -380,6 +484,17 @@ class InferenceServiceController(Controller):
     def _scale_predictors(
         self, isvc, dep: _Deployment, rev: _Revision, desired: int
     ) -> bool:
+        gang = rev.spec.predictor.gang
+        if gang is not None:
+            if not rev.predictors and desired > 0:
+                rev.predictors.append(_GangPredictor(
+                    self.store, isvc, rev.rev, gang, rev.cfg))
+                self.emit_event(
+                    isvc, "GangPlaced",
+                    f"rev {rev.rev} JaxJob "
+                    f"{rev.predictors[0].job_name} x{gang.hosts} hosts")
+                return True
+            return False
         changed = False
         while len(rev.predictors) < desired:
             server = ModelServer()
@@ -437,6 +552,11 @@ class InferenceServiceController(Controller):
         explain urls) — the transformer fronts the predictors when one is
         specified, the ``:explain`` verb routes to the explainer component
         [upstream: kserve routes verbs per component]."""
+        # a gang predictor exists before its rank-0 frontend answers; only
+        # READY predictors take traffic (in-process ModelServers are ready
+        # by construction)
+        ready_predictors = [
+            s for s in rev.predictors if getattr(s, "ready", True)]
         explain_urls: list[str] = []
         espec = rev.spec.explainer
         if espec and espec.handler:
@@ -445,14 +565,14 @@ class InferenceServiceController(Controller):
                 server = ModelServer()
                 model = cls(isvc.metadata.name, {
                     **dict(espec.config),
-                    "predictor_urls": [s.url for s in rev.predictors],
+                    "predictor_urls": [s.url for s in ready_predictors],
                     "model_name": isvc.metadata.name,
                 })
                 server.register(model, batch_max_size=1, batch_timeout_ms=0.0)
                 server.start()
                 rev.explainers.append(server)
             if rev.explainers:
-                urls = [s.url for s in rev.predictors]
+                urls = [s.url for s in ready_predictors]
                 for es in rev.explainers:
                     for m in es.models().values():
                         if hasattr(m, "predictor_urls"):
@@ -469,7 +589,7 @@ class InferenceServiceController(Controller):
                 cfg["predictor_url"] = None  # filled per request via backends
                 server = ModelServer()
                 model = cls(isvc.metadata.name, {
-                    **cfg, "predictor_urls": [s.url for s in rev.predictors],
+                    **cfg, "predictor_urls": [s.url for s in ready_predictors],
                     "model_name": isvc.metadata.name,
                 })
                 server.register(model, batch_max_size=tspec.batch_max_size,
@@ -479,13 +599,13 @@ class InferenceServiceController(Controller):
             if rev.transformers:
                 # keep the transformer's predictor list current: predictors
                 # churn on every scale event and ports never come back
-                urls = [s.url for s in rev.predictors]
+                urls = [s.url for s in ready_predictors]
                 for ts in rev.transformers:
                     for m in ts.models().values():
                         if hasattr(m, "predictor_urls"):
                             m.predictor_urls = list(urls)
                 return [s.url for s in rev.transformers], explain_urls
-        return [s.url for s in rev.predictors], explain_urls
+        return [s.url for s in ready_predictors], explain_urls
 
     def _wire(self, isvc, dep: _Deployment) -> None:
         """Point the router at every live revision, weighted by the canary

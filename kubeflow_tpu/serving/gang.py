@@ -1,0 +1,418 @@
+"""Multi-host serving: the predictor as a gang.
+
+The reference serves multi-accelerator models by giving the predictor pod
+N GPUs and letting vLLM/Triton span them inside one container [upstream:
+kserve/kserve -> python/huggingfaceserver; SURVEY.md §2.2 per-framework
+runtimes, §3.3 predictor hot path].  A TPU pod slice is different: a
+v5e-4x4 is 4 HOSTS x 4 chips — no single process addresses all 16 chips,
+so a TP=16 predictor is necessarily a *gang* of cooperating host
+processes executing the same SPMD programs in lockstep (the multi-host
+jit contract, SURVEY.md §2.6) — exactly the shape this platform already
+launches for training (runtime/bootstrap.py env triple ->
+``jax.distributed.initialize`` -> global mesh).
+
+Design — rank 0 decides, everyone dispatches:
+
+- every gang member loads the same snapshot, builds the same
+  ``ContinuousEngine`` programs over the same global serving mesh
+  (``engine_kwargs`` keeps the knobs byte-identical), and contributes its
+  addressable shards of the weights (serving/sharded.py
+  ``place_params``);
+- rank 0 additionally owns the HTTP frontend (``ModelServer``) and the
+  engine's scheduler thread.  The scheduler's *decisions* — which
+  requests admit into which slots, the decode schedule, sampling keys —
+  are host-side numpy scalars/arrays; :class:`GangChannel` streams them
+  to the followers as length-prefixed pickles over TCP **before** rank 0
+  dispatches, so every host issues the identical dispatch sequence and
+  XLA's collectives line up;
+- device data never crosses the channel: weights, the KV slot pool and
+  logits live sharded across the gang's chips; the only host fetch is
+  rank 0's sampled-token read, which the decode program replicates
+  (``constrain_replicated``) so rank 0 can read it locally.
+
+The dispatched programs are the SAME ones the single-process engine (and
+the AOT artifact, scripts/aot_7b_serving.py) compiles — the gang changes
+where processes sit, not what runs.  ``__graft_entry__.dryrun_multichip``'s
+serving leg therefore covers the gang's data plane.
+
+Failure semantics ride the JaxJob machinery: the InferenceService
+controller places the gang as a JaxJob (serving/controller.py
+``_GangPredictor``); a crashed member fails its pod, the JaxJob
+controller gang-restarts, and rank 0 re-binds the same frontend port.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import signal
+import socket
+import struct
+import threading
+import time
+from typing import Any, Optional
+
+import numpy as np
+
+from . import continuous as contlib
+from ..runtime import bootstrap
+
+#: pod-env key holding the JSON serving config (engine knobs +
+#: storage_path + serve_port + gang_port) the ISvc controller freezes at
+#: gang-placement time
+ENV_SERVE_CONFIG = "KFT_SERVE_CONFIG"
+
+_LEN = struct.Struct("!Q")
+
+
+class ChannelClosed(RuntimeError):
+    """The control stream died (a peer crashed or shut down)."""
+
+
+class GangChannel:
+    """Rank-0 -> followers control stream: length-prefixed pickles over
+    TCP.  Carries ONLY host-side scheduler decisions (op tag + numpy
+    args) between mutually-trusting gang members of one job — never
+    request payloads to the outside world and never device data.
+
+    Trust boundary: the stream is pickle between processes of ONE JaxJob,
+    so admission to it is guarded by a per-job shared ``token`` (frozen
+    into the gang's env by the ISvc controller, like the pod's other
+    credentials) — a follower must present it before it may occupy a
+    slot, and rank 0 closes anything that doesn't.  Deserialization
+    still trusts rank 0, which is the same trust a follower already
+    extends to the process that chose its dispatch stream.
+    """
+
+    def __init__(self, conns: list[socket.socket], rank: int) -> None:
+        self._conns = conns
+        self.rank = rank
+        self._lock = threading.Lock()
+
+    # -- construction ------------------------------------------------------
+
+    @classmethod
+    def listen(cls, port: int, num_followers: int, token: str = "",
+               timeout: float = 60.0) -> "GangChannel":
+        """Rank 0: accept every follower (they dial after the gang
+        barrier, so all are alive or the job already failed).  A
+        connection that fails the token handshake is dropped without
+        consuming a follower slot."""
+        import hmac
+
+        want = token.encode()
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind(("0.0.0.0", port))
+        srv.listen(max(num_followers, 1))
+        srv.settimeout(timeout)
+        deadline = time.monotonic() + timeout
+        conns: list[socket.socket] = []
+        try:
+            while len(conns) < num_followers:
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"only {len(conns)}/{num_followers} followers "
+                        "passed the gang handshake")
+                c, _addr = srv.accept()
+                try:
+                    c.settimeout(5.0)
+                    (n,) = _LEN.unpack(cls._read_exact(c, _LEN.size))
+                    got = cls._read_exact(c, n) if n <= 4096 else b""
+                    if not hmac.compare_digest(got, want):
+                        raise ChannelClosed("bad gang token")
+                    c.settimeout(None)
+                    c.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                    conns.append(c)
+                except (OSError, ChannelClosed, struct.error):
+                    c.close()
+        finally:
+            srv.close()
+        return cls(conns, rank=0)
+
+    @classmethod
+    def connect(cls, host: str, port: int, rank: int, token: str = "",
+                timeout: float = 60.0) -> "GangChannel":
+        payload = token.encode()
+        deadline = time.monotonic() + timeout
+        while True:
+            try:
+                c = socket.create_connection((host, port), timeout=5.0)
+                c.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                c.sendall(_LEN.pack(len(payload)) + payload)
+                c.settimeout(None)
+                return cls([c], rank=rank)
+            except OSError:
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(0.05)
+
+    # -- wire --------------------------------------------------------------
+
+    def publish(self, msg: tuple) -> None:
+        payload = pickle.dumps(msg, protocol=pickle.HIGHEST_PROTOCOL)
+        frame = _LEN.pack(len(payload)) + payload
+        with self._lock:
+            for c in self._conns:
+                try:
+                    c.sendall(frame)
+                except OSError as e:
+                    raise ChannelClosed(f"follower gone: {e}") from e
+
+    def next(self) -> tuple:
+        (c,) = self._conns
+        header = self._read_exact(c, _LEN.size)
+        (n,) = _LEN.unpack(header)
+        return pickle.loads(self._read_exact(c, n))
+
+    @staticmethod
+    def _read_exact(c: socket.socket, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            chunk = c.recv(n - len(buf))
+            if not chunk:
+                raise ChannelClosed("rank 0 closed the control stream")
+            buf += chunk
+        return buf
+
+    def close(self) -> None:
+        for c in self._conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+
+
+class GangEngine(contlib.ContinuousEngine):
+    """Rank-0 engine: every compiled-program call publishes its host args
+    before dispatching, so follower hosts replay the identical SPMD
+    dispatch stream against their shards (see :func:`follow`).
+
+    The wrap happens at the program-getter layer — the scheduler, the
+    admission batching, prefix-cache routing and warmup all run
+    UNMODIFIED; only the four dispatch sites gain a publish.  Host args
+    are normalized to numpy on both sides of the wire (a process-local
+    device array cannot feed a global-mesh jit).
+    """
+
+    def __init__(self, cfg, params, *, channel: GangChannel, **kw) -> None:
+        if kw.get("mesh_axes") is None:
+            raise ValueError("a serving gang needs mesh_axes")
+        self._channel = channel
+        super().__init__(cfg, params, **kw)
+
+    def _fatal(self, e: Exception) -> Exception:
+        """A failed publish OR a rank-0-only dispatch failure after a
+        successful publish both mean the gang's replicated pool state can
+        no longer be trusted (followers may have applied an update rank 0
+        skipped).  Mark the engine dead — the scheduler's per-request
+        exception handling must not paper over it — so serve_main's
+        watchdog exits non-zero and the JaxJob controller restarts the
+        whole gang."""
+        with self._gate:
+            if self._error is None:
+                self._error = e
+        return e
+
+    def _build_programs(self) -> None:
+        super()._build_programs()
+        ch = self._channel
+        prefill_inner = self._prefill_for
+        decode_inner = self._decode_for
+        prefix_inner = self._prefix_admit_for
+        merge_inner = self._merge
+
+        def prefill_for(bucket: int):
+            prog = prefill_inner(bucket)
+
+            def call(params, toks, lengths):
+                try:
+                    toks = np.asarray(toks)
+                    lengths = np.asarray(lengths)
+                    ch.publish(("prefill", int(bucket), toks, lengths))
+                    return prog(params, toks, lengths)
+                except Exception as e:  # noqa: BLE001 — see _fatal
+                    raise self._fatal(e)
+
+            return call
+
+        def decode_for(needed: int):
+            prog = decode_inner(needed)
+
+            def call(params, cache, logits, positions, active, temps, key):
+                try:
+                    positions = np.asarray(positions)
+                    active = np.asarray(active)
+                    temps = np.asarray(temps)
+                    key = np.asarray(key)
+                    ch.publish(
+                        ("decode", int(needed), positions, active, temps,
+                         key))
+                    return prog(params, cache, logits, positions, active,
+                                temps, key)
+                except Exception as e:  # noqa: BLE001
+                    raise self._fatal(e)
+
+            return call
+
+        def prefix_admit_for(total: int, suffix_bucket: int):
+            prog = prefix_inner(total, suffix_bucket)
+
+            def call(params, cache, logits, src, dst, lp, suffix, slen):
+                try:
+                    suffix = np.asarray(suffix)
+                    ch.publish(("prefix", int(total), int(suffix_bucket),
+                                int(src), int(dst), int(lp), suffix,
+                                int(slen)))
+                    return prog(params, cache, logits, np.int32(src),
+                                np.int32(dst), np.int32(lp), suffix,
+                                np.int32(slen))
+                except Exception as e:  # noqa: BLE001
+                    raise self._fatal(e)
+
+            return call
+
+        def merge(pool_cache, pool_logits, row_cache, row_logits, slots):
+            try:
+                slots = np.asarray(slots)
+                ch.publish(("merge", slots))
+                return merge_inner(
+                    pool_cache, pool_logits, row_cache, row_logits, slots)
+            except Exception as e:  # noqa: BLE001
+                raise self._fatal(e)
+
+        self._prefill_for = prefill_for
+        self._decode_for = decode_for
+        self._prefix_admit_for = prefix_admit_for
+        self._merge = merge
+
+    def stop(self) -> None:
+        super().stop()
+        try:
+            self._channel.publish(("stop",))
+        except ChannelClosed:
+            pass
+        self._channel.close()
+
+
+def follow(engine: contlib.ContinuousEngine, channel: GangChannel) -> None:
+    """Follower executor: replay rank 0's dispatch stream.
+
+    ``engine`` is a plain ContinuousEngine constructed from the same
+    config — its scheduler never starts (that thread is lazy on submit,
+    which followers never call); only its compiled programs and pool
+    buffers are used.  Returns cleanly on the ``stop`` message; raises
+    :class:`ChannelClosed` if rank 0 dies, which fails this pod and
+    triggers the gang restart.
+    """
+    params = engine.params
+    row: Optional[tuple] = None
+    while True:
+        msg = channel.next()
+        op = msg[0]
+        if op == "stop":
+            return
+        if op == "prefill":
+            _, bucket, toks, lengths = msg
+            row = engine._prefill_for(bucket)(params, toks, lengths)
+        elif op == "merge":
+            (_, slots) = msg
+            assert row is not None, "merge before prefill in gang stream"
+            row_logits, row_cache = row
+            engine._pool_cache, engine._pool_logits = engine._merge(
+                engine._pool_cache, engine._pool_logits,
+                row_cache, row_logits, slots)
+            row = None
+        elif op == "decode":
+            _, needed, positions, active, temps, key = msg
+            engine._pool_cache, engine._pool_logits, _toks = (
+                engine._decode_for(needed)(
+                    params, engine._pool_cache, engine._pool_logits,
+                    positions, active, temps, key))
+        elif op == "prefix":
+            _, total, sb, src, dst, lp, suffix, slen = msg
+            engine._pool_cache, engine._pool_logits = (
+                engine._prefix_admit_for(total, sb)(
+                    params, engine._pool_cache, engine._pool_logits,
+                    np.int32(src), np.int32(dst), np.int32(lp),
+                    suffix, np.int32(slen)))
+        else:
+            raise RuntimeError(f"unknown gang op {op!r}")
+
+
+# ---------------------------------------------------------------------------
+# Gang entrypoint (what the ISvc controller's JaxJob runs in every pod)
+# ---------------------------------------------------------------------------
+
+
+def serve_main(ctx: bootstrap.PodContext) -> None:
+    """Entrypoint for every member of a serving gang (via pod_main:
+    ``jax.distributed`` is already initialized and the gang barrier
+    passed when this runs).
+
+    Config (``KFT_SERVE_CONFIG`` json): engine knobs per ``engine_kwargs``
+    plus ``mesh_axes`` (the global serving mesh), ``storage_path`` or
+    ``params_ref`` (every member loads the same weights), ``serve_port``
+    (rank 0's HTTP frontend — stable across gang restarts) and
+    ``gang_port`` (the control stream).
+    """
+    conf = json.loads(os.environ[ENV_SERVE_CONFIG])
+    if conf.get("short_pool_len"):
+        raise ValueError(
+            "short_pool_len (TieredEngine) is not gang-capable yet: the "
+            "control stream drives ONE engine's dispatch order")
+    cfg, params = contlib.resolve_model_source(
+        conf, name=conf.get("model_name", "model"))
+    kw = contlib.engine_kwargs(conf, default_eos=conf.get("eos_id"))
+    kw["seq_buckets"] = conf.get("seq_buckets")
+    gang_port = int(conf["gang_port"])
+    token = str(conf.get("gang_token", ""))
+    followers = ctx.num_processes - 1
+
+    if ctx.process_id == 0:
+        from .server import ModelServer
+
+        channel = GangChannel.listen(gang_port, followers, token=token)
+        engine = GangEngine(cfg, params, channel=channel, **kw)
+        groups = conf.get("warmup_groups")
+        if groups != []:
+            engine.warmup([tuple(g) for g in groups] if groups else None)
+        model = contlib.ContinuousLlamaGenerator(
+            conf.get("model_name", "model"), conf, engine=engine)
+        server = ModelServer(port=int(conf["serve_port"]))
+        server.register(model)
+        # the frontend port is stable across gang restarts; the previous
+        # incarnation's listener may need its SIGTERM grace to vacate it
+        deadline = time.monotonic() + 15.0
+        while True:
+            try:
+                server.start()
+                break
+            except OSError:
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(0.2)
+        stop = threading.Event()
+        signal.signal(signal.SIGTERM, lambda *_: stop.set())
+        try:
+            while not stop.is_set():
+                # a dead follower surfaces as a ChannelClosed publish
+                # failure inside the scheduler -> engine error; exit
+                # non-zero so the JaxJob controller gang-restarts
+                if engine._error is not None:
+                    raise SystemExit(1)
+                stop.wait(0.2)
+        finally:
+            server.stop()
+            engine.stop()
+    else:
+        host, _, _ = bootstrap.resolve_coordinator(
+            ctx.coordinator_address or "127.0.0.1:0").rpartition(":")
+        channel = GangChannel.connect(
+            host, gang_port, rank=ctx.process_id, token=token)
+        engine = contlib.ContinuousEngine(cfg, params, **kw)
+        try:
+            follow(engine, channel)
+        finally:
+            channel.close()

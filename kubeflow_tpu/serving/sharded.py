@@ -105,6 +105,17 @@ def cache_shardings(cache_shapes: Any, mesh: Mesh) -> Any:
         lambda s: cache_leaf_sharding(mesh, len(s.shape)), cache_shapes)
 
 
+def constrain_replicated(x: jax.Array, mesh: Optional[Mesh]) -> jax.Array:
+    """Force a program output fully replicated — every host can then fetch
+    it locally (``np.asarray`` requires ``is_fully_replicated`` once the
+    mesh spans processes; the sampled-token fetch on the gang's rank 0 is
+    exactly that case)."""
+    if mesh is None:
+        return x
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh, PartitionSpec()))
+
+
 def constrain_logits(x: jax.Array, mesh: Optional[Mesh]) -> jax.Array:
     """Vocab-sharded logits constraint ([..., vocab] rides the TP axis,
     matching the unembedding matmul's natural output layout) — no-op
@@ -136,11 +147,26 @@ def place_params(cfg, params: Any, mesh: Mesh) -> Any:
 
     Accepts boxed (``nn.Partitioned``) or plain trees — checkpoints and
     ``model.init`` hand back boxed params; serving operates unboxed.
+
+    When the mesh spans multiple host processes (the serving gang,
+    serving/gang.py), every process calls this with the SAME host-local
+    weights (each gang member loads the same snapshot) and each
+    contributes its addressable shards via ``make_array_from_callback``
+    — ``device_put`` cannot target non-addressable devices.
     """
     from flax import linen as nn
 
-    return jax.device_put(
-        nn.meta.unbox(params), llama_param_shardings(cfg, mesh))
+    params = nn.meta.unbox(params)
+    shardings = llama_param_shardings(cfg, mesh)
+    if jax.process_count() == 1:
+        return jax.device_put(params, shardings)
+    import numpy as np
+
+    def place(leaf, s):
+        arr = np.asarray(leaf)
+        return jax.make_array_from_callback(arr.shape, s, lambda i: arr[i])
+
+    return jax.tree.map(place, params, shardings)
 
 
 def mesh_jit(mesh: Optional[Mesh], fn, **jit_kwargs):
