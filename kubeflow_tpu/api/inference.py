@@ -16,7 +16,7 @@ from __future__ import annotations
 import enum
 from typing import Any, Optional
 
-from pydantic import Field
+from pydantic import Field, model_validator
 
 from .common import Resources, TypedObject, _Model
 
@@ -45,6 +45,22 @@ class GangSpec(_Model):
     chips_per_host: int = Field(default=4, ge=1)
     #: gang-restart budget (JaxJob run_policy.backoff_limit)
     backoff_limit: int = 16
+
+    @model_validator(mode="after")
+    def _mesh_covers_gang(self) -> "GangSpec":
+        # reject at admission, not after backoff_limit whole-gang crash
+        # loops: every member builds this exact global mesh
+        import math
+
+        if not self.mesh_axes:
+            raise ValueError("gang.mesh_axes must name the serving mesh")
+        n = math.prod(self.mesh_axes.values())
+        if n != self.hosts * self.chips_per_host:
+            raise ValueError(
+                f"gang mesh {self.mesh_axes} covers {n} chips but "
+                f"{self.hosts} hosts x {self.chips_per_host} chips/host "
+                f"= {self.hosts * self.chips_per_host}")
+        return self
 
 
 class ComponentSpec(_Model):

@@ -654,10 +654,20 @@ def save_pretrained(path: str, cfg: LlamaConfig, params: Any) -> None:
 
 def load_pretrained_config(path: str) -> LlamaConfig:
     """The snapshot's architecture, without touching the weights (cheap on
-    every process; weight loading happens once per host at init)."""
+    every process; weight loading happens once per host at init).
+
+    Auto-detects the layout: this repo's ``save_pretrained`` dataclass
+    config, OR a stock transformers snapshot (``model_type: llama`` +
+    safetensors — models/hf_checkpoint.py), so every call site
+    (KFT_INIT_FROM, storage_path serving, TrainingClient.train(model=...))
+    accepts published Llama checkpoints unchanged."""
     import json
     import os
 
+    from . import hf_checkpoint
+
+    if hf_checkpoint.is_hf_snapshot(path):
+        return hf_checkpoint.config_from_hf(path)
     with open(os.path.join(path, "config.json")) as f:
         d = json.load(f)
     d["dtype"] = jnp.dtype(d["dtype"])
@@ -666,13 +676,18 @@ def load_pretrained_config(path: str) -> LlamaConfig:
 
 
 def load_pretrained(path: str) -> tuple[LlamaConfig, Any]:
-    """Read a snapshot written by ``save_pretrained`` (or any directory in
-    that layout) into (config, params) — params are plain host arrays,
-    ready for ``jax.device_put`` onto any mesh's shardings."""
+    """Read a snapshot written by ``save_pretrained`` — or a stock
+    transformers-layout safetensors snapshot (auto-detected) — into
+    (config, params): plain host arrays, ready for ``jax.device_put``
+    onto any mesh's shardings."""
     import os
 
     from flax import serialization
 
+    from . import hf_checkpoint
+
+    if hf_checkpoint.is_hf_snapshot(path):
+        return hf_checkpoint.load_hf_llama(path)
     cfg = load_pretrained_config(path)
     with open(os.path.join(path, "weights.msgpack"), "rb") as f:
         params = serialization.msgpack_restore(f.read())

@@ -197,7 +197,7 @@ class GangEngine(contlib.ContinuousEngine):
     """
 
     def __init__(self, cfg, params, *, channel: GangChannel, **kw) -> None:
-        if kw.get("mesh_axes") is None:
+        if not kw.get("mesh_axes"):
             raise ValueError("a serving gang needs mesh_axes")
         self._channel = channel
         super().__init__(cfg, params, **kw)
@@ -209,10 +209,15 @@ class GangEngine(contlib.ContinuousEngine):
         skipped).  Mark the engine dead — the scheduler's per-request
         exception handling must not paper over it — so serve_main's
         watchdog exits non-zero and the JaxJob controller restarts the
-        whole gang."""
-        with self._gate:
-            if self._error is None:
-                self._error = e
+        whole gang.
+
+        Deliberately lock-free: warmup() holds the engine gate while
+        calling the wrapped programs, so taking it here would deadlock
+        rank 0 on a mid-warmup follower death.  The assignment is a
+        single store read by the watchdog/submit; losing a first-error
+        race to the scheduler thread is benign."""
+        if self._error is None:
+            self._error = e
         return e
 
     def _build_programs(self) -> None:

@@ -1,0 +1,254 @@
+"""Stock published-Llama checkpoint interop (models/hf_checkpoint.py).
+
+SURVEY.md §3.5 / §2.2 storage row — the fine-tune/serve UX must accept a
+GENUINE transformers-layout snapshot (safetensors with per-layer
+``q_proj/k_proj/...`` tensors), not just this repo's own published
+format.  The WRITER here is test-local (building a synthetic HF-layout
+snapshot from known params); the reader under test lives in the repo.
+Parity bar: logits from converted params must match logits from the
+directly-constructed params bit-for-bit (both f32 on CPU).
+"""
+
+import json
+import os
+import struct
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubeflow_tpu.models import hf_checkpoint as hflib
+from kubeflow_tpu.models import llama as llamalib
+
+
+# -- test-local safetensors writer + reverse layout map ---------------------
+
+
+def write_safetensors(path: str, tensors: dict[str, np.ndarray],
+                      dtype_tag: str = "F32") -> None:
+    header = {}
+    blobs = []
+    offset = 0
+    for name, arr in tensors.items():
+        if dtype_tag == "BF16":
+            f32 = np.ascontiguousarray(arr, dtype=np.float32)
+            raw = ((f32.view(np.uint32) >> 16).astype("<u2")).tobytes()
+        else:
+            raw = np.ascontiguousarray(arr, dtype="<f4").tobytes()
+        header[name] = {
+            "dtype": dtype_tag,
+            "shape": list(arr.shape),
+            "data_offsets": [offset, offset + len(raw)],
+        }
+        blobs.append(raw)
+        offset += len(raw)
+    hdr = json.dumps(header).encode()
+    with open(path, "wb") as f:
+        f.write(struct.pack("<Q", len(hdr)))
+        f.write(hdr)
+        for b in blobs:
+            f.write(b)
+
+
+def hf_tensors_from_params(cfg, params) -> dict[str, np.ndarray]:
+    """Reverse of the repo's converter: repo tree -> HF names/layouts."""
+    E, M = cfg.hidden_size, cfg.intermediate_size
+    H, KV, D = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+    block = params["layers"]["block"]
+    out = {"model.embed_tokens.weight": np.asarray(
+        params["embedder"]["embedding"])}
+    for layer in range(cfg.num_layers):
+        p = f"model.layers.{layer}."
+        a = block["attn"]
+        out[p + "self_attn.q_proj.weight"] = (
+            np.asarray(a["wq"]["kernel"][layer]).reshape(E, H * D).T)
+        out[p + "self_attn.k_proj.weight"] = (
+            np.asarray(a["wk"]["kernel"][layer]).reshape(E, KV * D).T)
+        out[p + "self_attn.v_proj.weight"] = (
+            np.asarray(a["wv"]["kernel"][layer]).reshape(E, KV * D).T)
+        out[p + "self_attn.o_proj.weight"] = (
+            np.asarray(a["wo"]["kernel"][layer]).reshape(H * D, E).T)
+        m = block["mlp"]
+        out[p + "mlp.gate_proj.weight"] = np.asarray(
+            m["w_gate"]["kernel"][layer]).T
+        out[p + "mlp.up_proj.weight"] = np.asarray(
+            m["w_up"]["kernel"][layer]).T
+        out[p + "mlp.down_proj.weight"] = np.asarray(
+            m["w_down"]["kernel"][layer]).T
+        out[p + "input_layernorm.weight"] = np.asarray(
+            block["attn_norm"]["scale"][layer])
+        out[p + "post_attention_layernorm.weight"] = np.asarray(
+            block["mlp_norm"]["scale"][layer])
+    out["model.norm.weight"] = np.asarray(
+        params["head"]["final_norm"]["scale"])
+    if not cfg.tie_embeddings:
+        out["lm_head.weight"] = np.asarray(params["head"]["unembedding"]).T
+    return out
+
+
+def hf_config_dict(cfg) -> dict:
+    return {
+        "model_type": "llama",
+        "architectures": ["LlamaForCausalLM"],
+        "vocab_size": cfg.vocab_size,
+        "hidden_size": cfg.hidden_size,
+        "intermediate_size": cfg.intermediate_size,
+        "num_hidden_layers": cfg.num_layers,
+        "num_attention_heads": cfg.num_heads,
+        "num_key_value_heads": cfg.num_kv_heads,
+        "head_dim": cfg.head_dim,
+        "max_position_embeddings": cfg.max_seq_len,
+        "rope_theta": cfg.rope_theta,
+        "rms_norm_eps": cfg.rms_norm_eps,
+        "tie_word_embeddings": cfg.tie_embeddings,
+    }
+
+
+def make_hf_snapshot(tmp_path, cfg, params, shards: int = 1,
+                     dtype_tag: str = "F32") -> str:
+    path = tmp_path / "hf_snap"
+    path.mkdir(exist_ok=True)
+    with open(path / "config.json", "w") as f:
+        json.dump(hf_config_dict(cfg), f)
+    tensors = hf_tensors_from_params(cfg, params)
+    if shards == 1:
+        write_safetensors(str(path / "model.safetensors"), tensors,
+                          dtype_tag)
+    else:
+        names = sorted(tensors)
+        weight_map = {}
+        for i in range(shards):
+            part = {n: tensors[n] for n in names[i::shards]}
+            fname = f"model-{i + 1:05d}-of-{shards:05d}.safetensors"
+            write_safetensors(str(path / fname), part, dtype_tag)
+            weight_map.update({n: fname for n in part})
+        with open(path / "model.safetensors.index.json", "w") as f:
+            json.dump({"weight_map": weight_map}, f)
+    return str(path)
+
+
+def _tiny_with_params(**kw):
+    cfg = llamalib.tiny(**kw)
+    params = llamalib.Llama(cfg).init(
+        jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32))["params"]
+    from flax import linen as nn
+
+    return cfg, nn.meta.unbox(params)
+
+
+TOKENS = jnp.asarray([[3, 1, 4, 1, 5, 9, 2, 6]], jnp.int32)
+
+
+class TestSafetensorsReader:
+    def test_roundtrip_f32(self, tmp_path):
+        arrs = {"a": np.arange(12, dtype=np.float32).reshape(3, 4),
+                "b": np.float32([[1.5]])}
+        write_safetensors(str(tmp_path / "x.safetensors"), arrs)
+        got = hflib.read_safetensors(str(tmp_path / "x.safetensors"))
+        assert set(got) == {"a", "b"}
+        assert np.array_equal(got["a"], arrs["a"])
+
+    def test_bf16_upcast(self, tmp_path):
+        arr = {"w": np.linspace(-3, 3, 16, dtype=np.float32).reshape(4, 4)}
+        write_safetensors(str(tmp_path / "b.safetensors"), arr, "BF16")
+        got = hflib.read_safetensors(str(tmp_path / "b.safetensors"))["w"]
+        assert got.dtype == np.float32
+        # bf16 keeps ~3 decimal digits
+        assert np.allclose(got, arr["w"], atol=0.05)
+
+    def test_bad_offsets_rejected(self, tmp_path):
+        hdr = json.dumps({"x": {"dtype": "F32", "shape": [4],
+                                "data_offsets": [0, 999]}}).encode()
+        p = tmp_path / "bad.safetensors"
+        with open(p, "wb") as f:
+            f.write(struct.pack("<Q", len(hdr)) + hdr + b"\x00" * 16)
+        with pytest.raises(ValueError, match="offsets"):
+            hflib.read_safetensors(str(p))
+
+
+class TestHfLlamaConversion:
+    def test_logits_parity_exact(self, tmp_path):
+        cfg, params = _tiny_with_params()
+        snap = make_hf_snapshot(tmp_path, cfg, params)
+        cfg2, params2 = llamalib.load_pretrained(snap)  # auto-detect
+        assert cfg2.num_kv_heads == cfg.num_kv_heads
+        assert cfg2.head_dim == cfg.head_dim
+        # evaluate both under the SAME cfg: the converter keeps the
+        # repo's TPU dtype defaults (bf16 activations), which is a knob,
+        # not an architecture difference
+        model = llamalib.Llama(cfg)
+        want = model.apply({"params": params}, TOKENS)
+        got = model.apply({"params": params2}, TOKENS)
+        assert np.array_equal(np.asarray(want), np.asarray(got))
+
+    def test_sharded_snapshot_with_index(self, tmp_path):
+        cfg, params = _tiny_with_params(num_layers=3)
+        snap = make_hf_snapshot(tmp_path, cfg, params, shards=3)
+        cfg2, params2 = llamalib.load_pretrained(snap)
+        assert cfg2.num_layers == 3
+        want = llamalib.Llama(cfg).apply({"params": params}, TOKENS)
+        got = llamalib.Llama(cfg).apply({"params": params2}, TOKENS)
+        assert np.array_equal(np.asarray(want), np.asarray(got))
+
+    def test_tied_embeddings(self, tmp_path):
+        cfg, params = _tiny_with_params(tie_embeddings=True)
+        snap = make_hf_snapshot(tmp_path, cfg, params)
+        cfg2, params2 = llamalib.load_pretrained(snap)
+        assert cfg2.tie_embeddings
+        want = llamalib.Llama(cfg).apply({"params": params}, TOKENS)
+        got = llamalib.Llama(cfg).apply({"params": params2}, TOKENS)
+        assert np.array_equal(np.asarray(want), np.asarray(got))
+
+    def test_missing_tensor_named_in_error(self, tmp_path):
+        cfg, params = _tiny_with_params()
+        tensors = hf_tensors_from_params(cfg, params)
+        tensors.pop("model.layers.1.mlp.up_proj.weight")
+        path = tmp_path / "broken"
+        path.mkdir()
+        with open(path / "config.json", "w") as f:
+            json.dump(hf_config_dict(cfg), f)
+        write_safetensors(str(path / "model.safetensors"), tensors)
+        with pytest.raises(KeyError, match="up_proj"):
+            llamalib.load_pretrained(str(path))
+
+    def test_own_format_still_detected(self, tmp_path):
+        """save_pretrained snapshots must keep loading via msgpack —
+        the detector must not misfire on the dataclass config.json."""
+        cfg, params = _tiny_with_params()
+        path = str(tmp_path / "own")
+        llamalib.save_pretrained(path, cfg, params)
+        assert not hflib.is_hf_snapshot(path)
+        cfg2, _ = llamalib.load_pretrained(path)
+        assert cfg2 == cfg
+
+
+class TestHfServingAndFinetune:
+    def test_generator_serves_hf_snapshot(self, tmp_path):
+        from kubeflow_tpu.serving.runtimes import LlamaGenerator
+        from kubeflow_tpu.serving.storage import register_mem
+
+        cfg, params = _tiny_with_params()
+        snap = make_hf_snapshot(tmp_path, cfg, params)
+        ref = register_mem("hfparity", (cfg, params))
+        direct = LlamaGenerator("d", {"params_ref": ref, "max_new_tokens": 4})
+        direct.start()
+        want = direct.predict_batch([[1, 2, 3]])
+        hf = LlamaGenerator(
+            "h", {"storage_path": snap, "max_new_tokens": 4})
+        hf.start()
+        assert hf.predict_batch([[1, 2, 3]]) == want
+
+    def test_trainer_finetunes_from_hf_snapshot(self, tmp_path):
+        """KFT_INIT_FROM-equivalent: Trainer(init_from=<hf dir>) starts
+        from the converted weights (loss continuity beats scratch)."""
+        from kubeflow_tpu.train import trainer as trainlib
+
+        cfg, params = _tiny_with_params()
+        snap = make_hf_snapshot(tmp_path, cfg, params)
+        t = trainlib.Trainer(trainlib.TrainConfig(
+            model=cfg, steps=1, global_batch=8, seq_len=16, init_from=snap))
+        state = t.init_state()
+        got = state["params"]["embedder"]["embedding"]
+        assert np.array_equal(
+            np.asarray(got), np.asarray(params["embedder"]["embedding"]))
