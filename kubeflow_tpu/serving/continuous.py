@@ -138,7 +138,7 @@ def make_prefill_program(cfg, attend: int, mesh=None):
 
 
 def make_prefix_admit_program(cfg, attend: int, suffix_bucket: int,
-                              batch_axes=None, mesh=None):
+                              batch_axes=None, mesh=None, seq_axes=None):
     """Admission with PREFIX REUSE, fused into one dispatch.
 
     A new request whose prompt shares a long prefix with what some slot's
@@ -153,30 +153,39 @@ def make_prefix_admit_program(cfg, attend: int, suffix_bucket: int,
       pool[dst] <- updated row; logits[dst] <- last-token logits
 
     ``batch_axes``: per-leaf slot-axis tree (the engine's ``_batch_axes``
-    probe — the slot axis sits AFTER the scanned layer axis).  Signature:
-    (params, pool_cache, pool_logits, src, dst, lp, suffix, slen) ->
-    (pool_cache, pool_logits); pool buffers donated.
+    probe — the slot axis sits AFTER the scanned layer axis).
+    ``seq_axes``: per-leaf seq-axis tree (``_seq_axes`` probe) — the k/v
+    tensors keep seq right after the slot axis, but the int8-KV scale
+    buffers keep it LAST (llama._decode_attend layout note), so the
+    prefix mask must target the probed dim, not a positional guess.
+    Signature: (params, pool_cache, pool_logits, src, dst, lp, suffix,
+    slen) -> (pool_cache, pool_logits); pool buffers donated.
     """
     from jax import lax
 
     wmodel = llamalib.Llama(cfg, decode_attend_len=attend)
+    if seq_axes is None:  # pre-probe callers: seq follows the slot axis
+        seq_axes = jax.tree.map(
+            lambda a: None if a is None else a + 1, batch_axes)
 
     def admit(params, pool_cache, pool_logits, src, dst, lp, suffix, slen):
-        def copy_leaf(c, a):
+        def copy_leaf(c, a, sax):
             if a is None:  # cache_index bookkeeping: untouched
                 return c
             src_row = jnp.take(c, src, axis=a)   # slot axis removed
             dst_row = jnp.take(c, dst, axis=a)
-            seq_len = c.shape[a + 1]             # seq follows the slot axis
+            seq_len = c.shape[sax]
+            s_row = sax - 1 if sax > a else sax  # row lost the slot axis
             mask = (jnp.arange(seq_len) < lp).reshape(
-                *([1] * a), seq_len, *([1] * (c.ndim - a - 2)))
+                [seq_len if i == s_row else 1 for i in range(c.ndim - 1)])
             merged = jnp.where(mask, src_row, dst_row)
             idx = (slice(None),) * a + (dst,)
             # mode="drop": an out-of-range dst (the warmup sentinel
             # num_slots) must discard, not clamp onto the last real slot
             return c.at[idx].set(merged, mode="drop")
 
-        pool_cache = jax.tree.map(copy_leaf, pool_cache, batch_axes)
+        pool_cache = jax.tree.map(copy_leaf, pool_cache, batch_axes,
+                                  seq_axes)
         # suffix forward against the copied prefix: slice the dst row
         # (batch 1), run a [1, bucket] decode-mode forward at positions
         # lp+arange, scatter the mutated row back
@@ -305,6 +314,12 @@ class ContinuousEngine:
             shardedlib.build_serving_mesh(mesh_axes) if mesh_axes else None)
         if self.mesh is not None:
             params = shardedlib.place_params(cfg, params, self.mesh)
+        else:
+            # commit host arrays (snapshots, quantize_for_serving output)
+            # to the device ONCE — leaving numpy leaves in self.params
+            # would re-upload the whole model on EVERY dispatch, which a
+            # remote-dispatch backend turns into seconds per token
+            params = jax.device_put(params)
         self.params = params
         self.num_slots = num_slots
         self.decode_chunk = decode_chunk
@@ -416,6 +431,14 @@ class ContinuousEngine:
 
         self._pool_shapes = pool_proto
         self._batch_axes = jax.tree.map(batch_axis, probe_proto, row_proto)
+        # seq-axis probe: vary max_seq_len and see which dim moves (k/v
+        # keep seq after the slot axis; int8-KV scale buffers keep it
+        # LAST — make_prefix_admit_program's mask needs the truth)
+        import dataclasses as _dc
+
+        seq_proto = cache_shapes(
+            _dc.replace(cfg, max_seq_len=cfg.max_seq_len + 8), slots)
+        self._seq_axes = jax.tree.map(batch_axis, seq_proto, pool_proto)
 
         self._prefill_programs: dict[int, Any] = {}
 
@@ -469,7 +492,8 @@ class ContinuousEngine:
             key = (attend, suffix_bucket)
             if key not in self._prefix_programs:
                 self._prefix_programs[key] = make_prefix_admit_program(
-                    cfg, attend, suffix_bucket, self._batch_axes, mesh)
+                    cfg, attend, suffix_bucket, self._batch_axes, mesh,
+                    seq_axes=self._seq_axes)
             return self._prefix_programs[key]
 
         self._prefix_admit_for = prefix_admit_for
@@ -1054,6 +1078,18 @@ def resolve_model_source(config: dict, *, name: str = "model"):
     raise RuntimeError(f"model {name}: need params_ref or storage_uri")
 
 
+def apply_serving_quant(cfg, params, config: dict):
+    """Honor the serving config's int8 knobs (``quant_weights`` /
+    ``quant_kv``) — shared by build_engine and every gang member
+    (serving/gang.py), so a quantized deployment quantizes identically on
+    all hosts."""
+    w = bool(config.get("quant_weights"))
+    k = bool(config.get("quant_kv"))
+    if not (w or k):
+        return cfg, params
+    return llamalib.quantize_for_serving(cfg, params, weights=w, kv=k)
+
+
 def build_engine(cfg, params, config: dict, *, default_eos=None,
                  default_max_new_tokens: int = 16) -> "ContinuousEngine":
     """Engine from a serving-config dict — the ONE construction site shared
@@ -1065,6 +1101,7 @@ def build_engine(cfg, params, config: dict, *, default_eos=None,
     kw = engine_kwargs(
         config, default_eos=default_eos,
         default_max_new_tokens=default_max_new_tokens)
+    cfg, params = apply_serving_quant(cfg, params, config)
     short_len = config.get("short_pool_len")
     if short_len:
         engine = TieredEngine(

@@ -369,6 +369,7 @@ def serve_main(ctx: bootstrap.PodContext) -> None:
             "control stream drives ONE engine's dispatch order")
     cfg, params = contlib.resolve_model_source(
         conf, name=conf.get("model_name", "model"))
+    cfg, params = contlib.apply_serving_quant(cfg, params, conf)
     kw = contlib.engine_kwargs(conf, default_eos=conf.get("eos_id"))
     kw["seq_buckets"] = conf.get("seq_buckets")
     gang_port = int(conf["gang_port"])

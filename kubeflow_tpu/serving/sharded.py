@@ -73,12 +73,14 @@ def cache_leaf_sharding(mesh: Mesh, ndim: int) -> NamedSharding:
     """Sharding for one KV-cache leaf.
 
     Leaves are ``cached_key``/``cached_value`` of shape [batch, seq,
-    kv_heads, head_dim] (plus a leading layer axis under scan_layers) and
-    scalar/per-layer ``cache_index`` bookkeeping.  The kv_heads dim —
-    always ndim-2 on the tensor leaves — shards on the TP axis; everything
-    else replicates.  (Batch/slot sharding would put *requests* on
-    different chips, which serves throughput but not model size; the
-    capability gap is model size.)
+    kv_heads, head_dim] (plus a leading layer axis under scan_layers),
+    the int8-KV ``*_scale`` buffers [batch, kv_heads, seq] (seq MINOR —
+    chosen in llama._decode_attend precisely so the kv dim lands at
+    ndim-2 here too), and scalar/per-layer ``cache_index`` bookkeeping.
+    The kv_heads dim — uniformly ndim-2 on every >=4-dim leaf — shards
+    on the TP axis; everything else replicates.  (Batch/slot sharding
+    would put *requests* on different chips, which serves throughput but
+    not model size; the capability gap is model size.)
     """
     axis = kv_heads_axis(mesh)
     if ndim < 4 or axis is None:

@@ -66,15 +66,20 @@ def abstract_params(cfg, mesh):
 
 
 def compile_candidate(devs, cfg, *, tp, num_slots, decode_chunk=16,
-                      prompt_bucket=2048):
+                      prompt_bucket=2048, quant=False):
+    if quant:
+        # int8 serving (llama.quantize_for_serving flags): weights AND KV
+        # stored int8 — the abstract init emits the int8+scale param tree
+        import dataclasses as _dc
+
+        cfg = _dc.replace(cfg, quant_weights=True, quant_kv=True,
+                          param_dtype=jnp.float32)
     mesh = shardedlib.build_serving_mesh({"model": tp}, devices=devs)
     params = abstract_params(cfg, mesh)
     pool_shapes = contlib.cache_shapes(cfg, num_slots)
     pool = jax.tree.map(
-        lambda s: jax.ShapeDtypeStruct(
-            s.shape, s.dtype,
-            sharding=shardedlib.cache_leaf_sharding(mesh, len(s.shape))),
-        pool_shapes)
+        lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
+        pool_shapes, shardedlib.cache_shardings(pool_shapes, mesh))
     logits = jax.ShapeDtypeStruct(
         (num_slots, cfg.vocab_size), cfg.dtype,
         sharding=jax.sharding.NamedSharding(
@@ -85,7 +90,8 @@ def compile_candidate(devs, cfg, *, tp, num_slots, decode_chunk=16,
 
     out = {"mesh_axes": {"model": tp}, "num_slots": num_slots,
            "decode_chunk": decode_chunk, "prompt_bucket": prompt_bucket,
-           "max_seq_len": cfg.max_seq_len}
+           "max_seq_len": cfg.max_seq_len,
+           "quant": "int8-weights+int8-kv" if quant else None}
 
     # -- decode: the steady-state program (full attend window = worst case)
     t0 = time.perf_counter()
@@ -119,9 +125,16 @@ def compile_candidate(devs, cfg, *, tp, num_slots, decode_chunk=16,
         ppeak + peak - mem.argument_size_in_bytes <= V5E_HBM_BYTES)
 
     # -- analytic breakdown + per-mesh decode roofline -------------------
-    param_bytes = llama.num_params(cfg) * jnp.dtype(cfg.param_dtype).itemsize
+    # int8: projection kernels/unembedding are 1 byte (+ per-channel f32
+    # scales, <0.1%); int8 KV adds a per-(pos, kv_head) f32 scale pair
+    w_itemsize = 1 if quant else jnp.dtype(cfg.param_dtype).itemsize
+    kv_itemsize = 1 if quant else jnp.dtype(cfg.dtype).itemsize
+    param_bytes = llama.num_params(cfg) * w_itemsize
     kv_slot_bytes = (2 * cfg.num_layers * cfg.max_seq_len * cfg.num_kv_heads
-                     * cfg.head_dim * jnp.dtype(cfg.dtype).itemsize)
+                     * cfg.head_dim * kv_itemsize)
+    if quant:
+        kv_slot_bytes += (2 * cfg.num_layers * cfg.max_seq_len
+                          * cfg.num_kv_heads * 4)
     out["weight_bytes_per_chip"] = int(param_bytes / tp)
     out["kv_pool_bytes_per_chip"] = int(kv_slot_bytes * num_slots / tp)
     # decode streams the weight shard once per token-step (batched over all
@@ -157,6 +170,12 @@ def main():
         dict(tp=16, num_slots=64),
         dict(tp=8, num_slots=16),
         dict(tp=4, num_slots=8),
+        # int8 rows (r4 verdict missing #3): weight bytes halve and KV
+        # slots double per GiB -> the same mesh holds 2x the pool, and
+        # the HBM-bound decode roofline roughly doubles
+        dict(tp=16, num_slots=64, quant=True),
+        dict(tp=8, num_slots=32, quant=True),
+        dict(tp=4, num_slots=16, quant=True),
     ]
     if args.fast:
         candidates = candidates[:1]

@@ -78,16 +78,20 @@ def bench_bert(batch: int, seq: int) -> dict:
 
 
 def bench_continuous(batch: int, prompt_len: int, new_tokens: int,
-                     decode_chunk: int) -> dict:
+                     decode_chunk: int, quant: bool = False) -> dict:
     """Continuous-batching load probe: all requests submitted concurrently
     (the equal-batch comparison against bench_decode) plus one straggler
-    arriving mid-decode to measure admission latency + TTFT."""
+    arriving mid-decode to measure admission latency + TTFT.  ``quant``
+    runs the int8 weights+KV engine (llama.quantize_for_serving) — the
+    same programs with int8 HBM residents."""
     from kubeflow_tpu.serving.continuous import ContinuousEngine
 
     cfg = _bench_model()
     model = llamalib.Llama(cfg)
     params = model.init(
         jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32))["params"]
+    if quant:
+        cfg, params = llamalib.quantize_for_serving(cfg, params)
     # one slot beyond the burst so the straggler measures MID-DECODE
     # admission (with num_slots == batch it would measure queue-wait
     # behind the full burst — batch-drain latency, not admission)
@@ -126,7 +130,9 @@ def bench_continuous(batch: int, prompt_len: int, new_tokens: int,
         assert all(len(o) == new_tokens for o in outs)
         ttfts = sorted(r.ttft_s for r in reqs + [straggler])
         return {
-            "metric": "llama_continuous_decode_tokens_per_sec",
+            "metric": ("llama_continuous_int8_decode_tokens_per_sec"
+                       if quant else
+                       "llama_continuous_decode_tokens_per_sec"),
             "model": "271M", "slots": batch, "prompt_len": prompt_len,
             "new_tokens": new_tokens, "decode_chunk": decode_chunk,
             "value": round(batch * new_tokens / dt_burst, 1),
@@ -239,6 +245,9 @@ def main() -> None:
         print(json.dumps(bench_continuous(
             batch=8, prompt_len=128, new_tokens=64, decode_chunk=chunk)),
             flush=True)
+    print(json.dumps(bench_continuous(
+        batch=8, prompt_len=128, new_tokens=64, decode_chunk=16,
+        quant=True)), flush=True)
     # long prompt + few new tokens isolates ADMISSION cost (what the
     # prefix cache removes); with many new tokens the row would mostly
     # measure decode, which prefix reuse cannot and should not change

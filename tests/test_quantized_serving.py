@@ -1,0 +1,179 @@
+"""Int8 serving: weight-only + KV-cache quantization (SURVEY §2.2 — the
+vLLM/Triton quantization family; r4 verdict missing #3).
+
+Decode is HBM-bound, so int8 storage is the TPU-first lever: v5e reads
+half the bytes per token and holds twice the KV slots per GiB.  Parity
+bar (per the verdict): logits within a tolerance, plus a pinned
+greedy-token fixture through the real engine.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from flax import linen as nn
+
+from kubeflow_tpu.models import llama as llamalib
+from kubeflow_tpu.serving.continuous import (
+    ContinuousEngine,
+    apply_serving_quant,
+    build_engine,
+)
+
+PROMPTS = [[1, 2, 3], [4, 5, 6, 7, 8], [9]]
+
+
+def _tiny_with_params(**kw):
+    cfg = llamalib.tiny(**kw)
+    params = nn.meta.unbox(llamalib.Llama(cfg).init(
+        jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32))["params"])
+    return cfg, params
+
+
+class TestQuantizeForServing:
+    def test_weight_tree_is_int8_with_scales(self):
+        cfg, params = _tiny_with_params()
+        qcfg, qp = llamalib.quantize_for_serving(cfg, params)
+        assert qcfg.quant_weights and qcfg.quant_kv
+        wq = qp["layers"]["block"]["attn"]["wq"]
+        assert wq["kernel"].dtype == np.int8
+        # per-output-channel: scale covers (heads, head_dim), stacked [L]
+        assert wq["scale"].shape == (
+            cfg.num_layers, cfg.num_heads, cfg.head_dim)
+        assert qp["head"]["unembedding"].dtype == np.int8
+        assert qp["head"]["unembedding_scale"].shape == (cfg.vocab_size,)
+        # embedding + norms stay full precision
+        assert qp["embedder"]["embedding"].dtype == np.float32
+        assert qp["layers"]["block"]["attn_norm"]["scale"].dtype == np.float32
+
+    def test_logits_close(self):
+        cfg, params = _tiny_with_params()
+        qcfg, qp = llamalib.quantize_for_serving(cfg, params, kv=False)
+        toks = jnp.asarray([[3, 1, 4, 1, 5, 9, 2, 6]], jnp.int32)
+        want = np.asarray(
+            llamalib.Llama(cfg).apply({"params": params}, toks), np.float32)
+        got = np.asarray(
+            llamalib.Llama(qcfg).apply({"params": qp}, toks), np.float32)
+        # per-channel symmetric int8: ~1% relative error at these scales
+        assert np.abs(want - got).max() <= 0.05 * np.abs(want).max()
+
+    def test_dequantization_algebra_exact(self):
+        """y = (x @ w_q) * s must equal x @ (w_q * s): the factored form
+        the Einsum computes is exact algebra, not an approximation."""
+        cfg, params = _tiny_with_params()
+        qcfg, qp = llamalib.quantize_for_serving(cfg, params, kv=False)
+        w8 = np.asarray(qp["layers"]["block"]["mlp"]["w_gate"]["kernel"][0],
+                        np.float32)
+        s = np.asarray(qp["layers"]["block"]["mlp"]["w_gate"]["scale"][0])
+        x = np.random.default_rng(0).normal(
+            size=(3, cfg.hidden_size)).astype(np.float32)
+        left = (x @ w8) * s[None, :]
+        right = x @ (w8 * s[None, :])
+        assert np.allclose(left, right, rtol=1e-5, atol=1e-4)
+
+    def test_unquantized_kv_only(self):
+        cfg, params = _tiny_with_params()
+        qcfg, qp = llamalib.quantize_for_serving(cfg, params, weights=False)
+        assert not qcfg.quant_weights and qcfg.quant_kv
+        assert qp["layers"]["block"]["attn"]["wq"]["kernel"].dtype != np.int8
+
+
+class TestInt8Engine:
+    def test_greedy_token_fixture(self):
+        """Pinned fixture: int8 weights+KV through the real engine emit
+        the SAME greedy tokens as bf16 for these prompts/weights — the
+        verdict's greedy-token-match bar."""
+        cfg, params = _tiny_with_params()
+        ref = ContinuousEngine(cfg, params, num_slots=4, decode_chunk=2,
+                               eos_id=None)
+        try:
+            want = [ref.generate(p, max_new_tokens=5) for p in PROMPTS]
+        finally:
+            ref.stop()
+        qcfg, qp = llamalib.quantize_for_serving(cfg, params)
+        eng = ContinuousEngine(qcfg, qp, num_slots=4, decode_chunk=2,
+                               eos_id=None)
+        try:
+            # pool KV really is int8 (+ f32 scales)
+            dtypes = {str(x.dtype) for x in jax.tree.leaves(eng._pool_cache)}
+            assert "int8" in dtypes and "float32" in dtypes
+            got = [eng.generate(p, max_new_tokens=5) for p in PROMPTS]
+        finally:
+            eng.stop()
+        assert got == want
+
+    def test_tp2_int8_parity_and_shardings(self):
+        cfg, params = _tiny_with_params()
+        qcfg, qp = llamalib.quantize_for_serving(cfg, params)
+        single = ContinuousEngine(qcfg, qp, num_slots=4, decode_chunk=2,
+                                  eos_id=None)
+        try:
+            want = [single.generate(p, max_new_tokens=5) for p in PROMPTS]
+        finally:
+            single.stop()
+        tp = ContinuousEngine(qcfg, qp, num_slots=4, decode_chunk=2,
+                              eos_id=None, mesh_axes={"model": 2})
+        try:
+            wq = tp.params["layers"]["block"]["attn"]["wq"]
+            assert wq["kernel"].dtype == jnp.int8
+            assert len(wq["kernel"].sharding.device_set) == 2
+            # int8-KV scale leaves shard their (LAST) kv_heads dim
+            import jax.tree_util as jtu
+
+            for path, leaf in jtu.tree_leaves_with_path(tp._pool_cache):
+                if "scale" in str(path[-1]):
+                    assert leaf.sharding.spec[-1] == "model"
+            got = [tp.generate(p, max_new_tokens=5) for p in PROMPTS]
+        finally:
+            tp.stop()
+        assert got == want
+
+    def test_build_engine_quant_knobs(self):
+        cfg, params = _tiny_with_params()
+        eng = build_engine(cfg, params, {
+            "num_slots": 2, "decode_chunk": 1, "warmup_groups": [],
+            "quant_weights": True, "quant_kv": True})
+        try:
+            assert eng.cfg.quant_weights and eng.cfg.quant_kv
+            out = eng.generate([1, 2, 3], max_new_tokens=3)
+            assert len(out) == 3
+        finally:
+            eng.stop()
+
+    def test_prefix_cache_still_works_int8(self):
+        """The prefix-admit copy path must handle the int8+scale cache
+        leaves (slot-axis copy over every leaf kind)."""
+        cfg, params = _tiny_with_params()
+        qcfg, qp = llamalib.quantize_for_serving(cfg, params)
+        eng = ContinuousEngine(qcfg, qp, num_slots=2, decode_chunk=1,
+                               eos_id=None, prefix_cache=True, min_prefix=4)
+        try:
+            base = [7, 3, 5, 2, 9, 4, 8, 6]
+            first = eng.generate(base, max_new_tokens=3)
+            again = eng.generate(base, max_new_tokens=3)
+            assert eng.prefix_hits >= 1
+            assert first == again
+        finally:
+            eng.stop()
+
+
+class TestQuantHbmEconomy:
+    def test_cache_bytes_halve(self):
+        """The capacity claim, on the actual pool tree: int8 pool tensor
+        bytes are half the bf16 pool's (scales add <7% back)."""
+        from kubeflow_tpu.serving.continuous import cache_shapes
+
+        # real head_dim: the per-(pos, head) f32 scale adds only
+        # 4/(2*128) = 1.6% of the bf16 bill back
+        cfg = llamalib.tiny(dtype=jnp.bfloat16, head_dim=128)
+        qcfg = dataclasses.replace(cfg, quant_kv=True)
+
+        def nbytes(c):
+            return sum(
+                int(np.prod(s.shape)) * jnp.dtype(s.dtype).itemsize
+                for s in jax.tree.leaves(cache_shapes(c, 8)))
+
+        dense, quant = nbytes(cfg), nbytes(qcfg)
+        assert quant < 0.53 * dense, (quant, dense)
