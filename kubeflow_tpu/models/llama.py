@@ -820,6 +820,11 @@ def quantize_for_serving(
 
     from flax import linen as fnn
 
+    if cfg.moe_experts > 0 and weights:
+        raise ValueError(
+            "int8 weight quantization does not cover MoE expert trees yet "
+            "(MoeMlp owns raw stacked params, not Einsum kernels); serve "
+            "MoE bf16 or pass weights=False for int8 KV only")
     params = fnn.meta.unbox(params)
     qcfg = dataclasses.replace(
         cfg, quant_weights=bool(weights), quant_kv=bool(kv))

@@ -181,6 +181,13 @@ def _ragged_moe(x, idx, gates, w_gate, w_up, w_down, *, dtype,
     )
     if e % max(d, 1):
         raise ValueError(f"{e} experts not divisible by expert axis {d}")
+    if d > 1 and b % d:
+        # tokens batch-shard over the expert axis (GShard convention), so
+        # the shard_map transport needs b % d == 0.  Serving admission
+        # runs batch-1 prefill rows on EP meshes — fall back to the
+        # single-program path there: GSPMD gathers the (tiny-row) expert
+        # weights instead, and the math is identical.
+        d = 1
 
     m_dim = w_up.shape[-1]
     e_local_static = e // max(d, 1)

@@ -78,7 +78,8 @@ def bench_bert(batch: int, seq: int) -> dict:
 
 
 def bench_continuous(batch: int, prompt_len: int, new_tokens: int,
-                     decode_chunk: int, quant: bool = False) -> dict:
+                     decode_chunk: int, quant: bool = False,
+                     moe: bool = False) -> dict:
     """Continuous-batching load probe: all requests submitted concurrently
     (the equal-batch comparison against bench_decode) plus one straggler
     arriving mid-decode to measure admission latency + TTFT.  ``quant``
@@ -87,6 +88,13 @@ def bench_continuous(batch: int, prompt_len: int, new_tokens: int,
     from kubeflow_tpu.serving.continuous import ContinuousEngine
 
     cfg = _bench_model()
+    if moe:
+        # Mixtral-shape-in-miniature: the 271M dense trunk with 8 experts
+        # top-2, dropless dispatch (the serving-exact path)
+        import dataclasses as _dc
+
+        cfg = _dc.replace(cfg, moe_experts=8, moe_top_k=2,
+                          moe_dispatch="ragged")
     model = llamalib.Llama(cfg)
     params = model.init(
         jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32))["params"]
@@ -129,10 +137,13 @@ def bench_continuous(batch: int, prompt_len: int, new_tokens: int,
         straggler.wait(300)
         assert all(len(o) == new_tokens for o in outs)
         ttfts = sorted(r.ttft_s for r in reqs + [straggler])
+        metric = "llama_continuous_decode_tokens_per_sec"
+        if quant:
+            metric = "llama_continuous_int8_decode_tokens_per_sec"
+        if moe:
+            metric = "moe_continuous_decode_tokens_per_sec"
         return {
-            "metric": ("llama_continuous_int8_decode_tokens_per_sec"
-                       if quant else
-                       "llama_continuous_decode_tokens_per_sec"),
+            "metric": metric,
             "model": "271M", "slots": batch, "prompt_len": prompt_len,
             "new_tokens": new_tokens, "decode_chunk": decode_chunk,
             "value": round(batch * new_tokens / dt_burst, 1),
@@ -248,6 +259,9 @@ def main() -> None:
     print(json.dumps(bench_continuous(
         batch=8, prompt_len=128, new_tokens=64, decode_chunk=16,
         quant=True)), flush=True)
+    print(json.dumps(bench_continuous(
+        batch=8, prompt_len=128, new_tokens=64, decode_chunk=16,
+        moe=True)), flush=True)
     # long prompt + few new tokens isolates ADMISSION cost (what the
     # prefix cache removes); with many new tokens the row would mostly
     # measure decode, which prefix reuse cannot and should not change

@@ -1,0 +1,142 @@
+"""MoE serving (SURVEY §2.2 Mixtral-class backend capability, §2.5 EP row
+— r4 verdict missing #4).
+
+The engine is generic over LlamaConfig, so a MoE model serves through
+the SAME slot-pool programs; these tests pin the semantics:
+
+- decode is DROPLESS by construction (one token per step can never
+  exceed expert capacity), so the exact reference is the dropless
+  (ragged) full forward.  Capacity-factor dispatch is a train-time
+  batching artifact: a capacity-cfg PREFILL can drop assignments under
+  routing skew, which is why serving should publish/serve MoE snapshots
+  with ``moe_dispatch="ragged"`` (asserted equivalent here).
+- the train->publish->serve loop closes: ``save_pretrained`` keeps the
+  moe fields, and ``ContinuousLlamaGenerator`` serves the snapshot.
+- an EP x TP serving mesh shards expert weights on ``expert`` and
+  kv/mlp dims on ``model`` with token parity.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from flax import linen as nn
+
+from kubeflow_tpu.models import llama as llamalib
+from kubeflow_tpu.serving.continuous import ContinuousEngine
+
+PROMPTS = [[1, 2, 3], [4, 5, 6, 7, 8], [9]]
+
+
+def _moe(**kw):
+    cfg = llamalib.tiny(moe_experts=4, moe_top_k=2,
+                        moe_dispatch="ragged", **kw)
+    params = nn.meta.unbox(llamalib.Llama(cfg).init(
+        jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32))["params"])
+    return cfg, params
+
+
+def _full_forward_greedy(cfg, params, prompt, n):
+    """Independent reference: no KV cache, no engine — re-forward the
+    whole sequence per token and take the argmax."""
+    model = llamalib.Llama(cfg)
+    toks = list(prompt)
+    for _ in range(n):
+        logits = model.apply(
+            {"params": params}, jnp.asarray([toks], jnp.int32))
+        toks.append(int(np.argmax(np.asarray(logits[0, -1], np.float32))))
+    return toks[len(prompt):]
+
+
+class TestMoeDecodeParity:
+    def test_engine_matches_dropless_full_forward(self):
+        cfg, params = _moe()
+        want = [_full_forward_greedy(cfg, params, p, 5) for p in PROMPTS]
+        eng = ContinuousEngine(cfg, params, num_slots=4, decode_chunk=2,
+                               eos_id=None)
+        try:
+            got = [eng.generate(p, max_new_tokens=5) for p in PROMPTS]
+        finally:
+            eng.stop()
+        assert got == want
+
+    def test_capacity_decode_equals_dropless_decode(self):
+        """At decode shapes nothing can exceed capacity, so the dense
+        (capacity) dispatch and ragged dispatch decode identically —
+        the divergence lives only in full-sequence (train) forwards."""
+        cfg, params = _moe()
+        import dataclasses
+
+        dense_cfg = dataclasses.replace(cfg, moe_dispatch="dense")
+        outs = []
+        for c in (cfg, dense_cfg):
+            eng = ContinuousEngine(c, params, num_slots=4, decode_chunk=2,
+                                   eos_id=None)
+            try:
+                outs.append(
+                    [eng.generate(p, max_new_tokens=5) for p in PROMPTS])
+            finally:
+                eng.stop()
+        assert outs[0] == outs[1]
+
+    def test_ep_tp_mesh_parity_and_shardings(self):
+        cfg, params = _moe()
+        single = ContinuousEngine(cfg, params, num_slots=4, decode_chunk=2,
+                                  eos_id=None)
+        try:
+            want = [single.generate(p, max_new_tokens=5) for p in PROMPTS]
+        finally:
+            single.stop()
+        eng = ContinuousEngine(
+            cfg, params, num_slots=4, decode_chunk=2, eos_id=None,
+            mesh_axes={"expert": 2, "model": 2})
+        try:
+            wg = eng.params["layers"]["block"]["mlp"]["w_gate"]
+            # stacked [L, e, h, m]: experts split over 'expert', mlp dim
+            # over 'model'
+            assert wg.sharding.spec[1] == "expert"
+            assert wg.sharding.spec[-1] == "model"
+            assert len(wg.sharding.device_set) == 4
+            got = [eng.generate(p, max_new_tokens=5) for p in PROMPTS]
+        finally:
+            eng.stop()
+        assert got == want
+
+    def test_int8_weights_rejected_for_moe(self):
+        cfg, params = _moe()
+        with pytest.raises(ValueError, match="MoE"):
+            llamalib.quantize_for_serving(cfg, params)
+        # KV-only int8 composes with MoE
+        qcfg, qp = llamalib.quantize_for_serving(
+            cfg, params, weights=False, kv=True)
+        eng = ContinuousEngine(qcfg, qp, num_slots=2, decode_chunk=2,
+                               eos_id=None)
+        try:
+            out = eng.generate([1, 2, 3], max_new_tokens=3)
+        finally:
+            eng.stop()
+        assert len(out) == 3
+
+
+class TestMoePublishServe:
+    def test_train_publish_serve_loop(self, tmp_path):
+        """The loop the r4 verdict called out as stopping at publish:
+        an MoE snapshot published by save_pretrained serves through
+        ContinuousLlamaGenerator with exact parity."""
+        from kubeflow_tpu.serving.continuous import ContinuousLlamaGenerator
+
+        cfg, params = _moe()
+        snap = str(tmp_path / "moe_snap")
+        llamalib.save_pretrained(snap, cfg, params)
+        cfg2 = llamalib.load_pretrained_config(snap)
+        assert cfg2.moe_experts == 4 and cfg2.moe_dispatch == "ragged"
+        want = [_full_forward_greedy(cfg, params, p, 4) for p in PROMPTS]
+        gen = ContinuousLlamaGenerator("moe", {
+            "storage_path": snap, "num_slots": 4, "decode_chunk": 2,
+            "max_new_tokens": 4, "warmup_groups": []})
+        gen.start()
+        try:
+            got = gen.predict_batch(PROMPTS)
+        finally:
+            gen.stop()
+        assert got == want
