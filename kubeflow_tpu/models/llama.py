@@ -104,6 +104,22 @@ class LlamaConfig:
     #: math the decode path already does.
     quant_weights: bool = False
     quant_kv: bool = False
+    #: LoRA adapters (SURVEY §3.5 — the reference's train() packages
+    #: transformers/peft fine-tuning).  rank > 0 adds low-rank deltas
+    #: ``y += (x @ A) @ B * (alpha/rank)`` to the ``lora_targets``
+    #: projections; the trainer freezes everything else (optax
+    #: multi_transform), so a 7B fine-tune trains <1% of the params and
+    #: publishes MB-scale adapter snapshots (save_adapter) instead of
+    #: full-size ones.  B initializes to zeros: step 0 is exactly the
+    #: base model.
+    lora_rank: int = 0
+    lora_alpha: float = 0.0  # 0 -> alpha = rank (scale 1.0)
+    lora_targets: tuple[str, ...] = ("wq", "wv")
+
+    @property
+    def lora_scale(self) -> float:
+        return (self.lora_alpha or float(self.lora_rank)) / max(
+            self.lora_rank, 1)
 
     @property
     def q_per_kv(self) -> int:
@@ -244,6 +260,46 @@ class Einsum(nn.Module):
     #: the output).  The dot reads int8 bytes from HBM; no bf16 weight
     #: copy exists as a parameter.
     quant: bool = False
+    #: LoRA: rank > 0 adds ``lora_a`` [in..., r] / ``lora_b`` [r, out...]
+    #: and y += ((x @ a) @ b) * lora_scale.  Kept as two rank-r matmuls —
+    #: never materialized into the kernel during training (that would
+    #: erase the memory/FLOP economy adapters exist for).
+    lora_rank: int = 0
+    lora_scale: float = 1.0
+
+    def _lora_delta(self, x: jax.Array, dtype) -> jax.Array:
+        shape = self.shape
+        out_axes = tuple(
+            i for i in range(len(shape)) if i not in self.in_axes)
+        x_sub, rest = self.subscript.split(",")
+        k_sub, out_sub = rest.split("->")
+        used = set(self.subscript) - {",", "-", ">"}
+        r_ch = next(c for c in "zyxwvutq" if c not in used)
+        in_letters = "".join(k_sub[i] for i in self.in_axes)
+        out_letters = "".join(k_sub[i] for i in out_axes)
+        batch_letters = "".join(c for c in out_sub if c not in out_letters)
+        a = self.param(
+            "lora_a",
+            nn.with_logical_partitioning(
+                nn.initializers.normal(stddev=0.02),
+                tuple(self.logical_axes[i] for i in self.in_axes) + ("lora",)),
+            tuple(shape[i] for i in self.in_axes) + (self.lora_rank,),
+            jnp.float32,
+        )
+        b = self.param(
+            "lora_b",
+            nn.with_logical_partitioning(
+                nn.initializers.zeros_init(),
+                ("lora",) + tuple(self.logical_axes[i] for i in out_axes)),
+            (self.lora_rank,) + tuple(shape[i] for i in out_axes),
+            jnp.float32,
+        )
+        mid = jnp.einsum(
+            f"{x_sub},{in_letters}{r_ch}->{batch_letters}{r_ch}",
+            x, a.astype(dtype))
+        return jnp.einsum(
+            f"{batch_letters}{r_ch},{r_ch}{out_letters}->{out_sub}",
+            mid, b.astype(dtype)) * jnp.asarray(self.lora_scale, dtype)
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
@@ -263,16 +319,20 @@ class Einsum(nn.Module):
                 tuple(self.shape[i] for i in out_axes), jnp.float32,
             )
             y = jnp.einsum(self.subscript, x, kernel.astype(self.dtype))
-            return y * scale.astype(self.dtype)
-        init = nn.initializers.variance_scaling(
-            1.0, "fan_in", "truncated_normal",
-            in_axis=self.in_axes, out_axis=out_axes)
-        kernel = self.param(
-            "kernel",
-            nn.with_logical_partitioning(init, self.logical_axes),
-            self.shape, self.param_dtype,
-        )
-        return jnp.einsum(self.subscript, x, kernel.astype(self.dtype))
+            y = y * scale.astype(self.dtype)
+        else:
+            init = nn.initializers.variance_scaling(
+                1.0, "fan_in", "truncated_normal",
+                in_axis=self.in_axes, out_axis=out_axes)
+            kernel = self.param(
+                "kernel",
+                nn.with_logical_partitioning(init, self.logical_axes),
+                self.shape, self.param_dtype,
+            )
+            y = jnp.einsum(self.subscript, x, kernel.astype(self.dtype))
+        if self.lora_rank > 0:
+            y = y + self._lora_delta(x, self.dtype)
+        return y
 
 
 class Attention(nn.Module):
@@ -288,8 +348,14 @@ class Attention(nn.Module):
     @nn.compact
     def __call__(self, x: jax.Array, positions: jax.Array) -> jax.Array:
         cfg = self.cfg
-        proj = partial(Einsum, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
-                       quant=cfg.quant_weights)
+
+        def proj(*args, name: str, **kw):
+            return Einsum(
+                *args, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                quant=cfg.quant_weights,
+                lora_rank=(cfg.lora_rank if name in cfg.lora_targets else 0),
+                lora_scale=cfg.lora_scale, name=name, **kw)
+
         h_dim = x.shape[-1]
         q = proj(
             "bse,ehd->bshd", (h_dim, cfg.num_heads, cfg.head_dim),
@@ -448,8 +514,14 @@ class Mlp(nn.Module):
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
         cfg = self.cfg
-        proj = partial(Einsum, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
-                       quant=cfg.quant_weights)
+
+        def proj(*args, name: str, **kw):
+            return Einsum(
+                *args, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                quant=cfg.quant_weights,
+                lora_rank=(cfg.lora_rank if name in cfg.lora_targets else 0),
+                lora_scale=cfg.lora_scale, name=name, **kw)
+
         h_dim = x.shape[-1]
         gate = proj(
             "bse,em->bsm", (h_dim, cfg.intermediate_size),
@@ -779,6 +851,8 @@ def load_pretrained_config(path: str) -> LlamaConfig:
         d = json.load(f)
     d["dtype"] = jnp.dtype(d["dtype"])
     d["param_dtype"] = jnp.dtype(d["param_dtype"])
+    if "lora_targets" in d:
+        d["lora_targets"] = tuple(d["lora_targets"])  # json round-trip
     return LlamaConfig(**d)
 
 
@@ -799,6 +873,114 @@ def load_pretrained(path: str) -> tuple[LlamaConfig, Any]:
     with open(os.path.join(path, "weights.msgpack"), "rb") as f:
         params = serialization.msgpack_restore(f.read())
     return cfg, params
+
+
+def is_lora_path(path: tuple) -> bool:
+    """True for adapter leaves (flattened-dict path tuples)."""
+    return any(p in ("lora_a", "lora_b") for p in path)
+
+
+def split_lora(params: Any) -> tuple[Any, Any]:
+    """(base, adapters) as flattened-path dicts reassembled into trees —
+    the partition the trainer's freeze mask, adapter-only checkpoints and
+    ``save_adapter`` all share."""
+    from flax import traverse_util
+
+    flat = traverse_util.flatten_dict(params)
+    base = {k: v for k, v in flat.items() if not is_lora_path(k)}
+    lora = {k: v for k, v in flat.items() if is_lora_path(k)}
+    return (traverse_util.unflatten_dict(base),
+            traverse_util.unflatten_dict(lora))
+
+
+def save_adapter(path: str, cfg: LlamaConfig, params: Any) -> None:
+    """Publish ONLY the adapter weights (plus the full config, lora
+    fields included) — the MB-scale artifact that makes LoRA fine-tuning
+    economical: a 7B rank-8 q/v adapter is ~8 MB vs a 13 GiB snapshot."""
+    import json
+    import os
+
+    from flax import serialization
+    from flax import linen as fnn
+
+    _, lora = split_lora(fnn.meta.unbox(params))
+    if not jax.tree.leaves(lora):
+        raise ValueError("save_adapter: params contain no lora_a/lora_b "
+                         "leaves (model has lora_rank == 0?)")
+    os.makedirs(path, exist_ok=True)
+    d = dataclasses.asdict(cfg)
+    d["dtype"] = jnp.dtype(cfg.dtype).name
+    d["param_dtype"] = jnp.dtype(cfg.param_dtype).name
+    with open(os.path.join(path, "config.json"), "w") as f:
+        json.dump(d, f, indent=1)
+    with open(os.path.join(path, "adapter.msgpack"), "wb") as f:
+        f.write(serialization.msgpack_serialize(
+            jax.tree.map(jax.device_get, lora)))
+
+
+def load_adapter(path: str) -> tuple[LlamaConfig, Any]:
+    """(config-with-lora-fields, adapter tree) from ``save_adapter``."""
+    import os
+
+    from flax import serialization
+
+    cfg = load_pretrained_config(path)
+    with open(os.path.join(path, "adapter.msgpack"), "rb") as f:
+        lora = serialization.msgpack_restore(f.read())
+    return cfg, lora
+
+
+def merge_adapter(cfg: LlamaConfig, base_params: Any,
+                  adapters: Any) -> tuple[LlamaConfig, Any]:
+    """Fold adapters into the base weights for serving:
+    ``kernel += reshape(A @ B) * scale`` per adapted projection — after
+    the merge the model is a PLAIN Llama (lora_rank 0) and every serving
+    path (engines, int8 quantization, TP sharding) applies unchanged.
+
+    Relies on the Einsum convention that kernel dims order is
+    [*in_axes, *out_axes] (true for every projection in this file), so
+    the rank-r product reshapes straight onto the kernel.
+    """
+    import numpy as np
+
+    from flax import traverse_util
+
+    if cfg.quant_weights:
+        raise ValueError(
+            "merge_adapter needs an UNQUANTIZED base: adding a "
+            "model-space delta to int8 codes corrupts them — merge "
+            "first, then quantize_for_serving")
+    scale = cfg.lora_scale
+    flat = dict(traverse_util.flatten_dict(base_params))
+    aflat = dict(traverse_util.flatten_dict(adapters))
+    for path, a in aflat.items():
+        if path[-1] != "lora_a":
+            continue
+        mod = path[:-1]
+        b = aflat[mod + ("lora_b",)]
+        kpath = mod + ("kernel",)
+        kernel = np.asarray(jax.device_get(flat[kpath]))
+        if kernel.dtype == np.int8:
+            raise ValueError(
+                f"merge_adapter: base kernel {'/'.join(mod)} is int8 — "
+                "merge before quantizing")
+        a_np = np.asarray(jax.device_get(a), np.float32)
+        b_np = np.asarray(jax.device_get(b), np.float32)
+        r = a_np.shape[-1]
+        if kernel.ndim == a_np.ndim - 1 + b_np.ndim - 1:
+            # unstacked (non-scan) kernel
+            delta = (a_np.reshape(-1, r) @ b_np.reshape(r, -1)).reshape(
+                kernel.shape)
+        else:
+            # scan-stacked: leading layer axis on kernel, a and b alike
+            L = kernel.shape[0]
+            delta = np.einsum(
+                "lir,lro->lio",
+                a_np.reshape(L, -1, r), b_np.reshape(L, r, -1)
+            ).reshape(kernel.shape)
+        flat[kpath] = (kernel + scale * delta).astype(kernel.dtype)
+    merged_cfg = dataclasses.replace(cfg, lora_rank=0, lora_alpha=0.0)
+    return merged_cfg, traverse_util.unflatten_dict(flat)
 
 
 def quantize_for_serving(

@@ -126,6 +126,8 @@ class TrainingClient:
         env: Optional[dict[str, str]] = None,
         mesh: Optional[dict[str, int]] = None,
         model: Optional[str] = None,
+        lora_rank: int = 0,
+        publish_to: Optional[str] = None,
         backoff_limit: int = 0,
         namespace: str = "default",
         wait: bool = True,
@@ -139,9 +141,20 @@ class TrainingClient:
         the trainer resolves it through the storage initializer, takes the
         architecture from the snapshot's config.json, and loads the
         weights before step 0 (train/llm.py KFT_INIT_FROM).
+
+        ``lora_rank``: > 0 trains rank-r LoRA adapters on the snapshot's
+        q/v projections with the base FROZEN (the reference's peft path,
+        SURVEY §3.5) — checkpoints and the published artifact shrink to
+        adapter size.  ``publish_to``: directory the coordinator writes
+        the trained snapshot to (save_adapter under LoRA, save_pretrained
+        otherwise) — the train -> publish -> serve loop's publish step.
         """
         if model:
             env = {**(env or {}), "KFT_INIT_FROM": model}
+        if lora_rank:
+            env = {**(env or {}), "KFT_LORA_RANK": str(int(lora_rank))}
+        if publish_to:
+            env = {**(env or {}), "KFT_PUBLISH_TO": publish_to}
         job = JaxJob(
             metadata=ObjectMeta(name=name, namespace=namespace),
             spec={

@@ -1069,13 +1069,26 @@ def resolve_model_source(config: dict, *, name: str = "model"):
     """(cfg, params) from a serving config's model source — the ONE
     resolution site shared by the in-process generator and every gang
     member (serving/gang.py), so ``params_ref``/``storage_path``
-    semantics cannot drift between placements."""
+    semantics cannot drift between placements.
+
+    ``adapter_path``: a ``save_adapter`` snapshot to merge into the base
+    at load (kernel += A@B * scale) — after the merge the model is plain
+    Llama, so TP sharding and int8 quantization compose unchanged."""
     ref = config.get("params_ref")
     if ref:
-        return fetch_mem(ref[len("mem://"):])
-    if config.get("storage_path"):
-        return llamalib.load_pretrained(config["storage_path"])
-    raise RuntimeError(f"model {name}: need params_ref or storage_uri")
+        cfg, params = fetch_mem(ref[len("mem://"):])
+    elif config.get("storage_path"):
+        cfg, params = llamalib.load_pretrained(config["storage_path"])
+    else:
+        raise RuntimeError(
+            f"model {name}: need params_ref or storage_path (set "
+            "storage_uri on the component spec — the storage initializer "
+            "resolves it to storage_path)")
+    adapter = config.get("adapter_path")
+    if adapter:
+        acfg, adapters = llamalib.load_adapter(adapter)
+        cfg, params = llamalib.merge_adapter(acfg, params, adapters)
+    return cfg, params
 
 
 def apply_serving_quant(cfg, params, config: dict):

@@ -110,6 +110,13 @@ def config_from_env(ctx: "bootstrap.PodContext") -> trainlib.TrainConfig:
     else:
         preset = e.get("KFT_MODEL_PRESET", "tiny")
         model = llamalib.PRESETS[preset]()
+    lora_rank = int(e.get("KFT_LORA_RANK", "0"))
+    if lora_rank > 0:
+        # LoRA fine-tune (SURVEY §3.5 peft path): adapters on the
+        # snapshot's architecture; the trainer freezes the base
+        import dataclasses as _dc
+
+        model = _dc.replace(model, lora_rank=lora_rank)
     ckpt_dir = _pbt_checkpoint_dir(ctx) or e.get("KFT_CKPT_DIR") or None
     steps = int(e.get("KFT_STEPS", "10"))
     if e.get("KFT_PBT_ROOT") and ckpt_dir:
@@ -175,6 +182,31 @@ def train_main(ctx: "bootstrap.PodContext") -> None:
     if ctx.is_coordinator and final is not None:
         bootstrap.emit_metric(ctx, "final_loss", final.loss)
         bootstrap.emit_metric(ctx, "mfu", final.mfu)
+    publish_to = os.environ.get("KFT_PUBLISH_TO")
+    if publish_to and t.final_state is not None:
+        # publish the trained model as a serving snapshot: adapter-only
+        # under LoRA (MB-scale, save_adapter), full save_pretrained
+        # otherwise.  Every process gathers (the collective is global);
+        # only the coordinator writes.
+        from jax.experimental import multihost_utils
+
+        params = t.final_state["params"]
+        if cfg.model.lora_rank > 0:
+            # only the MB-scale adapters publish — gathering the frozen
+            # base would move GBs per host just to throw them away
+            _, params = llamalib.split_lora(params)
+        if ctx.num_processes > 1:
+            params = jax.tree.map(
+                lambda x: multihost_utils.process_allgather(x, tiled=True),
+                params)
+        else:
+            params = jax.device_get(params)
+        if ctx.is_coordinator:
+            if cfg.model.lora_rank > 0:
+                llamalib.save_adapter(publish_to, cfg.model, params)
+            else:
+                llamalib.save_pretrained(publish_to, cfg.model, params)
+            bootstrap.emit_metric(ctx, "published", 1.0)
     # every process syncs before exit so Succeeded means "all ranks done"
     if ctx.num_processes > 1:
         from jax.experimental import multihost_utils

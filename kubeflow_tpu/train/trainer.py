@@ -168,6 +168,28 @@ class Trainer:
             raise ValueError(f"unknown optimizer {cfg.optimizer!r}")
         self.tx = optax.chain(
             optax.clip_by_global_norm(cfg.grad_clip_norm), opt)
+        if cfg.model.lora_rank > 0 and not cfg.init_from:
+            # without a base snapshot the frozen base stays at RANDOM
+            # init forever — the job would "succeed" producing adapters
+            # that are garbage merged onto any real base
+            raise ValueError(
+                "lora_rank > 0 requires init_from: adapters train "
+                "against a frozen base snapshot "
+                "(TrainingClient.train(model=..., lora_rank=...))")
+        if cfg.model.lora_rank > 0:
+            # LoRA freezes the base: adapters get the real optimizer,
+            # everything else set_to_zero (whose state is EMPTY — the
+            # optimizer moments shrink to adapter size, which is the
+            # memory economy adapters exist for).  SURVEY §3.5 peft path.
+            from flax import traverse_util
+
+            def labels(params):
+                return traverse_util.unflatten_dict({
+                    k: ("lora" if llamalib.is_lora_path(k) else "frozen")
+                    for k in traverse_util.flatten_dict(params)})
+
+            self.tx = optax.multi_transform(
+                {"lora": self.tx, "frozen": optax.set_to_zero()}, labels)
         self.batch_sharding = meshlib.batch_sharding(self.mesh)
         self._step_fn = None
         self._abstract_state = None
@@ -177,6 +199,14 @@ class Trainer:
             if cfg.checkpoint_dir
             else None
         )
+        #: LoRA + init_from: checkpoints persist ONLY {step, opt_state,
+        #: adapters} — the base is reloadable from the snapshot, so a 7B
+        #: fine-tune's checkpoint shrinks from 13 GiB of params to the
+        #: MB-scale adapters (+ their moments).
+        self._adapter_ckpt = (
+            cfg.model.lora_rank > 0 and bool(cfg.init_from))
+        #: final state after train() — the publish hook's source
+        self.final_state: Optional[Any] = None
 
     # -- state ------------------------------------------------------------
 
@@ -243,7 +273,28 @@ class Trainer:
             )(jax.random.PRNGKey(seed))
         return nn.meta.unbox(state)
 
-    def _pretrained_params(self, abstract_params: Any) -> Any:
+    def _fresh_adapters(self, lora_abstract: Any) -> Any:
+        """Host-deterministic LoRA init (A ~ normal 0.02, B = 0) placed
+        onto the mesh — every process computes the same values, so no
+        cross-host RNG coordination is needed."""
+        from flax import traverse_util
+
+        rng = np.random.RandomState(0)
+        out = {}
+        for path, sds in sorted(
+                traverse_util.flatten_dict(lora_abstract).items()):
+            if path[-1] == "lora_a":
+                host = rng.normal(0.0, 0.02, size=sds.shape).astype(
+                    np.dtype(sds.dtype))
+            else:
+                host = np.zeros(sds.shape, np.dtype(sds.dtype))
+            out[path] = jax.make_array_from_callback(
+                sds.shape, sds.sharding, lambda idx, h=host: h[idx])
+        return traverse_util.unflatten_dict(out)
+
+    def _pretrained_params(
+        self, abstract_params: Any, adapters: Optional[Any] = None
+    ) -> Any:
         """Snapshot weights placed onto the mesh's param shardings.
 
         Loads host-side once per process and shards via
@@ -251,7 +302,11 @@ class Trainer:
         multi-host: each process materializes only its addressable
         shards).  The snapshot's architecture must match the training
         config — silent shape coercion would "fine-tune" a different
-        model than the one named."""
+        model than the one named.
+
+        With ``cfg.model.lora_rank > 0`` and a base (lora-free) snapshot,
+        the base leaves load from the snapshot and the adapter leaves
+        come from ``adapters`` (a checkpoint's) or fresh init."""
         snap_cfg, loaded = llamalib.load_pretrained(self.cfg.init_from)
         mcfg = self.cfg.model
         for f in ("vocab_size", "hidden_size", "intermediate_size",
@@ -275,18 +330,51 @@ class Trainer:
                 sds.shape, sds.sharding,
                 lambda idx: host[idx].astype(sds.dtype))
 
+        from flax import traverse_util
+
+        snap_has_lora = any(
+            llamalib.is_lora_path(k)
+            for k in traverse_util.flatten_dict(loaded))
         try:
+            if self.cfg.model.lora_rank > 0 and not snap_has_lora:
+                base_abs, lora_abs = llamalib.split_lora(abstract_params)
+                base = jax.tree.map(put, base_abs, loaded)
+                if adapters is None:
+                    adapters = self._fresh_adapters(lora_abs)
+                merged = dict(traverse_util.flatten_dict(base))
+                merged.update(traverse_util.flatten_dict(adapters))
+                return traverse_util.unflatten_dict(merged)
             return jax.tree.map(put, abstract_params, loaded)
         except ValueError as e:
             raise ValueError(
                 f"init_from snapshot {self.cfg.init_from} does not match "
                 f"the model's parameter tree: {e}") from None
 
+    def _to_ckpt(self, state: Any) -> Any:
+        """State as persisted: adapter-only under LoRA fine-tunes."""
+        if not self._adapter_ckpt:
+            return state
+        _, adapters = llamalib.split_lora(state["params"])
+        return {"step": state["step"], "opt_state": state["opt_state"],
+                "adapters": adapters}
+
     def restore_or_init(self, seed: int = 0) -> Any:
         """Resume from the newest checkpoint if one exists — onto the
         CURRENT mesh, whatever topology wrote it (reshape-restore)."""
         if self.ckpt and self.ckpt.latest_step() is not None:
-            return self.ckpt.restore(self.abstract_state())
+            abstract = self.abstract_state()
+            if not self._adapter_ckpt:
+                return self.ckpt.restore(abstract)
+            _, lora_abs = llamalib.split_lora(abstract["params"])
+            restored = self.ckpt.restore({
+                "step": abstract["step"],
+                "opt_state": abstract["opt_state"],
+                "adapters": lora_abs,
+            })
+            params = self._pretrained_params(
+                abstract["params"], adapters=restored["adapters"])
+            return {"step": restored["step"], "params": params,
+                    "opt_state": restored["opt_state"]}
         return self.init_state(seed)
 
     # -- step -------------------------------------------------------------
@@ -578,10 +666,11 @@ class Trainer:
                     if on_metrics:
                         on_metrics(metrics)
                 if self.ckpt:
-                    self.ckpt.save(step + 1, state)
+                    self.ckpt.save(step + 1, self._to_ckpt(state))
                 if self._preempted and self.ckpt:
                     if step + 1 not in self.ckpt.all_steps():
-                        self.ckpt.save(step + 1, state, force=True)
+                        self.ckpt.save(step + 1, self._to_ckpt(state),
+                                       force=True)
                     self.ckpt.wait_until_finished()
                     raise SystemExit(143)
             if profiling:
@@ -593,6 +682,7 @@ class Trainer:
             # orbax force=True still refuses to overwrite an existing step,
             # so skip if the in-loop save already wrote the final step
             if cfg.steps not in self.ckpt.all_steps():
-                self.ckpt.save(cfg.steps, state, force=True)
+                self.ckpt.save(cfg.steps, self._to_ckpt(state), force=True)
             self.ckpt.wait_until_finished()
+        self.final_state = state
         return metrics

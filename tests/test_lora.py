@@ -1,0 +1,249 @@
+"""LoRA fine-tuning (SURVEY §3.5 — the reference train()'s peft path;
+r4 verdict missing #5).
+
+Frozen base + rank-r q/v adapters via optax.multi_transform, adapter-
+only checkpoints + save_adapter snapshots, serve-side merge.  The merge
+bar: a merged plain model must generate the SAME greedy tokens as the
+adapter model.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from flax import linen as nn
+
+from kubeflow_tpu.models import llama as llamalib
+from kubeflow_tpu.train import trainer as trainlib
+
+
+def _base_snapshot(tmp_path, seed=0):
+    cfg = llamalib.tiny()
+    params = nn.meta.unbox(llamalib.Llama(cfg).init(
+        jax.random.PRNGKey(seed), jnp.ones((1, 8), jnp.int32))["params"])
+    path = str(tmp_path / "base")
+    llamalib.save_pretrained(path, cfg, params)
+    return cfg, params, path
+
+
+def _param_sizes(params):
+    from flax import traverse_util
+
+    flat = traverse_util.flatten_dict(params)
+    lora = sum(v.size for k, v in flat.items() if llamalib.is_lora_path(k))
+    total = sum(v.size for v in flat.values())
+    return lora, total
+
+
+class TestLoraModel:
+    def test_zero_init_b_means_base_function(self, tmp_path):
+        """B = 0 at init: the adapter model's step-0 logits ARE the base
+        model's (the property that makes fine-tuning start from the
+        snapshot, not near it)."""
+        import dataclasses
+
+        cfg, params, path = _base_snapshot(tmp_path)
+        lcfg = dataclasses.replace(cfg, lora_rank=8)
+        t = trainlib.Trainer(trainlib.TrainConfig(
+            model=lcfg, steps=1, global_batch=8, seq_len=16,
+            init_from=path))
+        state = t.init_state()
+        toks = jnp.asarray([[3, 1, 4, 1, 5]], jnp.int32)
+        base = llamalib.Llama(cfg).apply({"params": params}, toks)
+        lora = llamalib.Llama(lcfg).apply(
+            {"params": jax.device_get(state["params"])}, toks)
+        assert np.array_equal(np.asarray(base), np.asarray(lora))
+
+    def test_trainable_fraction_under_5pct(self, tmp_path):
+        import dataclasses
+
+        cfg, _, path = _base_snapshot(tmp_path)
+        lcfg = dataclasses.replace(cfg, lora_rank=8)
+        t = trainlib.Trainer(trainlib.TrainConfig(
+            model=lcfg, steps=1, global_batch=8, seq_len=16,
+            init_from=path))
+        lora, total = _param_sizes(t.init_state()["params"])
+        assert 0 < lora < 0.05 * total, (lora, total)
+
+    def test_base_frozen_adapters_move(self, tmp_path):
+        import dataclasses
+
+        cfg, base_params, path = _base_snapshot(tmp_path)
+        lcfg = dataclasses.replace(cfg, lora_rank=4)
+        t = trainlib.Trainer(trainlib.TrainConfig(
+            model=lcfg, steps=3, global_batch=8, seq_len=16,
+            init_from=path, warmup_steps=1, log_every=1))
+        t.train()
+        final = jax.device_get(t.final_state["params"])
+        # base kernels: bit-identical to the snapshot
+        wq = final["layers"]["block"]["attn"]["wq"]
+        assert np.array_equal(
+            np.asarray(wq["kernel"]),
+            np.asarray(base_params["layers"]["block"]["attn"]["wq"]["kernel"]))
+        # adapters: B must have left zero
+        assert np.abs(np.asarray(wq["lora_b"])).max() > 0
+        # non-target projection has no adapters at all
+        assert "lora_a" not in final["layers"]["block"]["attn"]["wo"]
+
+    def test_merge_math_parity(self, tmp_path):
+        """Serve-side merge: merged plain model == adapter model, on
+        logits (tolerance: merged folds the delta into the kernel, so
+        float association differs) AND on greedy tokens (exact)."""
+        import dataclasses
+
+        cfg, _, path = _base_snapshot(tmp_path)
+        lcfg = dataclasses.replace(cfg, lora_rank=4)
+        t = trainlib.Trainer(trainlib.TrainConfig(
+            model=lcfg, steps=3, global_batch=8, seq_len=16,
+            init_from=path, warmup_steps=1))
+        t.train()
+        params = jax.device_get(t.final_state["params"])
+        base, adapters = llamalib.split_lora(params)
+        mcfg, merged = llamalib.merge_adapter(lcfg, base, adapters)
+        assert mcfg.lora_rank == 0
+        toks = jnp.asarray([[3, 1, 4, 1, 5, 9]], jnp.int32)
+        want = np.asarray(llamalib.Llama(lcfg).apply(
+            {"params": params}, toks), np.float32)
+        got = np.asarray(llamalib.Llama(mcfg).apply(
+            {"params": merged}, toks), np.float32)
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+        assert np.array_equal(want.argmax(-1), got.argmax(-1))
+
+
+class TestLoraCheckpointAndPublish:
+    def test_adapter_only_checkpoint_resume(self, tmp_path):
+        """Checkpoints persist {step, opt_state, adapters} only; resume
+        rebuilds the base from init_from and restores the adapters."""
+        import dataclasses
+
+        cfg, base_params, path = _base_snapshot(tmp_path)
+        lcfg = dataclasses.replace(cfg, lora_rank=4)
+        ckpt = str(tmp_path / "ckpt")
+        tc = trainlib.TrainConfig(
+            model=lcfg, steps=2, global_batch=8, seq_len=16,
+            init_from=path, checkpoint_dir=ckpt, save_interval_steps=1,
+            warmup_steps=1)
+        t = trainlib.Trainer(tc)
+        t.train()
+        trained = jax.device_get(t.final_state["params"])
+
+        t2 = trainlib.Trainer(tc)
+        state = t2.restore_or_init()
+        assert int(jax.device_get(state["step"])) == 2
+        restored = jax.device_get(state["params"])
+        wq = restored["layers"]["block"]["attn"]["wq"]
+        assert np.array_equal(
+            np.asarray(wq["lora_b"]),
+            np.asarray(trained["layers"]["block"]["attn"]["wq"]["lora_b"]))
+        assert np.array_equal(
+            np.asarray(wq["kernel"]),
+            np.asarray(base_params["layers"]["block"]["attn"]["wq"]["kernel"]))
+
+    def test_save_adapter_is_small_and_serves_merged(self, tmp_path):
+        """save_adapter writes MB-scale artifacts; the serving config's
+        adapter_path merges at load and the engine serves it."""
+        import dataclasses
+
+        from kubeflow_tpu.serving.continuous import ContinuousLlamaGenerator
+
+        cfg, _, base_path = _base_snapshot(tmp_path)
+        lcfg = dataclasses.replace(cfg, lora_rank=4)
+        t = trainlib.Trainer(trainlib.TrainConfig(
+            model=lcfg, steps=2, global_batch=8, seq_len=16,
+            init_from=base_path, warmup_steps=1))
+        t.train()
+        params = jax.device_get(t.final_state["params"])
+        adapter_path = str(tmp_path / "adapter")
+        llamalib.save_adapter(adapter_path, lcfg, params)
+        base_bytes = os.path.getsize(
+            os.path.join(base_path, "weights.msgpack"))
+        adapter_bytes = os.path.getsize(
+            os.path.join(adapter_path, "adapter.msgpack"))
+        assert adapter_bytes < 0.05 * base_bytes
+
+        want = [t_greedy(lcfg, params, [1, 2, 3], 4)]
+        gen = ContinuousLlamaGenerator("ft", {
+            "storage_path": base_path, "adapter_path": adapter_path,
+            "num_slots": 2, "decode_chunk": 2, "max_new_tokens": 4,
+            "warmup_groups": []})
+        gen.start()
+        try:
+            got = gen.predict_batch([[1, 2, 3]])
+        finally:
+            gen.stop()
+        assert got == want
+
+
+def t_greedy(cfg, params, prompt, n):
+    model = llamalib.Llama(cfg)
+    toks = list(prompt)
+    for _ in range(n):
+        logits = model.apply(
+            {"params": params}, jnp.asarray([toks], jnp.int32))
+        toks.append(int(np.argmax(np.asarray(logits[0, -1], np.float32))))
+    return toks[len(prompt):]
+
+
+@pytest.mark.e2e
+class TestLoraE2E:
+    def test_two_worker_lora_finetune_publish_serve(self, tmp_path):
+        """The verdict's e2e: pretrain -> publish base ->
+        TrainingClient.train(model=..., lora_rank=8) as a 2-worker gang
+        (loss continues from the converged base, FAR below scratch ~5.55
+        — proof the frozen base loaded) -> adapter published -> served
+        merged."""
+        import re
+
+        from kubeflow_tpu.api.common import JobConditionType, has_condition
+        from kubeflow_tpu.runtime.platform import LocalPlatform
+        from kubeflow_tpu.sdk import TrainingClient
+        from kubeflow_tpu.serving.continuous import ContinuousLlamaGenerator
+
+        # pretrain in-process to convergence, publish the base
+        cfg = llamalib.tiny()
+        pre = trainlib.Trainer(trainlib.TrainConfig(
+            model=cfg, steps=80, learning_rate=1e-2, global_batch=8,
+            seq_len=32, warmup_steps=5, log_every=20))
+        final = pre.train()
+        assert final.loss < 3.0, f"pretrain did not converge: {final.loss}"
+        base_path = str(tmp_path / "base")
+        llamalib.save_pretrained(
+            base_path, cfg, jax.device_get(pre.final_state["params"]))
+
+        adapter_pub = str(tmp_path / "published_adapter")
+        with LocalPlatform(num_hosts=2, chips_per_host=4,
+                           root_dir=str(tmp_path / "plat")) as p:
+            client = TrainingClient(p)
+            job = client.train(
+                name="lora-ft", entrypoint="kubeflow_tpu.train.llm:train_main",
+                num_workers=2, model=f"file://{base_path}", lora_rank=8,
+                publish_to=adapter_pub,
+                env={"KFT_STEPS": "4", "KFT_BATCH": "8",
+                     "KFT_SEQ_LEN": "32", "KFT_LOG_EVERY": "1",
+                     "KFT_LR": "1e-4"},
+                timeout=420.0)
+            assert has_condition(
+                job.status.conditions, JobConditionType.SUCCEEDED)
+            log = client.get_job_logs("lora-ft")["lora-ft-worker-0"]
+        losses = [float(m) for m in re.findall(r"loss=([0-9.]+)", log)]
+        assert losses, log
+        # scratch starts at ~ln(256)=5.55; the frozen base left off <3
+        assert losses[0] < 3.5, losses
+        # the published artifact is the ADAPTER, not a full snapshot
+        assert os.path.exists(os.path.join(adapter_pub, "adapter.msgpack"))
+        assert not os.path.exists(
+            os.path.join(adapter_pub, "weights.msgpack"))
+
+        # serve base + published adapter, merged at load
+        gen = ContinuousLlamaGenerator("ft", {
+            "storage_path": base_path, "adapter_path": adapter_pub,
+            "num_slots": 2, "decode_chunk": 2, "max_new_tokens": 4,
+            "warmup_groups": []})
+        gen.start()
+        try:
+            out = gen.predict_batch([[1, 2, 3]])
+        finally:
+            gen.stop()
+        assert len(out[0]) == 4
