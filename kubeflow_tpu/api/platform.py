@@ -35,6 +35,10 @@ class ProfileSpec(_Model):
     #: hard caps for the profile's namespace, enforced gang-atomically by
     #: the scheduler: {"cpu": ..., "memory_gb": ..., "tpu": ...}
     resource_quota: dict[str, float] = Field(default_factory=dict)
+    #: bearer token authenticating AS this profile on the REST API —
+    #: mutations scope to the profile's namespace (apiserver authz;
+    #: the reference's Profile RBAC binding analog)
+    api_token: Optional[str] = None
 
 
 class ProfileStatus(_Model):

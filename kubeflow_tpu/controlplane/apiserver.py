@@ -24,16 +24,19 @@ Routes (JSON bodies; YAML accepted on writes):
 Error mapping follows the apiserver conventions: 404 NotFound, 409
 AlreadyExists/Conflict, 422 admission-rejected.
 
-Authn (an explicit scoping decision, not an accident): the server
-supports ONE cluster-admin bearer token (``token=`` / $KFT_API_TOKEN) —
-every route except ``/healthz`` requires ``Authorization: Bearer <t>``
-when set, else 401 Unauthorized.  That is the whole story by design:
-the reference's RBAC lives in kube-apiserver + Profile-namespace
-bindings; here Profiles (ux/profiles.py) own namespace quotas while the
-HTTP surface is flat admin — per-user tokens/RBAC would need an
-identity provider this environment doesn't have, so the boundary is
-"one platform-admin credential", stated rather than implied.  Default
-(no token) preserves the open local-dev surface.
+Authn/authz: a cluster-admin bearer token (``token=`` / $KFT_API_TOKEN)
+plus PER-PROFILE tokens (``profile_tokens=`` / $KFT_API_TOKENS
+"alice=t1,bob=t2" / ``Profile.spec.api_token``) — the reference's
+Profile-controller multi-tenancy [upstream: kubeflow/kubeflow ->
+profile-controller RBAC bindings; SURVEY §2.4] mapped onto this plane:
+a profile token authenticates as that profile, whose name IS its tenant
+namespace (ux/profiles.py), and mutating routes (POST/PUT/DELETE) are
+scoped to that namespace — 403 Forbidden elsewhere, which also stops
+tenants from editing Profile/PodDefault objects (those live in
+kft-profiles).  Reads stay cluster-wide (the dashboard surface).  With
+any token configured, every route except ``/healthz`` requires
+``Authorization: Bearer <t>``, else 401.  Default (nothing configured)
+preserves the open local-dev surface.
 """
 
 from __future__ import annotations
@@ -92,7 +95,8 @@ class ApiServer:
 
     def __init__(self, store: Store, port: Optional[int] = None,
                  log_path_for: Optional[Callable[[str, str], str]] = None,
-                 token: Optional[str] = None):
+                 token: Optional[str] = None,
+                 profile_tokens: Optional[dict[str, str]] = None):
         import os
 
         self.store = store
@@ -100,6 +104,14 @@ class ApiServer:
         self.port = port or allocate_port()
         self.token = token if token is not None else os.environ.get(
             "KFT_API_TOKEN") or None
+        #: profile name -> bearer token (per-tenant identity; also fed by
+        #: Profile.spec.api_token).  $KFT_API_TOKENS: "alice=t1,bob=t2".
+        self.profile_tokens = dict(profile_tokens or {})
+        env_tokens = os.environ.get("KFT_API_TOKENS", "")
+        for pair in env_tokens.split(","):
+            name, _, tok = pair.strip().partition("=")
+            if name and tok:
+                self.profile_tokens.setdefault(name, tok)
         api = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -175,22 +187,55 @@ class ApiServer:
 
     # -- request handling --------------------------------------------------
 
+    #: authenticated-as-cluster-admin sentinel (single-token mode, or no
+    #: authn configured at all — the open local-dev surface)
+    ADMIN = "__cluster_admin__"
+
+    def _profile_object_tokens(self) -> dict[str, str]:
+        """Profile.spec.api_token credentials (the object-driven half of
+        per-profile identity; env/ctor tokens need no store objects)."""
+        out = {}
+        try:
+            for prof in self.store.list("Profile"):
+                tok = getattr(prof.spec, "api_token", None)
+                if tok:
+                    out[prof.metadata.name] = tok
+        except KeyError:
+            pass
+        return out
+
+    def _authenticate(self, h) -> Optional[str]:
+        """Identity for this request: ADMIN, a profile name (== the
+        tenant namespace the identity may mutate), or None (rejected).
+        Constant-time compares throughout — a plain != short-circuits at
+        the first differing byte, a timing oracle on the credential."""
+        import hmac
+
+        tenant_tokens = dict(self.profile_tokens)
+        tenant_tokens.update(self._profile_object_tokens())
+        if not self.token and not tenant_tokens:
+            return self.ADMIN  # no authn configured: open local dev
+        got = h.headers.get("Authorization", "")
+        if self.token and hmac.compare_digest(got, f"Bearer {self.token}"):
+            return self.ADMIN
+        for name, tok in sorted(tenant_tokens.items()):
+            if hmac.compare_digest(got, f"Bearer {tok}"):
+                return name
+        return None
+
     def _handle(self, h, method: str) -> None:
         # errors carry a structured ``reason`` (kube-apiserver Status.reason
         # analog) so clients branch on it, never on message text — substring
         # matching misclassified a 422 whose message contained "exists"
-        if self.token and urlparse(h.path).path != "/healthz":
-            import hmac
-
-            got = h.headers.get("Authorization", "")
-            # constant-time compare: a plain != short-circuits at the
-            # first differing byte — a timing oracle on the credential
-            if not hmac.compare_digest(got, f"Bearer {self.token}"):
+        identity = self.ADMIN
+        if urlparse(h.path).path != "/healthz":
+            identity = self._authenticate(h)
+            if identity is None:
                 h._send(401, {"error": "missing or invalid bearer token",
                               "reason": "Unauthorized"})
                 return
         try:
-            self._route(h, method)
+            self._route(h, method, identity)
         except NotFound as e:
             h._send(404, {"error": str(e), "reason": "NotFound"})
         except AlreadyExists as e:
@@ -296,10 +341,24 @@ class ApiServer:
             ],
         })
 
-    def _route(self, h, method: str) -> None:
+    def _route(self, h, method: str, identity: Optional[str] = None) -> None:
+        identity = self.ADMIN if identity is None else identity
         u = urlparse(h.path)
         parts = [p for p in u.path.split("/") if p]
         q = parse_qs(u.query)
+
+        def forbidden(ns: str) -> bool:
+            """Mutations scope to the identity's tenant namespace (a
+            Profile's name IS its namespace, ux/profiles.py) — this also
+            blocks tenants from mutating Profiles/PodDefaults themselves,
+            which live in the kft-profiles namespace."""
+            if identity != self.ADMIN and ns != identity:
+                h._send(403, {
+                    "error": f"profile {identity!r} may not modify "
+                             f"namespace {ns!r}",
+                    "reason": "Forbidden"})
+                return True
+            return False
         if u.path == "/healthz":
             h._send(200, {"ok": True})
             return
@@ -314,7 +373,10 @@ class ApiServer:
             if method == "POST":
                 manifest = h._body()
                 manifest.setdefault("kind", kind)
-                created = self.store.create(from_dict(manifest))
+                obj = from_dict(manifest)
+                if forbidden(obj.metadata.namespace):
+                    return
+                created = self.store.create(obj)
                 h._send(201, to_dict(created))
                 return
             ns = q.get("namespace", [None])[0]
@@ -357,6 +419,8 @@ class ApiServer:
             h._send(200, to_dict(self.store.get(kind, name, ns)))
             return
         if method == "PUT":
+            if forbidden(ns):
+                return
             manifest = h._body()
             manifest.setdefault("kind", kind)
             obj = from_dict(manifest)
@@ -364,6 +428,8 @@ class ApiServer:
             h._send(200, to_dict(self.store.update(obj)))
             return
         if method == "DELETE":
+            if forbidden(ns):
+                return
             self.store.delete(kind, name, ns)
             h._send(200, {"deleted": f"{kind}/{ns}/{name}"})
             return

@@ -103,16 +103,20 @@ class Cluster:
         self.add_controller(ProfileController(self.store))
         self.add_controller(NotebookController(self.store))
 
-    def serve_api(self, port: int = 0, token: "str | None" = None) -> str:
+    def serve_api(self, port: int = 0, token: "str | None" = None,
+                  profile_tokens: "dict[str, str] | None" = None) -> str:
         """Start the REST API server (kube-apiserver analog) over this
         cluster's store; returns its URL for the kft CLI ($KFT_SERVER).
         Stopped with the cluster.  ``token`` (or $KFT_API_TOKEN) turns on
-        bearer-token authn — the documented single-admin-credential
-        scoping (apiserver.py module docstring)."""
+        admin bearer-token authn; ``profile_tokens`` (or $KFT_API_TOKENS,
+        or Profile.spec.api_token) adds per-tenant identities whose
+        mutations scope to their profile namespace (apiserver.py
+        docstring)."""
         from .apiserver import ApiServer
 
         self._apiserver = ApiServer(
             self.store, port=port or None,
+            profile_tokens=profile_tokens,
             log_path_for=getattr(self, "_log_path_for", None),
             token=token)
         return self._apiserver.url
