@@ -346,7 +346,8 @@ class Attention(nn.Module):
     decode_attend_len: Optional[int] = None
 
     @nn.compact
-    def __call__(self, x: jax.Array, positions: jax.Array) -> jax.Array:
+    def __call__(self, x: jax.Array, positions: jax.Array,
+                 prefix=None, cache_positions=None) -> jax.Array:
         cfg = self.cfg
 
         def proj(*args, name: str, **kw):
@@ -373,7 +374,9 @@ class Attention(nn.Module):
         v = nn.with_logical_constraint(v, ("batch", "act_seq", "act_kv_heads", "head_dim"))
 
         if self.decode:
-            out = self._decode_attend(q, k, v, positions)
+            out = self._decode_attend(q, k, v, positions,
+                                      prefix=prefix,
+                                      cache_positions=cache_positions)
         elif cfg.attention_impl == "ring":
             out = ringlib.ring_attention(
                 q, k, v, axis_name="seq", q_per_kv=cfg.q_per_kv
@@ -389,7 +392,8 @@ class Attention(nn.Module):
             "bshd,hde->bse", (cfg.num_heads, cfg.head_dim, h_dim),
             ("heads", "head_dim", "embed"), in_axes=(0, 1), name="wo")(out)
 
-    def _decode_attend(self, q, k, v, positions):
+    def _decode_attend(self, q, k, v, positions, prefix=None,
+                       cache_positions=None):
         """Decode against a mutable KV cache with PER-ROW positions.
 
         Flax 'cache' collection: cached_key/value are [batch, max_seq, kv,
@@ -400,9 +404,22 @@ class Attention(nn.Module):
         makes RAGGED batches sound: rows pad to a shared bucket, pad-slot
         junk sits at positions greater than the row's live front, where the
         mask hides it until a real decode write overwrites it.
+
+        SHARED-PREFIX mode (serving/prefix_sharing.py): ``prefix`` =
+        (pk, pv, plen) — per-row KV of an IMMUTABLE shared segment
+        holding global positions [0, plen), already roped at those
+        positions.  The row's own cache then stores only its suffix at
+        SLOT-LOCAL index ``cache_positions = positions - plen`` (rope and
+        causal order stay global).  Attention is ONE softmax over
+        [segment ; private] — logits concatenate along the key axis, so
+        the math is exactly full-sequence attention, not an approximate
+        merge.
         """
         cfg = self.cfg
         batch, sc = q.shape[0], q.shape[1]
+        if cache_positions is None:
+            cache_positions = positions
+        cache_positions = jnp.broadcast_to(cache_positions, (batch, sc))
         kv_dtype = jnp.int8 if cfg.quant_kv else cfg.dtype
         cached_k = self.variable(
             "cache", "cached_key",
@@ -447,22 +464,22 @@ class Attention(nn.Module):
 
             kq, ks = quantize(k)
             vq, vs = quantize(v)
-            cached_k.value = cached_k.value.at[rows, positions].set(
+            cached_k.value = cached_k.value.at[rows, cache_positions].set(
                 kq, mode="drop")
-            cached_v.value = cached_v.value.at[rows, positions].set(
+            cached_v.value = cached_v.value.at[rows, cache_positions].set(
                 vq, mode="drop")
             heads_ix = jnp.arange(cfg.num_kv_heads, dtype=jnp.int32)[
                 None, None, :]
             k_scale.value = k_scale.value.at[
-                rows[:, :, None], heads_ix, positions[:, :, None]].set(
-                ks, mode="drop")
+                rows[:, :, None], heads_ix,
+                cache_positions[:, :, None]].set(ks, mode="drop")
             v_scale.value = v_scale.value.at[
-                rows[:, :, None], heads_ix, positions[:, :, None]].set(
-                vs, mode="drop")
+                rows[:, :, None], heads_ix,
+                cache_positions[:, :, None]].set(vs, mode="drop")
         else:
-            cached_k.value = cached_k.value.at[rows, positions].set(
+            cached_k.value = cached_k.value.at[rows, cache_positions].set(
                 k.astype(cfg.dtype), mode="drop")
-            cached_v.value = cached_v.value.at[rows, positions].set(
+            cached_v.value = cached_v.value.at[rows, cache_positions].set(
                 v.astype(cfg.dtype), mode="drop")
         idx.value = idx.value + sc  # legacy cursor, informational only
         # static slice to the live front: the decode step streams the
@@ -480,12 +497,36 @@ class Attention(nn.Module):
             kf = cached_k.value[:, :attend]
             vf = cached_v.value[:, :attend]
         qh = q.reshape(batch, sc, cfg.num_kv_heads, cfg.q_per_kv, cfg.head_dim)
-        logits = jnp.einsum("bqkgh,bskh->bkgqs", qh.astype(jnp.float32), kf.astype(jnp.float32))
-        logits = logits / jnp.sqrt(cfg.head_dim).astype(jnp.float32)
-        # per-row per-query causal mask over cache slots
+        qf = qh.astype(jnp.float32)
+        logits = jnp.einsum("bqkgh,bskh->bkgqs", qf, kf.astype(jnp.float32))
+        # per-row per-query causal mask over the PRIVATE cache: slot-local
+        # index i holds global position plen + i, so i <= local_pos is
+        # exactly global causality
         valid = (jnp.arange(attend)[None, None, :]
-                 <= positions[:, :, None])  # [b, q, s]
+                 <= cache_positions[:, :, None])  # [b, q, s]
         logits = jnp.where(valid[:, None, None, :, :], logits, -1e30)
+        if prefix is not None:
+            pk, pv, plen = prefix
+            pkf = pk.astype(jnp.float32)
+            pvf = pv.astype(jnp.float32)
+            plogits = jnp.einsum("bqkgh,bskh->bkgqs", qf, pkf)
+            # the whole live prefix precedes every query position
+            pvalid = (jnp.arange(pk.shape[1])[None, :]
+                      < plen[:, None])  # [b, sp]
+            plogits = jnp.where(
+                pvalid[:, None, None, None, :], plogits, -1e30)
+            # ONE softmax over [segment ; private] — exact full-sequence
+            # attention, keys merely live in two buffers
+            cat = jnp.concatenate([plogits, logits], axis=-1)
+            cat = cat / jnp.sqrt(cfg.head_dim).astype(jnp.float32)
+            probs = jax.nn.softmax(cat, axis=-1)
+            sp = pk.shape[1]
+            out = (jnp.einsum("bkgqs,bskh->bqkgh", probs[..., :sp], pvf)
+                   + jnp.einsum("bkgqs,bskh->bqkgh", probs[..., sp:],
+                                vf.astype(jnp.float32)))
+            return out.reshape(
+                batch, sc, cfg.num_heads, cfg.head_dim).astype(cfg.dtype)
+        logits = logits / jnp.sqrt(cfg.head_dim).astype(jnp.float32)
         probs = jax.nn.softmax(logits, axis=-1)
         out = jnp.einsum("bkgqs,bskh->bqkgh", probs, vf.astype(jnp.float32))
         return out.reshape(batch, sc, cfg.num_heads, cfg.head_dim).astype(cfg.dtype)
@@ -552,12 +593,14 @@ class Block(nn.Module):
     decode_attend_len: Optional[int] = None
 
     @nn.compact
-    def __call__(self, x: jax.Array, positions: jax.Array):
+    def __call__(self, x: jax.Array, positions: jax.Array,
+                 prefix=None, cache_positions=None):
         cfg = self.cfg
         x = nn.with_logical_constraint(x, ("batch", "act_seq", "act_embed"))
         h = RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="attn_norm")(x)
         x = x + Attention(cfg, self.decode, self.decode_attend_len,
-                          name="attn")(h, positions)
+                          name="attn")(h, positions, prefix,
+                                       cache_positions)
         h = RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="mlp_norm")(x)
         if cfg.moe_experts > 0:
             from .moe import MoeMlp
@@ -580,6 +623,24 @@ class _ScanBlock(nn.Module):
     def __call__(self, x, positions):
         return Block(self.cfg, self.decode, self.decode_attend_len,
                      name="block")(x, positions), None
+
+
+class _ScanBlockPrefix(nn.Module):
+    """_ScanBlock variant with shared-prefix args: pk/pv scan over their
+    leading LAYER axis (each block attends its own layer's segment KV);
+    plen/cache_positions broadcast.  Same "block" module name, so the
+    param tree is identical to _ScanBlock's — one set of weights serves
+    both call shapes."""
+
+    cfg: LlamaConfig
+    decode: bool = False
+    decode_attend_len: Optional[int] = None
+
+    @nn.compact
+    def __call__(self, x, positions, pk, pv, plen, cache_positions):
+        return Block(self.cfg, self.decode, self.decode_attend_len,
+                     name="block")(
+            x, positions, (pk, pv, plen), cache_positions), None
 
 
 class Embedder(nn.Module):
@@ -684,6 +745,8 @@ class Llama(nn.Module):
         positions: Optional[jax.Array] = None,
         *,
         decode: bool = False,
+        prefix=None,
+        cache_positions=None,
     ) -> jax.Array:
         cfg = self.cfg
         if positions is None:
@@ -695,7 +758,22 @@ class Llama(nn.Module):
         if cfg.remat:
             block_cls = nn.remat(
                 Block, policy=remat_policy(cfg), prevent_cse=False)
-        if cfg.scan_layers:
+        if cfg.scan_layers and prefix is not None:
+            # shared-prefix decode: pk/pv carry a leading layer axis and
+            # scan WITH the blocks; everything else broadcasts
+            pk, pv, plen = prefix
+            if cache_positions is None:
+                cache_positions = positions
+            x, _ = nn.scan(
+                _ScanBlockPrefix,
+                variable_axes={"params": 0, "cache": 0, "intermediates": 0},
+                split_rngs={"params": True},
+                in_axes=(nn.broadcast, 0, 0, nn.broadcast, nn.broadcast),
+                length=cfg.num_layers,
+                metadata_params={nn.PARTITION_NAME: "layers"},
+            )(cfg, decode, self.decode_attend_len, name="layers")(
+                x, positions, pk, pv, plen, cache_positions)
+        elif cfg.scan_layers:
             scan_cls = _ScanBlock
             if cfg.remat:
                 scan_cls = nn.remat(
@@ -712,8 +790,13 @@ class Llama(nn.Module):
             )(cfg, decode, self.decode_attend_len, name="layers")(x, positions)
         else:
             for i in range(cfg.num_layers):
+                lp = None
+                if prefix is not None:
+                    pk, pv, plen = prefix
+                    lp = (pk[i], pv[i], plen)
                 x = block_cls(cfg, decode, self.decode_attend_len,
-                              name=f"layer_{i}")(x, positions)
+                              name=f"layer_{i}")(x, positions, lp,
+                                                 cache_positions)
 
         table = embedder.table() if cfg.tie_embeddings else None
         return Head(cfg, name="head")(x, table)

@@ -97,6 +97,18 @@ class Request:
         return self.first_token_at - self.submitted_at
 
 
+def _lcp(content: list[int], prompt_arr: np.ndarray, cap: int) -> int:
+    """Longest common prefix of ``content`` and the prompt (as int64
+    array), capped — vectorized: this runs per segment/slot per
+    admission on the scheduler thread."""
+    n = min(len(content), cap)
+    if n <= 0:
+        return 0
+    c = np.asarray(content[:n], np.int64)
+    neq = np.nonzero(c != prompt_arr[:n])[0]
+    return int(neq[0]) if neq.size else n
+
+
 def cache_shapes(cfg: llamalib.LlamaConfig, batch: int):
     """Abstract KV-cache pytree for a ``batch``-row cache (eval_shape — no
     allocation, no dispatch)."""
@@ -216,6 +228,98 @@ def make_prefix_admit_program(cfg, attend: int, suffix_bucket: int,
     return shardedlib.mesh_jit(mesh, admit, donate_argnums=(1, 2))
 
 
+def _seg_kv(seg_cache):
+    """(pk, pv) leaves of a segment-pool cache tree (scan layout:
+    [L, n_seg, S_seg, KV, D])."""
+    attn = seg_cache["layers"]["block"]["attn"]
+    return attn["cached_key"], attn["cached_value"]
+
+
+def make_suffix_admit_program(cfg, attend: int, seg_att: int,
+                              suffix_bucket: int, mesh=None):
+    """Admission AGAINST SHARED SEGMENTS: run only the suffix forwards,
+    attending the (immutable) segment KV gathered per row — the slots'
+    private caches store suffixes at SLOT-LOCAL positions, so slots can
+    be far shorter than prompt+response (the paged-KV capacity economy,
+    SURVEY §2.2; design note in llama._decode_attend).
+
+    BATCHED like the legacy prefill (a burst of N same-prefix requests
+    costs 2 dispatches, not 2N — the admission docstring's rule holds):
+    (params, seg_cache, toks [g, bucket], seg_ids [g], plens [g],
+    slens [g]) -> (last_logits [g, v], row_cache) — feeds the engine's
+    existing merge.  Rows with plen == 0 (group padding) attend nothing
+    of the segment.
+    """
+    wmodel = llamalib.Llama(cfg, decode_attend_len=attend)
+
+    def admit(params, seg_cache, toks, seg_ids, plens, slens):
+        g = toks.shape[0]
+        pk, pv = _seg_kv(seg_cache)
+        pk = jnp.take(pk, seg_ids, axis=1)[:, :, :seg_att]  # [L,g,sa,KV,D]
+        pv = jnp.take(pv, seg_ids, axis=1)[:, :, :seg_att]
+        cache = jax.tree.map(
+            lambda s: jnp.zeros(s.shape, s.dtype), cache_shapes(cfg, g))
+        ar = jnp.arange(suffix_bucket, dtype=jnp.int32)
+        gpos = plens[:, None] + ar[None, :]
+        lpos = jnp.broadcast_to(ar[None, :], (g, suffix_bucket))
+        logits_all, mutated = wmodel.apply(
+            {"params": params, "cache": cache}, toks, gpos,
+            decode=True, prefix=(pk, pv, plens.astype(jnp.int32)),
+            cache_positions=lpos, mutable=["cache"])
+        last = jnp.take_along_axis(
+            logits_all, (slens - 1)[:, None, None], axis=1)[:, 0]
+        return (shardedlib.constrain_logits(last, mesh),
+                shardedlib.constrain_cache(mutated["cache"], mesh))
+
+    return shardedlib.mesh_jit(mesh, admit)
+
+
+def make_prefix_decode_program(cfg, attend: int, seg_att: int, chunk: int,
+                               mesh=None):
+    """``chunk`` sampling steps for the whole pool where slots may attend
+    a shared segment: per-slot (seg_id, plen) gather the segment KV once
+    per dispatch; private cache positions are slot-local (= global -
+    plen), so the pool's rows hold only suffixes.  Rows with plen == 0
+    behave exactly as the plain decode program (empty segment masked
+    out)."""
+    wmodel = llamalib.Llama(cfg, decode_attend_len=attend)
+
+    def decode(params, cache, logits, seg_cache, positions, plens,
+               seg_ids, active, temps, key):
+        # positions are SLOT-LOCAL; the sentinel (max_seq_len) drops
+        # writes exactly as in the plain program
+        safe = jnp.where(active, positions, cfg.max_seq_len)
+        pk, pv = _seg_kv(seg_cache)
+        pk = jnp.take(pk, seg_ids, axis=1)[:, :, :seg_att]  # [L,b,sa,KV,D]
+        pv = jnp.take(pv, seg_ids, axis=1)[:, :, :seg_att]
+
+        def step(carry, key):
+            cache, logits, lpos = carry
+            greedy = jnp.argmax(logits, axis=-1)
+            sampled = jax.random.categorical(
+                key,
+                logits.astype(jnp.float32)
+                / jnp.maximum(temps, 1e-6)[:, None],
+                axis=-1)
+            tok = jnp.where(temps > 0, sampled, greedy).astype(jnp.int32)
+            gpos = lpos + plens  # rope/causality are global
+            l, mutated = wmodel.apply(
+                {"params": params, "cache": cache}, tok[:, None],
+                gpos[:, None], decode=True, prefix=(pk, pv, plens),
+                cache_positions=lpos[:, None], mutable=["cache"])
+            nxt = jnp.where(active, lpos + 1, cfg.max_seq_len)
+            return (shardedlib.constrain_cache(mutated["cache"], mesh),
+                    shardedlib.constrain_logits(l[:, -1, :], mesh),
+                    nxt), tok
+
+        keys = jax.random.split(key, chunk)
+        (cache, logits, lpos), toks = jax.lax.scan(
+            step, (cache, logits, safe), keys)
+        return cache, logits, shardedlib.constrain_replicated(toks.T, mesh)
+
+    return shardedlib.mesh_jit(mesh, decode, donate_argnums=(1, 2))
+
+
 def make_decode_program(cfg, attend: int, chunk: int, mesh=None):
     """``chunk`` sampling steps for the whole slot pool in one program,
     attending only over cache slots [0, attend).
@@ -302,6 +406,8 @@ class ContinuousEngine:
         mesh_axes: Optional[dict[str, int]] = None,
         prefix_cache: bool = True,
         min_prefix: int = 32,
+        prefix_segments: int = 0,
+        segment_len: int = 0,
     ):
         if num_slots < 1:
             raise ValueError("num_slots must be >= 1")
@@ -323,6 +429,14 @@ class ContinuousEngine:
         self.params = params
         self.num_slots = num_slots
         self.decode_chunk = decode_chunk
+        self.prefix_segments = int(prefix_segments)
+        self.segment_len = int(segment_len)
+        if self.prefix_segments > 0:
+            if self.segment_len <= 0:
+                raise ValueError("prefix_segments needs segment_len > 0")
+            if not cfg.scan_layers:
+                raise ValueError(
+                    "shared-prefix segments require scan_layers=True")
         self.temperature = float(temperature)
         self.eos_id = eos_id
         self.default_max_new_tokens = default_max_new_tokens
@@ -354,6 +468,24 @@ class ContinuousEngine:
         self._slots: list[Optional[Request]] = [None] * num_slots
         self.prefix_cache = prefix_cache
         self.min_prefix = int(min_prefix)
+        #: refcounted SHARED-PREFIX segments (the vLLM paged-KV capacity
+        #: economy, r4 verdict missing #6): N concurrent requests with
+        #: the same long prefix hold ONE immutable segment + N short
+        #: suffix slots, instead of N full-length slots.  Configure the
+        #: engine cfg's max_seq_len as the SUFFIX capacity and
+        #: segment_len as the prefix capacity.
+        self._seg_content: list[list[int]] = [
+            [] for _ in range(prefix_segments)]
+        self._seg_refs = np.zeros(max(prefix_segments, 1), np.int64)
+        self._seg_used = np.zeros(max(prefix_segments, 1), np.float64)
+        self._slot_plen = np.zeros(num_slots, np.int32)
+        self._slot_seg = np.zeros(num_slots, np.int32)
+        self.segment_hits = 0
+        self.segment_tokens_shared = 0
+        self.segment_evictions = 0
+        #: segments planned into this admission cycle's batched suffix
+        #: prefill — shielded from eviction until the dispatch lands
+        self._seg_reserved: set[int] = set()
         #: tokens whose KV each physical slot currently holds at positions
         #: [0, len) — survives retirement (the KV stays in HBM) and resets
         #: on reuse; the prefix matcher's ground truth
@@ -483,6 +615,81 @@ class ContinuousEngine:
 
         self._decode_for = decode_for
 
+        if self.prefix_segments > 0:
+            import dataclasses as _dc
+
+            # segment pool: a cache tree over its own (prefix-length)
+            # config — bf16 regardless of quant_kv (the prefix arg feeds
+            # the f32 attend math directly; int8 slots still compose)
+            self._seg_cfg = _dc.replace(
+                cfg, max_seq_len=self.segment_len, quant_kv=False)
+            self._seg_shapes = cache_shapes(
+                self._seg_cfg, self.prefix_segments)
+            seg_row = cache_shapes(self._seg_cfg, 1)
+            seg_probe = cache_shapes(self._seg_cfg, 2)
+            self._seg_batch_axes = jax.tree.map(
+                batch_axis, seg_probe, seg_row)
+            self._seg_attends = tuple(
+                [b for b in (128, 256, 512, 1024, 2048)
+                 if b < self.segment_len] + [self.segment_len])
+
+            self._seg_prefill_programs: dict[int, Any] = {}
+
+            def seg_prefill_for(bucket: int):
+                a = next(x for x in self._seg_attends if x >= bucket)
+                if a not in self._seg_prefill_programs:
+                    self._seg_prefill_programs[a] = make_prefill_program(
+                        self._seg_cfg, a, mesh)
+                return self._seg_prefill_programs[a]
+
+            self._seg_prefill_for = seg_prefill_for
+
+            seg_axes = self._seg_batch_axes
+
+            def seg_merge(seg_cache, row_cache, rows):
+                def leaf(pool, row, axis):
+                    if axis is None:
+                        return pool
+                    idx = (slice(None),) * axis + (rows,)
+                    return pool.at[idx].set(row, mode="drop")
+
+                return shardedlib.constrain_cache(
+                    jax.tree.map(leaf, seg_cache, row_cache, seg_axes),
+                    mesh)
+
+            self._seg_merge = shardedlib.mesh_jit(
+                mesh, seg_merge, donate_argnums=(0,))
+
+            self._suffix_admit_programs: dict[tuple, Any] = {}
+
+            def suffix_admit_for(attend: int, seg_att: int, bucket: int):
+                a = next(
+                    (b for b in self.attend_buckets if b >= attend),
+                    cfg.max_seq_len)
+                sa = next(x for x in self._seg_attends if x >= seg_att)
+                k = (a, sa, bucket)
+                if k not in self._suffix_admit_programs:
+                    self._suffix_admit_programs[k] = (
+                        make_suffix_admit_program(cfg, a, sa, bucket, mesh))
+                return self._suffix_admit_programs[k]
+
+            self._suffix_admit_for = suffix_admit_for
+
+            self._prefix_decode_programs: dict[tuple, Any] = {}
+
+            def prefix_decode_for(needed: int, seg_att: int):
+                a = next(
+                    (b for b in self.attend_buckets if b >= needed),
+                    cfg.max_seq_len)
+                sa = next(x for x in self._seg_attends if x >= seg_att)
+                k = (a, sa)
+                if k not in self._prefix_decode_programs:
+                    self._prefix_decode_programs[k] = (
+                        make_prefix_decode_program(cfg, a, sa, chunk, mesh))
+                return self._prefix_decode_programs[k]
+
+            self._prefix_decode_for = prefix_decode_for
+
         self._prefix_programs: dict[tuple[int, int], Any] = {}
 
         def prefix_admit_for(total_needed: int, suffix_bucket: int):
@@ -525,6 +732,13 @@ class ContinuousEngine:
                               self._logits_dtype),
                     mesh),
             ))()
+        if self.prefix_segments > 0:
+            self._seg_cache = shardedlib.mesh_jit(
+                mesh,
+                lambda: shardedlib.constrain_cache(
+                    jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype),
+                                 self._seg_shapes),
+                    mesh))()
 
     # -- public API --------------------------------------------------------
 
@@ -582,6 +796,38 @@ class ContinuousEngine:
                 np.zeros(self.num_slots, bool),
                 np.zeros(self.num_slots, np.float32),
                 np.asarray(jax.random.PRNGKey(0)))
+            jax.block_until_ready(toks)
+        if self.prefix_segments > 0:
+            # warm the SEGMENT path (creation prefill, batched suffix
+            # admit, prefix decode) — the first same-prefix burst must
+            # not stall the whole pool on mid-serving compiles.  All
+            # targets are out of range (row prefix_segments, slot
+            # num_slots) or inactive, so every write drops.
+            sb = self.seq_buckets[0]
+            top = self.segment_len
+            self._seg_cache = self._seg_merge(
+                self._seg_cache,
+                self._seg_prefill_for(top)(
+                    self.params, np.zeros((1, top), np.int32),
+                    np.ones(1, np.int32))[1],
+                np.full(1, self.prefix_segments, np.int32))
+            row_logits, row_cache = self._suffix_admit_for(sb, top, sb)(
+                self.params, self._seg_cache, np.zeros((1, sb), np.int32),
+                np.zeros(1, np.int32), np.full(1, top, np.int32),
+                np.ones(1, np.int32))
+            self._pool_cache, self._pool_logits = self._merge(
+                self._pool_cache, self._pool_logits, row_cache, row_logits,
+                np.full(1, self.num_slots, np.int32))
+            self._pool_cache, self._pool_logits, toks = (
+                self._prefix_decode_for(sb + self.decode_chunk, top)(
+                    self.params, self._pool_cache, self._pool_logits,
+                    self._seg_cache,
+                    np.full(self.num_slots, self.cfg.max_seq_len, np.int32),
+                    np.zeros(self.num_slots, np.int32),
+                    np.zeros(self.num_slots, np.int32),
+                    np.zeros(self.num_slots, bool),
+                    np.zeros(self.num_slots, np.float32),
+                    np.asarray(jax.random.PRNGKey(0))))
             jax.block_until_ready(toks)
         if self.prefix_cache:
             # warm the prefix-admit programs for the warmed prompt buckets
@@ -649,6 +895,12 @@ class ContinuousEngine:
             "tokens_discarded": self.tokens_discarded,
             "prefix_hits": self.prefix_hits,
             "prefix_tokens_saved": self.prefix_tokens_saved,
+            "segments_capacity": self.prefix_segments,
+            "segments_live": int(sum(
+                1 for c in self._seg_content if c)),
+            "segment_hits": self.segment_hits,
+            "segment_tokens_shared": self.segment_tokens_shared,
+            "segment_evictions": self.segment_evictions,
         }
 
     def stop(self) -> None:
@@ -694,7 +946,7 @@ class ContinuousEngine:
         self._waiting = [r for r in self._waiting
                          if not r.cancelled.is_set()]
         free = [i for i, r in enumerate(self._slots) if r is None]
-        taken: list[tuple[Request, list[int], int]] = []  # (req, prompt, slot)
+        taken: list[tuple[Request, int]] = []  # (req, slot)
         while free and self._waiting:
             req = self._waiting.pop(0)
             # budget the KV cache: prompt + generated tokens must fit
@@ -703,22 +955,39 @@ class ContinuousEngine:
             # frozen cache (the same guard LlamaGenerator applies at load)
             if req.max_new_tokens >= self.cfg.max_seq_len:
                 req.max_new_tokens = self.cfg.max_seq_len - 1
-            cap = min(self.seq_buckets[-1],
-                      self.cfg.max_seq_len - req.max_new_tokens)
-            prompt = req.prompt[-cap:]  # left-truncate, keep the tail
-            if not prompt:
+            if not req.prompt:
                 # empty prompt -> empty continuation (runtimes.py rule)
                 req.done.set()
                 continue
-            taken.append((req, prompt, free.pop(0)))
+            taken.append((req, free.pop(0)))
         if not taken:
             return
-        # prefix-cache routing: a prompt sharing >= min_prefix tokens with
-        # some slot's live KV admits via on-device copy + suffix prefill
-        # (src == dst is the conversation-continues case: the prefix is
-        # already in place and only the suffix runs)
+        # SHARED-SEGMENT routing sees the FULL prompt (legacy truncation
+        # below caps it to the slot length — which for a suffix-slot pool
+        # is exactly what segments exist to avoid); then legacy
+        # prefix-cache routing: a prompt sharing >= min_prefix tokens
+        # with some slot's live KV admits via on-device copy + suffix
+        # prefill (src == dst is the conversation-continues case)
         grouped: list[tuple[Request, list[int], int]] = []
-        for req, prompt, slot in taken:
+        seg_groups: dict[int, list] = {}  # bucket -> [(req, slot, seg, blen, suffix)]
+        for req, slot in taken:
+            if self.prefix_segments > 0:
+                try:
+                    plan = self._plan_segment(req)
+                except Exception as e:  # noqa: BLE001 — fail this request
+                    req.error = e
+                    req.done.set()
+                    continue
+                if plan is not None:
+                    seg, blen, suffix, _created = plan
+                    bucket = next(
+                        b for b in self.seq_buckets if b >= len(suffix))
+                    seg_groups.setdefault(bucket, []).append(
+                        (req, slot, seg, blen, suffix))
+                    continue
+            cap = min(self.seq_buckets[-1],
+                      self.cfg.max_seq_len - req.max_new_tokens)
+            prompt = req.prompt[-cap:]  # left-truncate, keep the tail
             src, lp = (self._best_prefix(prompt)
                        if self.prefix_cache else (-1, 0))
             if src < 0 or lp < self.min_prefix:
@@ -729,6 +998,44 @@ class ContinuousEngine:
             except Exception as e:  # noqa: BLE001 — fail this request only
                 req.error = e
                 req.done.set()
+        # batched segment admissions: one multi-row suffix prefill + one
+        # merge per bucket group (the 2-dispatches-per-burst rule holds
+        # for the segment path too); pad rows carry plen 0 / slot
+        # num_slots, which the masks and the merge scatter drop
+        for bucket, members in seg_groups.items():
+            g = 1
+            while g < len(members):
+                g *= 2
+            g = min(g, self.num_slots)
+            try:
+                toks = np.zeros((g, bucket), np.int32)
+                seg_ids = np.zeros(g, np.int32)
+                plens = np.zeros(g, np.int32)
+                slens = np.ones(g, np.int32)
+                slots = np.full(g, self.num_slots, np.int32)
+                max_blen = 1
+                for j, (req, slot, seg, blen, suffix) in enumerate(members):
+                    toks[j, : len(suffix)] = suffix
+                    seg_ids[j] = seg
+                    plens[j] = blen
+                    slens[j] = len(suffix)
+                    slots[j] = slot
+                    max_blen = max(max_blen, blen)
+                program = self._suffix_admit_for(bucket, max_blen, bucket)
+                row_logits, row_cache = program(
+                    self.params, self._seg_cache, toks, seg_ids, plens,
+                    slens)
+                self._pool_cache, self._pool_logits = self._merge(
+                    self._pool_cache, self._pool_logits, row_cache,
+                    row_logits, slots)
+                for req, slot, seg, blen, suffix in members:
+                    self._occupy(req, req.prompt, slot, plen=blen, seg=seg,
+                                 local_len=len(suffix))
+            except Exception as e:  # noqa: BLE001 — fail this group only
+                for req, *_ in members:
+                    req.error = e
+                    req.done.set()
+        self._seg_reserved.clear()
         groups: dict[int, list[tuple[Request, list[int], int]]] = {}
         for req, prompt, slot in grouped:
             bucket = next(b for b in self.seq_buckets if b >= len(prompt))
@@ -761,17 +1068,108 @@ class ContinuousEngine:
                     req.error = e
                     req.done.set()
 
-    def _occupy(self, req: Request, prompt: list[int], slot: int) -> None:
+    def _occupy(self, req: Request, prompt: list[int], slot: int, *,
+                plen: int = 0, seg: int = 0,
+                local_len: Optional[int] = None) -> None:
         self._slots[slot] = req
         self._active[slot] = True
-        self._positions[slot] = len(prompt)
+        # positions are SLOT-LOCAL: = global for plain slots, suffix
+        # length for segment-backed ones
+        self._positions[slot] = (
+            local_len if local_len is not None else len(prompt))
         self._remaining[slot] = req.max_new_tokens
         self._temps[slot] = (self.temperature if req.temperature is None
                              else req.temperature)
-        self._slot_content[slot] = list(prompt)
-        self._slot_owner[slot] = req
+        if plen > 0:
+            self._slot_plen[slot] = plen
+            self._slot_seg[slot] = seg
+            self._seg_refs[seg] += 1
+            self._seg_used[seg] = time.monotonic()
+            # a segment-backed slot's KV sits at OFFSET positions — the
+            # legacy slot-copy prefix matcher must never match it
+            self._slot_content[slot] = []
+            self._slot_owner[slot] = None
+        else:
+            self._slot_content[slot] = list(prompt)
+            self._slot_owner[slot] = req
         req.slot = slot
         req.admitted_step = self.step_counter
+
+    def _release_seg(self, slot: int) -> None:
+        """Drop a freed slot's segment reference (refcounted sharing)."""
+        if self.prefix_segments > 0 and self._slot_plen[slot] > 0:
+            self._seg_refs[self._slot_seg[slot]] -= 1
+            self._slot_plen[slot] = 0
+            self._slot_seg[slot] = 0
+
+    def _create_segment(self, tokens: list[int]) -> int:
+        """Prefill ``tokens`` into a free (or evictable refcount-0 LRU)
+        segment row; returns the row index or -1 when the pool is full of
+        referenced segments (caller falls back to legacy admission)."""
+        free = [i for i, c in enumerate(self._seg_content) if not c]
+        if not free:
+            evictable = [
+                i for i in range(self.prefix_segments)
+                if self._seg_refs[i] == 0 and self._seg_content[i]
+                and i not in self._seg_reserved]
+            if not evictable:
+                return -1
+            victim = min(evictable, key=lambda i: self._seg_used[i])
+            self._seg_content[victim] = []
+            self.segment_evictions += 1
+            free = [victim]
+        seg = free[0]
+        bucket = next(b for b in self._seg_attends if b >= len(tokens))
+        toks = np.zeros((1, bucket), np.int32)
+        toks[0, : len(tokens)] = tokens
+        _, row_cache = self._seg_prefill_for(bucket)(
+            self.params, toks, np.asarray([len(tokens)], np.int32))
+        self._seg_cache = self._seg_merge(
+            self._seg_cache, row_cache, np.asarray([seg], np.int32))
+        self._seg_content[seg] = list(tokens)
+        self._seg_used[seg] = time.monotonic()
+        return seg
+
+    def _plan_segment(self, req: Request) -> Optional[tuple]:
+        """Segment routing decision for one request: (seg, blen, suffix,
+        hit) or None (caller falls through to the legacy paths).  May
+        CREATE a segment (one prefill dispatch); the reservation set
+        keeps segments planned this admission cycle from being evicted
+        before their batched suffix prefill lands."""
+        prompt = req.prompt
+        cap = len(prompt) - 1  # >= 1 suffix token must run for logits
+        # longest-common-prefix match: segment KV at positions < lcp
+        # depends only on tokens < lcp (causal), so ANY prompt sharing
+        # those tokens may attend that much of the segment — one segment
+        # serves every variation on a system prompt
+        best, blen = -1, 0
+        p_arr = np.asarray(prompt, np.int64)
+        for i, content in enumerate(self._seg_content):
+            if min(len(content), cap) <= blen:
+                continue
+            lcp = _lcp(content, p_arr, cap)
+            if lcp > blen:
+                best, blen = i, lcp
+        created = False
+        if blen < self.min_prefix and cap >= self.min_prefix:
+            # too little shared with ANY segment (a 1-token BOS overlap
+            # must not block a new prompt from getting its own segment)
+            want = min(self.segment_len, cap)
+            made = self._create_segment(prompt[:want])
+            if made >= 0:
+                best, blen, created = made, want, True
+        if best < 0 or blen < self.min_prefix:
+            return None
+        suffix = prompt[blen:]
+        room = self.cfg.max_seq_len - 1 - len(suffix)
+        if room <= 0 or len(suffix) > self.seq_buckets[-1]:
+            return None  # suffix alone overflows the slot
+        req.max_new_tokens = min(req.max_new_tokens, room)
+        self._seg_reserved.add(best)
+        if not created:
+            self.segment_hits += 1
+            self.segment_tokens_shared += blen
+        return best, blen, suffix, created
 
     def _best_prefix(self, prompt: list[int]) -> tuple[int, int]:
         """(src_slot, lp): the longest usable prefix of ``prompt`` already
@@ -785,12 +1183,9 @@ class ContinuousEngine:
         cap = len(prompt) - 1
         p = np.asarray(prompt, np.int64)
         for s, content in enumerate(self._slot_content):
-            n = min(len(content), cap)
-            if n <= best_lp:
+            if min(len(content), cap) <= best_lp:
                 continue  # cannot beat the incumbent
-            c = np.asarray(content[:n], np.int64)
-            neq = np.nonzero(c != p[:n])[0]
-            lcp = int(neq[0]) if neq.size else n
+            lcp = _lcp(content, p, cap)
             if lcp > best_lp:
                 best_slot, best_lp = s, lcp
         return best_slot, best_lp
@@ -849,6 +1244,7 @@ class ContinuousEngine:
                     self._slots[slot] = None
                     self._active[slot] = False
                     self._remaining[slot] = 0
+                    self._release_seg(slot)
             if not self._active.any():
                 # drain the tail, then wait for work without spinning
                 while pending:
@@ -878,11 +1274,24 @@ class ContinuousEngine:
             # executed yet — an aliased input then reads ADVANCED
             # positions (writes land one slot off, intermittently, under
             # dispatch-ahead pipelining; reproduced 3/10 before this fix)
-            self._pool_cache, self._pool_logits, toks = self._decode_for(
-                needed)(
-                self.params, self._pool_cache, self._pool_logits,
-                self._positions.copy(), self._active.copy(),
-                self._temps.copy(), key)
+            live_seg = (self.prefix_segments > 0
+                        and bool((self._slot_plen[self._active] > 0).any()))
+            if live_seg:
+                seg_att = int(self._slot_plen[self._active].max())
+                plens = np.where(
+                    self._active, self._slot_plen, 0).astype(np.int32)
+                self._pool_cache, self._pool_logits, toks = (
+                    self._prefix_decode_for(needed, seg_att)(
+                        self.params, self._pool_cache, self._pool_logits,
+                        self._seg_cache, self._positions.copy(), plens,
+                        self._slot_seg.astype(np.int32).copy(),
+                        self._active.copy(), self._temps.copy(), key))
+            else:
+                self._pool_cache, self._pool_logits, toks = self._decode_for(
+                    needed)(
+                    self.params, self._pool_cache, self._pool_logits,
+                    self._positions.copy(), self._active.copy(),
+                    self._temps.copy(), key)
             # advance the value-independent schedule NOW so the next chunk
             # can dispatch before this one's tokens are fetched
             for slot, req, take in snapshot:
@@ -893,6 +1302,7 @@ class ContinuousEngine:
                     # the request itself resolves when its tokens arrive
                     self._slots[slot] = None
                     self._active[slot] = False
+                    self._release_seg(slot)
             pending.append((toks, snapshot))
             if len(pending) >= self.pipeline_depth:
                 self._process(*pending.pop(0))
@@ -926,6 +1336,7 @@ class ContinuousEngine:
                     self._slots[slot] = None
                     self._active[slot] = False
                     self._remaining[slot] = 0
+                    self._release_seg(slot)
             if emitted and req.first_token_at is None:
                 req.first_token_at = now
             req.tokens.extend(emitted)
@@ -1060,6 +1471,8 @@ def engine_kwargs(config: dict, *, default_eos=None,
         mesh_axes=config.get("mesh_axes"),
         prefix_cache=bool(config.get("prefix_cache", True)),
         min_prefix=int(config.get("min_prefix", 32)),
+        prefix_segments=int(config.get("prefix_segments", 0)),
+        segment_len=int(config.get("segment_len", 0)),
         default_max_new_tokens=int(
             config.get("max_new_tokens", default_max_new_tokens)),
     )

@@ -199,6 +199,11 @@ class GangEngine(contlib.ContinuousEngine):
     def __init__(self, cfg, params, *, channel: GangChannel, **kw) -> None:
         if not kw.get("mesh_axes"):
             raise ValueError("a serving gang needs mesh_axes")
+        if kw.get("prefix_segments"):
+            raise ValueError(
+                "shared-prefix segments are not gang-capable yet: the "
+                "segment prefill/suffix/decode ops are not in the control "
+                "stream protocol")
         self._channel = channel
         super().__init__(cfg, params, **kw)
 

@@ -1,0 +1,174 @@
+"""Refcounted shared-prefix segments (r4 verdict missing #6 — the vLLM
+paged-KV capacity economy, SURVEY §2.2).
+
+N concurrent requests sharing a long prefix hold ONE immutable segment
+plus N short suffix slots, instead of N full-length slots: the engine's
+slot pool can be sized for suffixes only, which is what changes
+capacity (slots per GiB), not just latency.  Attention stays exact —
+one softmax over [segment ; private] (llama._decode_attend).
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from flax import linen as nn
+
+from kubeflow_tpu.models import llama as llamalib
+from kubeflow_tpu.serving.continuous import ContinuousEngine, cache_shapes
+
+
+def _setup():
+    base = llamalib.tiny()  # max_seq_len 128
+    params = nn.meta.unbox(llamalib.Llama(base).init(
+        jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32))["params"])
+    rng = np.random.default_rng(0)
+    system = rng.integers(1, 256, size=48).tolist()
+    prompts = [system + rng.integers(1, 256, size=5).tolist()
+               for _ in range(4)]
+    return base, params, system, prompts
+
+
+def _reference(base, params, prompts, n=5):
+    eng = ContinuousEngine(base, params, num_slots=len(prompts),
+                           decode_chunk=2, eos_id=None, prefix_cache=False)
+    try:
+        return [eng.generate(p, max_new_tokens=n) for p in prompts]
+    finally:
+        eng.stop()
+
+
+def _segment_engine(base, params, **kw):
+    suffix_cfg = dataclasses.replace(base, max_seq_len=32)
+    defaults = dict(num_slots=4, decode_chunk=2, eos_id=None,
+                    prefix_cache=False, prefix_segments=2, segment_len=64,
+                    min_prefix=16)
+    defaults.update(kw)
+    return ContinuousEngine(suffix_cfg, params, **defaults)
+
+
+class TestSharedSegments:
+    def test_concurrent_same_prefix_burst_parity(self):
+        """4 requests with a common 48-token prefix decode CONCURRENTLY
+        in 32-token suffix slots, token-identical to full-length slots —
+        one segment, three hits, no evictions."""
+        base, params, _, prompts = _setup()
+        want = _reference(base, params, prompts)
+        eng = _segment_engine(base, params)
+        try:
+            reqs = [eng.submit(p, max_new_tokens=5) for p in prompts]
+            got = [r.wait(300) for r in reqs]
+            st = eng.stats()
+        finally:
+            eng.stop()
+        assert got == want
+        assert st["segments_live"] == 1
+        assert st["segment_hits"] == 3
+        assert st["segment_tokens_shared"] == 3 * 48
+        assert st["segment_evictions"] == 0
+
+    def test_divergence_isolated(self):
+        """Requests diverging after the shared prefix must not see each
+        other's suffixes: distinct continuations for distinct suffixes,
+        identical for identical prompts (the copy-on-write concern
+        dissolves because segments are immutable)."""
+        base, params, system, _ = _setup()
+        a = system + [7, 7, 7]
+        b = system + [9, 9, 9]
+        want = _reference(base, params, [a, b, a])
+        eng = _segment_engine(base, params)
+        try:
+            reqs = [eng.submit(p, max_new_tokens=5) for p in (a, b, a)]
+            got = [r.wait(300) for r in reqs]
+        finally:
+            eng.stop()
+        assert got == want
+        assert got[0] == got[2]
+        assert got[0] != got[1]
+
+    def test_capacity_bytes_per_request(self):
+        """The capacity claim in bytes, on the actual pool trees: suffix
+        slots + amortized segment << full-length slots, per request."""
+        base, params, _, _ = _setup()
+        suffix_cfg = dataclasses.replace(base, max_seq_len=32)
+        seg_cfg = dataclasses.replace(base, max_seq_len=64)
+
+        def nbytes(cfg, rows):
+            return sum(
+                int(np.prod(s.shape)) * jnp.dtype(s.dtype).itemsize
+                for s in jax.tree.leaves(cache_shapes(cfg, rows)))
+
+        n = 8  # concurrent same-prefix requests
+        legacy = nbytes(base, n)
+        shared = nbytes(suffix_cfg, n) + nbytes(seg_cfg, 1)
+        # 8 x 128-token slots vs 8 x 32 + one 64-token segment
+        assert shared < 0.45 * legacy, (shared, legacy)
+
+    def test_eviction_respects_refcounts(self):
+        """A referenced segment is never evicted; refcount-0 LRU is."""
+        base, params, system, _ = _setup()
+        rng = np.random.default_rng(7)
+        other1 = rng.integers(1, 256, size=40).tolist()
+        other2 = rng.integers(1, 256, size=40).tolist()
+        eng = _segment_engine(base, params)
+        try:
+            # hold a LIVE reference on the system-prompt segment
+            live = eng.submit(system + [3], max_new_tokens=20)
+            deadline = 60
+            import time as _t
+
+            t0 = _t.monotonic()
+            while eng.stats()["segments_live"] < 1:
+                assert _t.monotonic() - t0 < deadline
+                _t.sleep(0.05)
+            # two disjoint-prefix requests: the second must evict the
+            # FIRST's (refcount-0) segment, never the referenced one
+            eng.generate(other1 + [5], max_new_tokens=2)
+            eng.generate(other2 + [5], max_new_tokens=2)
+            st = eng.stats()
+            assert st["segment_evictions"] >= 1
+            # the system segment survived: a new same-prefix request hits
+            hits_before = st["segment_hits"]
+            eng.generate(system + [9], max_new_tokens=2)
+            assert eng.stats()["segment_hits"] > hits_before
+            live.wait(300)
+        finally:
+            eng.stop()
+
+    def test_falls_back_when_suffix_overflows_slot(self):
+        """A prompt whose post-prefix suffix exceeds the slot bucket must
+        still complete (legacy truncation path), not error."""
+        base, params, system, _ = _setup()
+        eng = _segment_engine(base, params)
+        rng = np.random.default_rng(3)
+        # post-SEGMENT suffix must exceed the 32-token slot: segment
+        # captures at most segment_len=64 tokens, so 48 system + 100
+        # extra leaves a 84-token suffix > seq_buckets[-1]=32
+        long_suffix = rng.integers(1, 256, size=100).tolist()
+        try:
+            out = eng.generate(system + long_suffix, max_new_tokens=3)
+            st = eng.stats()
+        finally:
+            eng.stop()
+        assert len(out) == 3
+        # proof the fallback (not the segment path) served it: no slot
+        # was occupied through a segment reference
+        assert st["segment_hits"] == 0
+
+    def test_build_engine_knobs(self):
+        from kubeflow_tpu.serving.continuous import build_engine
+
+        base, params, _, prompts = _setup()
+        suffix_cfg = dataclasses.replace(base, max_seq_len=32)
+        eng = build_engine(suffix_cfg, params, {
+            "num_slots": 2, "decode_chunk": 2, "warmup_groups": [],
+            "prefix_cache": False, "prefix_segments": 2,
+            "segment_len": 64, "min_prefix": 16})
+        try:
+            out = eng.generate(prompts[0], max_new_tokens=3)
+            assert len(out) == 3
+            assert eng.stats()["segments_live"] == 1
+        finally:
+            eng.stop()
