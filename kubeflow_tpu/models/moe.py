@@ -31,6 +31,59 @@ import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
+
+@jax.custom_vjp
+def _permute(x, order, inv):
+    """``x[order]`` whose BACKWARD is ``g[inv]`` — a gather, not the
+    scatter-add autodiff derives for gather's transpose.  For a bijective
+    permutation the two are identical math, but the gather keeps the
+    backward pass on the same fast path as the forward (the r4 PERF.md
+    "reuse the fwd sort order in bwd" lever: the permutation is
+    value-independent given routing, so bwd re-derives nothing)."""
+    return jnp.take(x, order, axis=0)
+
+
+def _permute_fwd(x, order, inv):
+    return jnp.take(x, order, axis=0), (order, inv)
+
+
+def _permute_bwd(res, g):
+    order, inv = res
+    ft0 = jax.dtypes.float0
+    return (jnp.take(g, inv, axis=0),
+            jnp.zeros(order.shape, ft0), jnp.zeros(inv.shape, ft0))
+
+
+_permute.defvjp(_permute_fwd, _permute_bwd)
+
+
+from functools import partial as _partial
+
+
+@_partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _gather_tokens(xf, order, inv, k):
+    """``xf[order // k]`` (each token row fans out to its k expert
+    copies, permuted to expert order) whose BACKWARD is gather-by-inverse
+    + a k-way reshape-sum — no scatter-add.  ``inv`` is the caller's
+    already-computed ``argsort(order)`` (reused, not re-derived)."""
+    return jnp.take(xf, order // k, axis=0)
+
+
+def _gather_tokens_fwd(xf, order, inv, k):
+    return jnp.take(xf, order // k, axis=0), (inv, xf.shape[0])
+
+
+def _gather_tokens_bwd(k, res, g):
+    inv, n = res
+    # unsort to (token-major, k) layout, then sum each token's k copies
+    g_tok = jnp.take(g, inv, axis=0).reshape(n, k, *g.shape[1:])
+    ft0 = jax.dtypes.float0
+    return (g_tok.sum(axis=1),
+            jnp.zeros(inv.shape, ft0), jnp.zeros(inv.shape, ft0))
+
+
+_gather_tokens.defvjp(_gather_tokens_fwd, _gather_tokens_bwd)
+
 from typing import TYPE_CHECKING
 
 if TYPE_CHECKING:  # pragma: no cover
@@ -226,25 +279,37 @@ def _ragged_moe(x, idx, gates, w_gate, w_up, w_down, *, dtype,
             (wg, wu, wd, jnp.arange(wg.shape[0], dtype=jnp.int32)))
         return acc
 
-    def grouped_compute(recv, lid, valid, wg, wu, wd):
+    def grouped_compute(recv, lid, valid, wg, wu, wd, presorted=False):
         """Grouped-GEMM expert MLP: re-group rows by local expert, run the
-        block-sparse kernel over contiguous expert ranges, un-group."""
+        block-sparse kernel over contiguous expert ranges, un-group.
+
+        ``presorted``: the d == 1 path hands rows ALREADY globally
+        expert-sorted with padding at the end — the second sort and its
+        two permutes (fwd gather + unsort gather, and their backward
+        twins) are pure tax there and are skipped (PERF.md MoE table,
+        the r4 "2 sorts + 2 gathers" lever)."""
         from ..ops.grouped_matmul import grouped_matmul
 
         e_local = wg.shape[0]
         key = jnp.where(valid, lid, e_local)  # invalid rows sort last
-        order2 = jnp.argsort(key, stable=True)
-        xs2 = recv[order2]
         counts = jax.ops.segment_sum(
             jnp.where(valid, 1, 0), jnp.clip(key, 0, e_local),
             num_segments=e_local + 1)[:e_local]
         offsets = jnp.concatenate(
             [jnp.zeros((1,), jnp.int32), jnp.cumsum(counts).astype(jnp.int32)])
+        if presorted:
+            xs2 = recv
+        else:
+            order2 = jnp.argsort(key, stable=True)
+            inv2 = jnp.argsort(order2)
+            xs2 = _permute(recv, order2, inv2)
         g = grouped_matmul(xs2, wg.astype(dtype), offsets)
         u = grouped_matmul(xs2, wu.astype(dtype), offsets)
         hidden = nn.silu(g) * u
         y2 = grouped_matmul(hidden, wd.astype(dtype), offsets)
-        return y2[jnp.argsort(order2)]
+        if presorted:
+            return y2
+        return _permute(y2, inv2, order2)
 
     expert_mlp = grouped_compute if use_grouped else local_compute
 
@@ -268,15 +333,23 @@ def _ragged_moe(x, idx, gates, w_gate, w_up, w_down, *, dtype,
         xf = x_blk.reshape(n, h)
         flat_expert = idx_blk.reshape(n * k)
         order = jnp.argsort(flat_expert, stable=True)
+        inv = jnp.argsort(order)
         sorted_expert = flat_expert[order]
-        xs = xf[order // k].astype(dtype)                  # [n*k, h]
+        # fan-out + permute whose BACKWARD is gathers (no scatter-add)
+        xs = _gather_tokens(xf, order, inv, k).astype(dtype)  # [n*k, h]
 
         if d == 1:
             (xs_p, ids_p), rows = _pad_rows(
                 [xs, sorted_expert], n * k)
             valid_p = jnp.arange(rows) < n * k
-            y_buf = expert_mlp(
-                xs_p, jnp.where(valid_p, ids_p, e_local), valid_p, wg, wu, wd)
+            ids_m = jnp.where(valid_p, ids_p, e_local)
+            if use_grouped:
+                # rows are already globally expert-sorted: skip the
+                # kernel-side re-sort entirely
+                y_buf = grouped_compute(
+                    xs_p, ids_m, valid_p, wg, wu, wd, presorted=True)
+            else:
+                y_buf = expert_mlp(xs_p, ids_m, valid_p, wg, wu, wd)
             y_sorted = y_buf[: n * k]
         else:
             me = lax.axis_index("expert")
@@ -321,8 +394,7 @@ def _ragged_moe(x, idx, gates, w_gate, w_up, w_down, *, dtype,
                 y_buf, back, recv_starts, recv_sizes, mr[:, me], send_sizes,
                 axis_name="expert")
 
-        inv = jnp.argsort(order)
-        y_flat = y_sorted[inv].reshape(n, k, h)
+        y_flat = _permute(y_sorted, inv, order).reshape(n, k, h)
         y = (y_flat * gates_blk.reshape(n, k)[..., None].astype(dtype)).sum(1)
         return y.reshape(bl, s, h)
 
