@@ -391,7 +391,21 @@ class JaxJobController(Controller):
             if not self.store.try_delete(KIND_POD, p.metadata.name, p.metadata.namespace):
                 self.expectations.deletion_observed(key)
         job = self._set_cond(job, JobConditionType.RESTARTING, "PodsRestarting", "gang restart after failure")
-        self._update_job(job, lambda o: setattr(o.status, "restart_count", o.status.restart_count + 1))
+
+        def bump(o):
+            o.status.restart_count += 1
+            if not o.spec.coordinator_port:
+                # fresh coordinator port for the new incarnation: the old
+                # coordinator process may hold the previous port through
+                # its kill-grace window, and jax.distributed's bind/
+                # connect retry backoff was the dominant term of
+                # restart->resume (measured ~10.5s of 11s p50,
+                # scripts/gang_startup_bench.py phase decomposition) —
+                # the new gang's pods are rebuilt anyway, so they carry
+                # the new port in their env
+                o.status.coordinator_port = None
+
+        self._update_job(job, bump)
         self.emit_event(job, "Restarting", f"gang restart #{job.status.restart_count + 1}", "Warning")
         return Result(requeue_after=0.05)
 

@@ -60,6 +60,7 @@ class LocalKubelet:
         self.interval = interval
         self.env_overrides = env_overrides or {}
         self._procs: dict[str, _Proc] = {}  # ns/name -> proc
+        self._kill_threads: list[threading.Thread] = []
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._watch = None
@@ -87,6 +88,9 @@ class LocalKubelet:
             self.store.stop_watch(self._watch)
         for key in list(self._procs):
             self._kill(key)
+        for t in self._kill_threads:
+            t.join(timeout=2 * GRACE_SECONDS + 1)
+        self._kill_threads.clear()
 
     def _loop(self) -> None:
         while not self._stop.is_set():
@@ -223,11 +227,20 @@ class LocalKubelet:
         log.info("pod %s exited code=%s", pod.key, code)
 
     def _kill(self, key: str) -> None:
+        """SIGTERM -> grace -> SIGKILL, OFF the kubelet loop thread: the
+        grace wait used to block the single-threaded loop, delaying the
+        NEXT incarnation's spawn by up to GRACE_SECONDS whenever a gang
+        restart's survivor was wedged in a collective with its dead peer
+        (measured as a 3.1s respawn phase in the restart decomposition,
+        scripts/gang_startup_bench.py)."""
         proc = self._procs.pop(key, None)
         if proc is None:
             return
         popen = proc.popen
-        if popen.poll() is None:
+        if popen.poll() is not None:
+            return
+
+        def grace_kill():
             try:
                 os.killpg(os.getpgid(popen.pid), signal.SIGTERM)
             except (ProcessLookupError, PermissionError):
@@ -239,7 +252,15 @@ class LocalKubelet:
                     os.killpg(os.getpgid(popen.pid), signal.SIGKILL)
                 except (ProcessLookupError, PermissionError):
                     pass
-                popen.wait(timeout=GRACE_SECONDS)
+                try:
+                    popen.wait(timeout=GRACE_SECONDS)
+                except subprocess.TimeoutExpired:
+                    pass
+
+        t = threading.Thread(
+            target=grace_kill, name=f"pod-kill-{popen.pid}", daemon=True)
+        t.start()
+        self._kill_threads.append(t)
 
     # -- status writes ---------------------------------------------------------
 

@@ -584,8 +584,17 @@ class Trainer:
         cfg = self.cfg
         source = source or datalib.SyntheticLm(
             cfg.global_batch, cfg.seq_len, cfg.model.vocab_size)
-        state = self.restore_or_init()
-        step_fn = self.compiled_step()
+        # overlap restore/init with the step compile: the compile needs
+        # only the ABSTRACT state, restore is IO + device_put — serial
+        # they stack (recovery pays both, BASELINE restart metric), in
+        # parallel the longer one hides the shorter (XLA compilation
+        # releases the GIL)
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(max_workers=1) as ex:
+            state_fut = ex.submit(self.restore_or_init)
+            step_fn = self.compiled_step()
+            state = state_fut.result()
         start_step = int(jax.device_get(state["step"]))
         n_chips = self.mesh.devices.size
         flops_tok = llamalib.flops_per_token(cfg.model, cfg.seq_len)

@@ -79,8 +79,84 @@ def _resume_metric_ts(root: str, after: float) -> float:
     return best
 
 
-def measure_restart_resume(platform, client, n, workers, cache) -> list[float]:
+class _PodWatcher:
+    """Polls pod statuses to timestamp the recovery phases: old-pod
+    failure detection, teardown completion (old incarnation gone), new
+    incarnation spawn + gang barrier."""
+
+    def __init__(self, store, job_name):
+        import threading
+
+        self.store = store
+        self.job = job_name
+        self.failed_at = None      # first old pod observed FAILED
+        self.gone_at = None        # all old pods deleted
+        self.new_start = None      # first new pod start_time
+        self.new_barrier = None    # last new pod barrier_time
+        self._uids = {}
+        for pod in store.list("Pod"):
+            if pod.metadata.name.startswith(self.job + "-"):
+                self._uids[pod.metadata.name] = pod.metadata.uid
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        while not self._stop.is_set():
+            now = time.time()
+            seen = {}
+            for pod in self.store.list("Pod"):
+                if not pod.metadata.name.startswith(self.job + "-"):
+                    continue
+                seen[pod.metadata.name] = pod
+            old_alive = False
+            barriers = []
+            for name, uid in self._uids.items():
+                pod = seen.get(name)
+                if pod is not None and pod.metadata.uid == uid:
+                    old_alive = True
+                    if (self.failed_at is None
+                            and str(pod.status.phase) == "PodPhase.FAILED"):
+                        self.failed_at = now
+            if not old_alive and self.gone_at is None and self.failed_at:
+                self.gone_at = now
+            for name, pod in seen.items():
+                if pod.metadata.uid == self._uids.get(name):
+                    continue  # old incarnation
+                if pod.status.start_time:
+                    if (self.new_start is None
+                            or pod.status.start_time < self.new_start):
+                        self.new_start = pod.status.start_time
+                if pod.status.barrier_time:
+                    barriers.append(pod.status.barrier_time)
+            if barriers and len(barriers) == len(self._uids):
+                self.new_barrier = max(barriers)
+            self._stop.wait(0.005)
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join(timeout=2)
+
+
+def _first_loss_ts(root: str, after: float) -> float:
+    best = None
+    for dirpath, _, names in os.walk(root):
+        if "metrics.jsonl" not in names:
+            continue
+        with open(os.path.join(dirpath, "metrics.jsonl")) as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if rec.get("name") == "loss" and rec.get("ts", 0) > after:
+                    best = rec["ts"] if best is None else min(best, rec["ts"])
+    return best
+
+
+def measure_restart_resume(platform, client, n, workers, cache):
     samples = []
+    phase_rows = []
     root = platform.root_dir
     for i in range(n):
         name = f"restart-{i}"
@@ -104,17 +180,37 @@ def measure_restart_resume(platform, client, n, workers, cache) -> list[float]:
                 break
             time.sleep(0.1)
         assert steps, "no checkpoint before the kill"
+        watcher = _PodWatcher(platform.store, name)
         pod = platform.store.get("Pod", f"{name}-worker-{workers - 1}")
         t_kill = time.time()
         os.kill(pod.status.pid, signal.SIGKILL)
         client.wait_for_job_conditions(name, timeout=300)
+        watcher.stop()
         ts = _resume_metric_ts(root, t_kill)
         assert ts is not None, "no resume marker after the kill"
+        loss_ts = _first_loss_ts(root, t_kill)
+        ph = {
+            "detect_s": (watcher.failed_at or t_kill) - t_kill,
+            "teardown_s": ((watcher.gone_at or t_kill)
+                           - (watcher.failed_at or t_kill)),
+            "respawn_s": ((watcher.new_start or 0)
+                          - (watcher.gone_at or t_kill)
+                          if watcher.new_start else None),
+            "rendezvous_s": ((watcher.new_barrier - watcher.new_start)
+                             if watcher.new_barrier and watcher.new_start
+                             else None),
+            "trainer_init_s": (ts - watcher.new_barrier
+                               if watcher.new_barrier else None),
+            "first_step_s": (loss_ts - ts) if loss_ts else None,
+        }
+        phase_rows.append(ph)
         samples.append(ts - t_kill)
-        print(f"# {name}: restart_to_resume={ts - t_kill:.3f}s",
+        print(f"# {name}: restart_to_resume={ts - t_kill:.3f}s phases=" +
+              json.dumps({k: (round(v, 3) if v is not None else None)
+                          for k, v in ph.items()}),
               file=sys.stderr)
         client.delete_job(name)
-    return samples
+    return samples, phase_rows
 
 
 def main() -> None:
@@ -136,8 +232,8 @@ def main() -> None:
             client, n_jobs + 1, workers, {"KFT_COMPILE_CACHE": cache},
             "warm")
         warm = warm_all[1:]
-        restart = measure_restart_resume(
-            platform, client, max(3, n_jobs // 3), workers, cache)
+        restart, phases = measure_restart_resume(
+            platform, client, max(8, n_jobs // 3), workers, cache)
 
     base = f"(n={n_jobs}, workers={workers}, local CPU runtime)"
     print(json.dumps({
@@ -147,10 +243,15 @@ def main() -> None:
         "metric": "gang_startup_warm_p50_seconds",
         "unit": f"s {base}, shared persistent compile cache",
         **_percentiles(warm)}))
+    med_phase = {}
+    for key in phases[0]:
+        vals = sorted(v for p in phases for v in [p[key]] if v is not None)
+        med_phase[key] = round(vals[len(vals) // 2], 3) if vals else None
     print(json.dumps({
         "metric": "restart_to_resume_p50_seconds",
         "unit": f"s (kill -> resume marker, workers={workers})",
-        **_percentiles(restart)}))
+        **_percentiles(restart),
+        "phase_p50": med_phase}))
 
 
 if __name__ == "__main__":
