@@ -60,7 +60,12 @@ def problem():
     return block_apply, loss_fn, ws, head, x, tgt
 
 
-def bench(schedule: str, m: int, v: int = 1) -> dict:
+def bench(schedule: str, m: int, v: int = 1,
+          persistent: bool = False) -> dict:
+    """``persistent``: weights live PRE-PERMUTED in the interleaved
+    layout across steps (the in-step permute and its ~2x temp bytes
+    vanish; grads come back in the same layout, so a trainer adopting it
+    must canonicalize at checkpoint/publish boundaries)."""
     block_apply, loss_fn, ws, head, x, tgt = problem()
     mesh = meshlib.build_mesh({"pipeline": P_STAGES, "data": 8 // P_STAGES})
 
@@ -73,14 +78,16 @@ def bench(schedule: str, m: int, v: int = 1) -> dict:
             return jax.value_and_grad(loss, argnums=(0, 1))(ws, hp)
     else:
         perm = pipelib.interleave_permutation(LAYERS, P_STAGES, v)
+        if persistent and v > 1:
+            ws = jnp.take(ws, jnp.asarray(perm), axis=0)
 
         def step(ws, hp, x, tgt):
-            # the interleaved layout permute is part of the step (as in
-            # the trainer) so its cost is measured, not hidden
+            # in-step permute (as in the trainer) unless persistent —
+            # both variants measured so the layout cost is visible
+            w_used = (ws if (v == 1 or persistent)
+                      else jnp.take(ws, jnp.asarray(perm), axis=0))
             loss, (dws, dhead, dx) = pipelib.one_f_one_b(
-                block_apply, loss_fn,
-                ws if v == 1 else jnp.take(ws, jnp.asarray(perm), axis=0),
-                hp, x, tgt,
+                block_apply, loss_fn, w_used, hp, x, tgt,
                 mesh=mesh, num_microbatches=m, interleave=v)
             return loss, dws
 
@@ -98,7 +105,8 @@ def bench(schedule: str, m: int, v: int = 1) -> dict:
 
     row = {
         "metric": "pipeline_schedule_probe",
-        "schedule": schedule if v == 1 else f"{schedule}-v{v}",
+        "schedule": (schedule if v == 1 else
+                     f"{schedule}-v{v}" + ("-persist" if persistent else "")),
         "stages": P_STAGES,
         "interleave": v,
         "microbatches": m,
@@ -134,9 +142,11 @@ def bench(schedule: str, m: int, v: int = 1) -> dict:
 
 def main() -> None:
     for m in (4, 8, 16):
-        for schedule, v in (("gpipe", 1), ("1f1b", 1), ("1f1b", 2),
-                            ("1f1b", 4)):
-            print(json.dumps(bench(schedule, m, v)), flush=True)
+        for schedule, v, persist in (
+                ("gpipe", 1, False), ("1f1b", 1, False),
+                ("1f1b", 2, False), ("1f1b", 4, False),
+                ("1f1b", 2, True), ("1f1b", 4, True)):
+            print(json.dumps(bench(schedule, m, v, persist)), flush=True)
 
 
 if __name__ == "__main__":
