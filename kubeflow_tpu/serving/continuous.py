@@ -1501,6 +1501,13 @@ def resolve_model_source(config: dict, *, name: str = "model"):
     if adapter:
         acfg, adapters = llamalib.load_adapter(adapter)
         cfg, params = llamalib.merge_adapter(acfg, params, adapters)
+    if config.get("max_seq_len"):
+        # serve-time override: with shared-prefix segments the SLOT pool
+        # is sized for suffixes (cfg.max_seq_len), far below the
+        # snapshot's trained context — the capacity knob deployments turn
+        import dataclasses as _dc
+
+        cfg = _dc.replace(cfg, max_seq_len=int(config["max_seq_len"]))
     return cfg, params
 
 

@@ -199,11 +199,6 @@ class GangEngine(contlib.ContinuousEngine):
     def __init__(self, cfg, params, *, channel: GangChannel, **kw) -> None:
         if not kw.get("mesh_axes"):
             raise ValueError("a serving gang needs mesh_axes")
-        if kw.get("prefix_segments"):
-            raise ValueError(
-                "shared-prefix segments are not gang-capable yet: the "
-                "segment prefill/suffix/decode ops are not in the control "
-                "stream protocol")
         self._channel = channel
         super().__init__(cfg, params, **kw)
 
@@ -297,6 +292,86 @@ class GangEngine(contlib.ContinuousEngine):
         self._prefix_admit_for = prefix_admit_for
         self._merge = merge
 
+        if self.prefix_segments > 0:
+            # shared-prefix segment ops join the control stream: segment
+            # creation (prefill + merge into the segment pool), batched
+            # suffix admission, and the prefix-aware decode — all
+            # replayed by follow() against each host's segment shards
+            seg_prefill_inner = self._seg_prefill_for
+            seg_merge_inner = self._seg_merge
+            suffix_inner = self._suffix_admit_for
+            pdecode_inner = self._prefix_decode_for
+
+            def seg_prefill_for(bucket: int):
+                prog = seg_prefill_inner(bucket)
+
+                def call(params, toks, lengths):
+                    try:
+                        toks = np.asarray(toks)
+                        lengths = np.asarray(lengths)
+                        ch.publish(("seg_prefill", int(bucket), toks,
+                                    lengths))
+                        return prog(params, toks, lengths)
+                    except Exception as e:  # noqa: BLE001
+                        raise self._fatal(e)
+
+                return call
+
+            def seg_merge(seg_cache, row_cache, rows):
+                try:
+                    rows = np.asarray(rows)
+                    ch.publish(("seg_merge", rows))
+                    return seg_merge_inner(seg_cache, row_cache, rows)
+                except Exception as e:  # noqa: BLE001
+                    raise self._fatal(e)
+
+            def suffix_admit_for(attend: int, seg_att: int, bucket: int):
+                prog = suffix_inner(attend, seg_att, bucket)
+
+                def call(params, seg_cache, toks, seg_ids, plens, slens):
+                    try:
+                        toks = np.asarray(toks)
+                        seg_ids = np.asarray(seg_ids)
+                        plens = np.asarray(plens)
+                        slens = np.asarray(slens)
+                        ch.publish(("suffix_admit", int(attend),
+                                    int(seg_att), int(bucket), toks,
+                                    seg_ids, plens, slens))
+                        return prog(params, seg_cache, toks, seg_ids,
+                                    plens, slens)
+                    except Exception as e:  # noqa: BLE001
+                        raise self._fatal(e)
+
+                return call
+
+            def prefix_decode_for(needed: int, seg_att: int):
+                prog = pdecode_inner(needed, seg_att)
+
+                def call(params, cache, logits, seg_cache, positions,
+                         plens, seg_ids, active, temps, key):
+                    try:
+                        positions = np.asarray(positions)
+                        plens = np.asarray(plens)
+                        seg_ids = np.asarray(seg_ids)
+                        active = np.asarray(active)
+                        temps = np.asarray(temps)
+                        key = np.asarray(key)
+                        ch.publish(("prefix_decode", int(needed),
+                                    int(seg_att), positions, plens,
+                                    seg_ids, active, temps, key))
+                        return prog(params, cache, logits, seg_cache,
+                                    positions, plens, seg_ids, active,
+                                    temps, key)
+                    except Exception as e:  # noqa: BLE001
+                        raise self._fatal(e)
+
+                return call
+
+            self._seg_prefill_for = seg_prefill_for
+            self._seg_merge = seg_merge
+            self._suffix_admit_for = suffix_admit_for
+            self._prefix_decode_for = prefix_decode_for
+
     def stop(self) -> None:
         super().stop()
         try:
@@ -318,6 +393,7 @@ def follow(engine: contlib.ContinuousEngine, channel: GangChannel) -> None:
     """
     params = engine.params
     row: Optional[tuple] = None
+    seg_row = None
     while True:
         msg = channel.next()
         op = msg[0]
@@ -347,6 +423,28 @@ def follow(engine: contlib.ContinuousEngine, channel: GangChannel) -> None:
                     params, engine._pool_cache, engine._pool_logits,
                     np.int32(src), np.int32(dst), np.int32(lp),
                     suffix, np.int32(slen)))
+        elif op == "seg_prefill":
+            _, bucket, toks, lengths = msg
+            seg_row = engine._seg_prefill_for(bucket)(
+                params, toks, lengths)
+        elif op == "seg_merge":
+            (_, rows) = msg
+            assert seg_row is not None, "seg_merge before seg_prefill"
+            engine._seg_cache = engine._seg_merge(
+                engine._seg_cache, seg_row[1], rows)
+            seg_row = None
+        elif op == "suffix_admit":
+            _, attend, seg_att, bucket, toks, seg_ids, plens, slens = msg
+            row = engine._suffix_admit_for(attend, seg_att, bucket)(
+                params, engine._seg_cache, toks, seg_ids, plens, slens)
+        elif op == "prefix_decode":
+            (_, needed, seg_att, positions, plens, seg_ids, active,
+             temps, key) = msg
+            engine._pool_cache, engine._pool_logits, _toks = (
+                engine._prefix_decode_for(needed, seg_att)(
+                    params, engine._pool_cache, engine._pool_logits,
+                    engine._seg_cache, positions, plens, seg_ids,
+                    active, temps, key))
         else:
             raise RuntimeError(f"unknown gang op {op!r}")
 

@@ -144,6 +144,57 @@ class TestServingGang:
             time.sleep(1.0)
         assert restarted, "gang did not come back after rank-0 SIGKILL"
 
+    def test_gang_shared_segments_parity(self, platform, tmp_path):
+        """Shared-prefix segments over the gang control stream: the
+        segment ops (creation prefill/merge, batched suffix admit,
+        prefix decode) replay on followers, token-identical to the
+        single-process segment engine — suffix-sized slots and all."""
+        snap = _snapshot(tmp_path)
+        rng = __import__("numpy").random.default_rng(0)
+        system = rng.integers(1, 200, size=24).tolist()
+        prompts = [system + rng.integers(1, 200, size=3).tolist()
+                   for _ in range(3)]
+        conf = {
+            "num_slots": 3, "decode_chunk": 2, "temperature": 0.0,
+            "max_new_tokens": 4, "seq_buckets": [16], "max_seq_len": 32,
+            "prefix_cache": False, "prefix_segments": 2,
+            "segment_len": 64, "min_prefix": 8, "warmup_groups": [],
+        }
+        # single-process TP=8 reference with the same knobs
+        import dataclasses
+
+        cfg, params = llamalib.load_pretrained(snap)
+        scfg = dataclasses.replace(cfg, max_seq_len=32)
+        ref = ContinuousEngine(
+            scfg, params, num_slots=3, decode_chunk=2, temperature=0.0,
+            eos_id=None, seq_buckets=[16], prefix_cache=False,
+            prefix_segments=2, segment_len=64, min_prefix=8,
+            mesh_axes={"model": 8})
+        try:
+            want = [ref.generate(p, max_new_tokens=4, timeout=300)
+                    for p in prompts]
+            assert ref.stats()["segments_live"] >= 1
+        finally:
+            ref.stop()
+
+        isvc = InferenceService(
+            metadata=ObjectMeta(name="seggang"),
+            spec=InferenceServiceSpec(predictor=ComponentSpec(
+                handler=(
+                    "kubeflow_tpu.serving.continuous:"
+                    "ContinuousLlamaGenerator"),
+                storage_uri=f"file://{snap}",
+                gang=GangSpec(
+                    hosts=2, mesh_axes={"model": 8}, chips_per_host=4),
+                config=conf,
+            )))
+        platform.store.create(isvc)
+        isvc = _wait_phase(platform.store, "seggang",
+                           InferenceServicePhase.READY)
+        got = [_predict(isvc.status.url, "seggang", [p])[0]
+               for p in prompts]
+        assert got == want
+
     def test_gang_channel_roundtrip(self):
         """Framing unit test: big numpy payloads survive the stream."""
         import threading
