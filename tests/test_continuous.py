@@ -440,3 +440,84 @@ class TestPerRequestTemperature:
             assert a["choices"][0]["text"] == b["choices"][0]["text"]
         finally:
             m.stop()
+
+
+class TestNTierEngine:
+    """r4 weak #7: the tiered pool generalized past two tiers — requests
+    route to the smallest pool whose KV buffer fits their known total,
+    and each capped tier's decode programs are structurally incapable of
+    reading past its cap."""
+
+    def _setup(self):
+        import jax
+        import jax.numpy as jnp
+        from flax import linen as nn
+
+        from kubeflow_tpu.models import llama as llamalib
+
+        cfg = llamalib.tiny()  # max_seq_len 128
+        params = nn.meta.unbox(llamalib.Llama(cfg).init(
+            jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32))["params"])
+        return cfg, params
+
+    def test_three_tier_routing_and_parity(self):
+        import jax
+
+        from kubeflow_tpu.serving.continuous import (
+            ContinuousEngine,
+            TieredEngine,
+        )
+
+        cfg, params = self._setup()
+        ref = ContinuousEngine(cfg, params, num_slots=3, decode_chunk=2,
+                               eos_id=None, prefix_cache=False)
+        prompts = [[1, 2, 3], [5] * 30, [9] * 70]
+        try:
+            want = [ref.generate(p, max_new_tokens=4) for p in prompts]
+        finally:
+            ref.stop()
+        eng = TieredEngine(
+            cfg, params, num_slots=6, tier_lens=[16, 64],
+            tier_slots=[2, 2], decode_chunk=2, eos_id=None,
+            prefix_cache=False)
+        try:
+            assert len(eng.pools) == 3
+            # tier caps bound each pool's KV buffer structurally
+            for pool, cap in zip(eng.pools, [16, 64, 128]):
+                big = [x for x in jax.tree.leaves(pool._pool_cache)
+                       if x.ndim >= 4]
+                assert all(x.shape[-3] == cap for x in big)
+            got = [eng.generate(p, max_new_tokens=4) for p in prompts]
+            st = eng.stats()
+            # one request landed in each tier (totals 7, 34, 74)
+            assert [d["tokens_emitted"] for d in st["pools"]] == [4, 4, 4]
+        finally:
+            eng.stop()
+        assert got == want
+
+    def test_build_engine_tier_lens(self):
+        from kubeflow_tpu.serving.continuous import TieredEngine, build_engine
+
+        cfg, params = self._setup()
+        eng = build_engine(cfg, params, {
+            "num_slots": 6, "tier_lens": [16, 64], "warmup_groups": [],
+            "prefix_cache": False})
+        try:
+            assert isinstance(eng, TieredEngine)
+            assert eng.caps == [16, 64]
+            out = eng.generate([1, 2, 3], max_new_tokens=3)
+            assert len(out) == 3
+        finally:
+            eng.stop()
+
+    def test_bad_tier_config_rejected(self):
+        import pytest
+
+        from kubeflow_tpu.serving.continuous import TieredEngine
+
+        cfg, params = self._setup()
+        with pytest.raises(ValueError, match="ascending"):
+            TieredEngine(cfg, params, tier_lens=[64, 16], num_slots=6)
+        with pytest.raises(ValueError, match="uncapped"):
+            TieredEngine(cfg, params, tier_lens=[16, 64],
+                         tier_slots=[3, 3], num_slots=6)
