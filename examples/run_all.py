@@ -1,7 +1,8 @@
 """Run the five BASELINE workload examples end-to-end on the local
 platform (the reference's stock-config parity demonstration).
 
-Usage: JAX_PLATFORMS=cpu python examples/run_all.py [mnist resnet bert bo llm]
+Usage: JAX_PLATFORMS=cpu python examples/run_all.py [mnist resnet bert bo
+llm lora gang]
 """
 
 from __future__ import annotations
@@ -69,22 +70,116 @@ def run_bo(platform, path):
           f"score={best['value']:.4f}")
 
 
+def run_lora(platform, _path):
+    """r5 UX: fine-tune a published snapshot with LoRA adapters on a
+    2-worker gang, publish the MB-scale adapter, serve base + adapter
+    merged — the reference's peft train() -> serve loop."""
+    import tempfile
+
+    from flax import linen as nn
+
+    from kubeflow_tpu.models import llama as llamalib
+
+    root = tempfile.mkdtemp(prefix="lora-demo-")
+    cfg = llamalib.tiny()
+    params = nn.meta.unbox(llamalib.Llama(cfg).init(
+        jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32))["params"])
+    base = os.path.join(root, "base")
+    llamalib.save_pretrained(base, cfg, params)
+    adapter = os.path.join(root, "adapter")
+
+    client = TrainingClient(platform)
+    job = client.train(
+        name="lora-demo", entrypoint="kubeflow_tpu.train.llm:train_main",
+        num_workers=2, model=f"file://{base}", lora_rank=8,
+        publish_to=adapter,
+        env={"KFT_STEPS": "3", "KFT_BATCH": "8", "KFT_SEQ_LEN": "16",
+             "KFT_LOG_EVERY": "1"},
+        timeout=300)
+    assert has_condition(job.status.conditions, "Succeeded")
+    kb = os.path.getsize(os.path.join(adapter, "adapter.msgpack")) / 1024
+    print(f"  lora-demo: Succeeded, adapter artifact {kb:.0f} KiB")
+
+    ks = KServeClient(platform.cluster)
+    ks.create(f"""
+kind: InferenceService
+metadata:
+  name: lora-serve
+spec:
+  predictor:
+    handler: kubeflow_tpu.serving.continuous:ContinuousLlamaGenerator
+    storage_uri: file://{base}
+    config:
+      adapter_path: {adapter}
+      num_slots: 2
+      decode_chunk: 2
+      max_new_tokens: 4
+      warmup_groups: []
+""")
+    ks.wait_isvc_ready("lora-serve", timeout=180)
+    toks = ks.predict("lora-serve", [[1, 2, 3]])[0]
+    print(f"  lora-serve: Ready (base+adapter merged), tokens={toks}")
+
+
+def run_gang(platform, _path):
+    """r5: a tensor-parallel predictor spanning TWO host processes,
+    placed and restarted as a JaxJob (predictor.gang)."""
+    import tempfile
+
+    from flax import linen as nn
+
+    from kubeflow_tpu.models import llama as llamalib
+
+    cfg = llamalib.tiny(num_heads=8, num_kv_heads=8)
+    params = nn.meta.unbox(llamalib.Llama(cfg).init(
+        jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32))["params"])
+    snap = os.path.join(tempfile.mkdtemp(prefix="gang-demo-"), "snap")
+    llamalib.save_pretrained(snap, cfg, params)
+    ks = KServeClient(platform.cluster)
+    ks.create(f"""
+kind: InferenceService
+metadata:
+  name: gang-serve
+spec:
+  predictor:
+    handler: kubeflow_tpu.serving.continuous:ContinuousLlamaGenerator
+    storage_uri: file://{snap}
+    gang:
+      hosts: 2
+      mesh_axes: {{model: 8}}
+      chips_per_host: 4
+    config:
+      num_slots: 2
+      decode_chunk: 2
+      max_new_tokens: 4
+      seq_buckets: [32]
+      prefix_cache: false
+      warmup_groups: [[1, 32]]
+""")
+    ks.wait_isvc_ready("gang-serve", timeout=300)
+    toks = ks.predict("gang-serve", [[1, 2, 3]])[0]
+    print(f"  gang-serve: Ready (TP=8 across 2 host processes), "
+          f"tokens={toks}")
+
+
 STEPS = {
     "mnist": ("01-jaxjob-mnist.yaml", run_job),
     "resnet": ("02-jaxjob-resnet-ddp.yaml", run_job),
     "bert": ("03-isvc-bert.yaml", run_bert),
     "bo": ("04-experiment-bo.yaml", run_bo),
     "llm": ("05-jaxjob-llm.yaml", run_job),
+    "lora": (None, run_lora),
+    "gang": (None, run_gang),
 }
 
 
 def main() -> None:
     want = sys.argv[1:] or list(STEPS)
-    with LocalPlatform(num_hosts=1, chips_per_host=4) as p:
+    with LocalPlatform(num_hosts=2, chips_per_host=4) as p:
         for key in want:
             path, fn = STEPS[key]
-            print(f"[{key}] {path}")
-            fn(p, os.path.join(HERE, path))
+            print(f"[{key}] {path or fn.__name__}")
+            fn(p, os.path.join(HERE, path) if path else None)
     print("ALL EXAMPLES PASSED")
 
 
