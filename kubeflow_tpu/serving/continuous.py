@@ -60,9 +60,12 @@ class Request:
 
     prompt: list[int]
     max_new_tokens: int
-    #: per-request sampling temperature (None = the engine's default;
-    #: 0 = greedy, >0 = categorical) — the OpenAI per-request field
+    #: per-request sampling knobs (None = the engine's defaults) — the
+    #: OpenAI fields: temperature (0 = greedy, >0 = categorical),
+    #: top_p (nucleus mass), top_k (candidate cutoff; 0 = off)
     temperature: Optional[float] = None
+    top_p: Optional[float] = None
+    top_k: Optional[int] = None
     submitted_at: float = field(default_factory=time.perf_counter)
     #: engine step counter when the request was submitted / admitted
     submitted_step: int = 0
@@ -285,7 +288,7 @@ def make_prefix_decode_program(cfg, attend: int, seg_att: int, chunk: int,
     wmodel = llamalib.Llama(cfg, decode_attend_len=attend)
 
     def decode(params, cache, logits, seg_cache, positions, plens,
-               seg_ids, active, temps, key):
+               seg_ids, active, temps, top_ps, top_ks, key):
         # positions are SLOT-LOCAL; the sentinel (max_seq_len) drops
         # writes exactly as in the plain program
         safe = jnp.where(active, positions, cfg.max_seq_len)
@@ -295,13 +298,7 @@ def make_prefix_decode_program(cfg, attend: int, seg_att: int, chunk: int,
 
         def step(carry, key):
             cache, logits, lpos = carry
-            greedy = jnp.argmax(logits, axis=-1)
-            sampled = jax.random.categorical(
-                key,
-                logits.astype(jnp.float32)
-                / jnp.maximum(temps, 1e-6)[:, None],
-                axis=-1)
-            tok = jnp.where(temps > 0, sampled, greedy).astype(jnp.int32)
+            tok = _sample_step(logits, temps, top_ps, top_ks, key)
             gpos = lpos + plens  # rope/causality are global
             l, mutated = wmodel.apply(
                 {"params": params, "cache": cache}, tok[:, None],
@@ -318,6 +315,56 @@ def make_prefix_decode_program(cfg, attend: int, seg_att: int, chunk: int,
         return cache, logits, shardedlib.constrain_replicated(toks.T, mesh)
 
     return shardedlib.mesh_jit(mesh, decode, donate_argnums=(1, 2))
+
+
+def _sample_step(logits, temps, top_ps, top_ks, key):
+    """One sampling decision for every slot — the OpenAI sampling
+    family, per request, in one dispatch:
+
+    - ``temps`` [slots] f32: 0 = greedy, >0 = categorical at T;
+    - ``top_ks`` [slots] i32: 0 = off, k = keep the k most likely;
+    - ``top_ps`` [slots] f32: 1 = off, p = nucleus (smallest set of
+      tokens whose cumulative probability reaches p).
+
+    HF-conventional warp order (temperature -> top-k -> top-p) on one
+    descending sort of the scaled logits; filters reduce to "keep values
+    >= a per-slot threshold", so the original layout never re-sorts.
+    Greedy slots ignore the filtered distribution entirely.
+    """
+    v = logits.shape[-1]
+    greedy = jnp.argmax(logits, axis=-1)
+    scaled = logits.astype(jnp.float32) / jnp.maximum(temps, 1e-6)[:, None]
+
+    def filtered(scaled):
+        sorted_desc = -jnp.sort(-scaled, axis=-1)         # [slots, v]
+        # top-k: values below the k-th largest drop (k = 0 -> keep all)
+        k_eff = jnp.where(top_ks > 0, jnp.clip(top_ks, 1, v), v)
+        kth = jnp.take_along_axis(
+            sorted_desc, (k_eff - 1)[:, None], axis=-1)   # [slots, 1]
+        ranks = jnp.arange(v, dtype=jnp.int32)[None, :]
+        sorted_k = jnp.where(ranks < k_eff[:, None], sorted_desc, -jnp.inf)
+        # top-p over the top-k-filtered distribution: keep tokens while
+        # the cumulative probability BEFORE them is < p (always keeps
+        # the top-1)
+        sp = jax.nn.softmax(sorted_k, axis=-1)
+        cum_before = jnp.cumsum(sp, axis=-1) - sp
+        keep = jnp.logical_and(
+            ranks < k_eff[:, None],
+            cum_before < jnp.clip(top_ps, 1e-6, 1.0)[:, None])
+        # threshold = smallest kept VALUE; original layout, no unsort
+        min_keep = jnp.min(
+            jnp.where(keep, sorted_desc, jnp.inf), axis=-1)[:, None]
+        return jnp.where(
+            jnp.logical_and(scaled >= min_keep, scaled >= kth),
+            scaled, -jnp.inf)
+
+    # the vocab sort costs ~9% of decode throughput (measured at 271M):
+    # lax.cond executes only the taken branch, so pools with no
+    # top-p/top-k request in flight pay nothing
+    need = jnp.any(jnp.logical_or(top_ks > 0, top_ps < 1.0))
+    final = jax.lax.cond(need, filtered, lambda s: s, scaled)
+    sampled = jax.random.categorical(key, final, axis=-1)
+    return jnp.where(temps > 0, sampled, greedy).astype(jnp.int32)
 
 
 def make_decode_program(cfg, attend: int, chunk: int, mesh=None):
@@ -337,18 +384,13 @@ def make_decode_program(cfg, attend: int, chunk: int, mesh=None):
     """
     wmodel = llamalib.Llama(cfg, decode_attend_len=attend)
 
-    def decode(params, cache, logits, positions, active, temps, key):
+    def decode(params, cache, logits, positions, active, temps,
+               top_ps, top_ks, key):
         safe = jnp.where(active, positions, cfg.max_seq_len)
 
         def step(carry, key):
             cache, logits, pos = carry
-            greedy = jnp.argmax(logits, axis=-1)
-            sampled = jax.random.categorical(
-                key,
-                logits.astype(jnp.float32)
-                / jnp.maximum(temps, 1e-6)[:, None],
-                axis=-1)
-            tok = jnp.where(temps > 0, sampled, greedy).astype(jnp.int32)
+            tok = _sample_step(logits, temps, top_ps, top_ks, key)
             l, mutated = wmodel.apply(
                 {"params": params, "cache": cache}, tok[:, None],
                 pos[:, None], decode=True, mutable=["cache"])
@@ -499,9 +541,11 @@ class ContinuousEngine:
         self._active = np.zeros(num_slots, dtype=bool)
         self._positions = np.zeros(num_slots, dtype=np.int32)
         self._remaining = np.zeros(num_slots, dtype=np.int64)
-        #: per-slot sampling temperature (0 = greedy) — requests override
-        #: the engine default (the OpenAI per-request temperature field)
+        #: per-slot sampling knobs (the OpenAI per-request fields):
+        #: temperature 0 = greedy; top_p 1 = off; top_k 0 = off
         self._temps = np.zeros(num_slots, dtype=np.float32)
+        self._top_ps = np.ones(num_slots, dtype=np.float32)
+        self._top_ks = np.zeros(num_slots, dtype=np.int32)
         self.step_counter = 0          # decode dispatches so far
         self.tokens_emitted = 0        # useful (delivered) tokens
         #: tokens decoded for requests already EOS-retired — the price of
@@ -795,6 +839,8 @@ class ContinuousEngine:
                 np.full(self.num_slots, self.cfg.max_seq_len, np.int32),
                 np.zeros(self.num_slots, bool),
                 np.zeros(self.num_slots, np.float32),
+                np.ones(self.num_slots, np.float32),
+                np.zeros(self.num_slots, np.int32),
                 np.asarray(jax.random.PRNGKey(0)))
             jax.block_until_ready(toks)
         if self.prefix_segments > 0:
@@ -827,6 +873,8 @@ class ContinuousEngine:
                     np.zeros(self.num_slots, np.int32),
                     np.zeros(self.num_slots, bool),
                     np.zeros(self.num_slots, np.float32),
+                    np.ones(self.num_slots, np.float32),
+                    np.zeros(self.num_slots, np.int32),
                     np.asarray(jax.random.PRNGKey(0))))
             jax.block_until_ready(toks)
         if self.prefix_cache:
@@ -856,6 +904,7 @@ class ContinuousEngine:
     def submit(
         self, prompt: list[int], max_new_tokens: Optional[int] = None,
         temperature: Optional[float] = None,
+        top_p: Optional[float] = None, top_k: Optional[int] = None,
     ) -> Request:
         req = Request(
             prompt=list(map(int, prompt)),
@@ -865,6 +914,8 @@ class ContinuousEngine:
                 self.default_max_new_tokens
                 if max_new_tokens is None else max_new_tokens),
             temperature=(None if temperature is None else float(temperature)),
+            top_p=(None if top_p is None else float(top_p)),
+            top_k=(None if top_k is None else int(top_k)),
         )
         req.submitted_step = self.step_counter
         with self._gate:
@@ -880,8 +931,11 @@ class ContinuousEngine:
 
     def generate(self, prompt: list[int], max_new_tokens: Optional[int] = None,
                  timeout: float = 120.0,
-                 temperature: Optional[float] = None) -> list[int]:
-        return self.submit(prompt, max_new_tokens, temperature).wait(timeout)
+                 temperature: Optional[float] = None,
+                 top_p: Optional[float] = None,
+                 top_k: Optional[int] = None) -> list[int]:
+        return self.submit(prompt, max_new_tokens, temperature,
+                           top_p=top_p, top_k=top_k).wait(timeout)
 
     def stats(self) -> dict:
         """Engine observability snapshot (exported as Prometheus gauges
@@ -1080,6 +1134,8 @@ class ContinuousEngine:
         self._remaining[slot] = req.max_new_tokens
         self._temps[slot] = (self.temperature if req.temperature is None
                              else req.temperature)
+        self._top_ps[slot] = 1.0 if req.top_p is None else req.top_p
+        self._top_ks[slot] = 0 if req.top_k is None else req.top_k
         if plen > 0:
             self._slot_plen[slot] = plen
             self._slot_seg[slot] = seg
@@ -1285,13 +1341,15 @@ class ContinuousEngine:
                         self.params, self._pool_cache, self._pool_logits,
                         self._seg_cache, self._positions.copy(), plens,
                         self._slot_seg.astype(np.int32).copy(),
-                        self._active.copy(), self._temps.copy(), key))
+                        self._active.copy(), self._temps.copy(),
+                        self._top_ps.copy(), self._top_ks.copy(), key))
             else:
                 self._pool_cache, self._pool_logits, toks = self._decode_for(
                     needed)(
                     self.params, self._pool_cache, self._pool_logits,
                     self._positions.copy(), self._active.copy(),
-                    self._temps.copy(), key)
+                    self._temps.copy(), self._top_ps.copy(),
+                    self._top_ks.copy(), key)
             # advance the value-independent schedule NOW so the next chunk
             # can dispatch before this one's tokens are fetched
             for slot, req, take in snapshot:
@@ -1431,13 +1489,15 @@ class TieredEngine:
         return self.pools[-1]
 
     def submit(self, prompt, max_new_tokens=None,
-               temperature=None) -> Request:
+               temperature=None, top_p=None, top_k=None) -> Request:
         return self._route(prompt, max_new_tokens).submit(
-            prompt, max_new_tokens, temperature)
+            prompt, max_new_tokens, temperature, top_p=top_p, top_k=top_k)
 
     def generate(self, prompt, max_new_tokens=None,
-                 timeout: float = 120.0, temperature=None) -> list[int]:
-        return self.submit(prompt, max_new_tokens, temperature).wait(timeout)
+                 timeout: float = 120.0, temperature=None,
+                 top_p=None, top_k=None) -> list[int]:
+        return self.submit(prompt, max_new_tokens, temperature,
+                           top_p=top_p, top_k=top_k).wait(timeout)
 
     def warmup(self, groups=None) -> None:
         for pool in self.pools:

@@ -245,17 +245,20 @@ class GangEngine(contlib.ContinuousEngine):
         def decode_for(needed: int):
             prog = decode_inner(needed)
 
-            def call(params, cache, logits, positions, active, temps, key):
+            def call(params, cache, logits, positions, active, temps,
+                     top_ps, top_ks, key):
                 try:
                     positions = np.asarray(positions)
                     active = np.asarray(active)
                     temps = np.asarray(temps)
+                    top_ps = np.asarray(top_ps)
+                    top_ks = np.asarray(top_ks)
                     key = np.asarray(key)
                     ch.publish(
                         ("decode", int(needed), positions, active, temps,
-                         key))
+                         top_ps, top_ks, key))
                     return prog(params, cache, logits, positions, active,
-                                temps, key)
+                                temps, top_ps, top_ks, key)
                 except Exception as e:  # noqa: BLE001
                     raise self._fatal(e)
 
@@ -348,20 +351,24 @@ class GangEngine(contlib.ContinuousEngine):
                 prog = pdecode_inner(needed, seg_att)
 
                 def call(params, cache, logits, seg_cache, positions,
-                         plens, seg_ids, active, temps, key):
+                         plens, seg_ids, active, temps, top_ps, top_ks,
+                         key):
                     try:
                         positions = np.asarray(positions)
                         plens = np.asarray(plens)
                         seg_ids = np.asarray(seg_ids)
                         active = np.asarray(active)
                         temps = np.asarray(temps)
+                        top_ps = np.asarray(top_ps)
+                        top_ks = np.asarray(top_ks)
                         key = np.asarray(key)
                         ch.publish(("prefix_decode", int(needed),
                                     int(seg_att), positions, plens,
-                                    seg_ids, active, temps, key))
+                                    seg_ids, active, temps, top_ps,
+                                    top_ks, key))
                         return prog(params, cache, logits, seg_cache,
                                     positions, plens, seg_ids, active,
-                                    temps, key)
+                                    temps, top_ps, top_ks, key)
                     except Exception as e:  # noqa: BLE001
                         raise self._fatal(e)
 
@@ -411,11 +418,11 @@ def follow(engine: contlib.ContinuousEngine, channel: GangChannel) -> None:
                 row_cache, row_logits, slots)
             row = None
         elif op == "decode":
-            _, needed, positions, active, temps, key = msg
+            _, needed, positions, active, temps, top_ps, top_ks, key = msg
             engine._pool_cache, engine._pool_logits, _toks = (
                 engine._decode_for(needed)(
                     params, engine._pool_cache, engine._pool_logits,
-                    positions, active, temps, key))
+                    positions, active, temps, top_ps, top_ks, key))
         elif op == "prefix":
             _, total, sb, src, dst, lp, suffix, slen = msg
             engine._pool_cache, engine._pool_logits = (
@@ -439,12 +446,12 @@ def follow(engine: contlib.ContinuousEngine, channel: GangChannel) -> None:
                 params, engine._seg_cache, toks, seg_ids, plens, slens)
         elif op == "prefix_decode":
             (_, needed, seg_att, positions, plens, seg_ids, active,
-             temps, key) = msg
+             temps, top_ps, top_ks, key) = msg
             engine._pool_cache, engine._pool_logits, _toks = (
                 engine._prefix_decode_for(needed, seg_att)(
                     params, engine._pool_cache, engine._pool_logits,
                     engine._seg_cache, positions, plens, seg_ids,
-                    active, temps, key))
+                    active, temps, top_ps, top_ks, key))
         else:
             raise RuntimeError(f"unknown gang op {op!r}")
 

@@ -153,10 +153,11 @@ class TextGenerator(Model):
             prompt = inst.get("prompt", "")
             max_new = inst.get("max_tokens")
             temp = inst.get("temperature")
+            tp, tk = inst.get("top_p"), inst.get("top_k")
         else:
-            prompt, max_new, temp = str(inst), None, None
+            prompt, max_new, temp, tp, tk = str(inst), None, None, None, None
         return self.engine.submit(self.tokenizer.encode(prompt), max_new,
-                                  temperature=temp)
+                                  temperature=temp, top_p=tp, top_k=tk)
 
     def predict_batch(self, instances):
         assert self.engine is not None, "model not loaded"
@@ -184,9 +185,10 @@ class TextGenerator(Model):
             prompts = [prompts]
         max_tokens = payload.get("max_tokens")
         temp = payload.get("temperature")
+        tp, tk = payload.get("top_p"), payload.get("top_k")
         reqs = [
             self.engine.submit(self.tokenizer.encode(str(p)), max_tokens,
-                               temperature=temp)
+                               temperature=temp, top_p=tp, top_k=tk)
             for p in prompts
         ]
         sent = [""] * len(reqs)
@@ -238,9 +240,10 @@ class TextGenerator(Model):
             prompts = [prompts]
         max_tokens = payload.get("max_tokens")
         temp = payload.get("temperature")
+        tp, tk = payload.get("top_p"), payload.get("top_k")
         reqs = [
             self.engine.submit(self.tokenizer.encode(p), max_tokens,
-                               temperature=temp)
+                               temperature=temp, top_p=tp, top_k=tk)
             for p in prompts
         ]
         try:

@@ -521,3 +521,101 @@ class TestNTierEngine:
         with pytest.raises(ValueError, match="uncapped"):
             TieredEngine(cfg, params, tier_lens=[16, 64],
                          tier_slots=[3, 3], num_slots=6)
+
+
+class TestSamplingFilters:
+    """Per-request top_p / top_k (the OpenAI sampling family) — HF warp
+    order temperature -> top-k -> top-p, per slot, in one dispatch;
+    greedy-only pools skip the vocab sort via lax.cond."""
+
+    def _engine(self):
+        import jax
+        import jax.numpy as jnp
+        from flax import linen as nn
+
+        from kubeflow_tpu.models import llama as llamalib
+        from kubeflow_tpu.serving.continuous import ContinuousEngine
+
+        cfg = llamalib.tiny()
+        params = nn.meta.unbox(llamalib.Llama(cfg).init(
+            jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32))["params"])
+        return cfg, params, ContinuousEngine(
+            cfg, params, num_slots=4, decode_chunk=2, eos_id=None,
+            prefix_cache=False)
+
+    def test_degenerate_filters_equal_greedy(self):
+        _, _, eng = self._engine()
+        try:
+            greedy = eng.generate([1, 2, 3], max_new_tokens=5)
+            k1 = eng.generate([1, 2, 3], max_new_tokens=5,
+                              temperature=0.8, top_k=1)
+            p0 = eng.generate([1, 2, 3], max_new_tokens=5,
+                              temperature=0.8, top_p=1e-6)
+        finally:
+            eng.stop()
+        assert k1 == greedy
+        assert p0 == greedy
+
+    def test_top_k_restricts_support(self):
+        import jax.numpy as jnp
+        import numpy as np
+
+        from kubeflow_tpu.models import llama as llamalib
+
+        cfg, params, eng = self._engine()
+        try:
+            logits = llamalib.Llama(cfg).apply(
+                {"params": params}, jnp.asarray([[1, 2, 3]], jnp.int32))
+            top5 = set(np.asarray(
+                logits[0, -1], np.float32).argsort()[-5:].tolist())
+            outs = {eng.generate([1, 2, 3], max_new_tokens=1,
+                                 temperature=5.0, top_k=5)[0]
+                    for _ in range(12)}
+            # all sampled tokens inside the top-5 support, and the
+            # filter actually bites (unfiltered T=5 escapes it)
+            wild = {eng.generate([1, 2, 3], max_new_tokens=1,
+                                 temperature=5.0)[0] for _ in range(12)}
+        finally:
+            eng.stop()
+        assert outs <= top5, (sorted(outs), sorted(top5))
+        assert len(outs) > 1  # still sampling, not collapsed to greedy
+        assert not (wild <= top5)
+
+    def test_mixed_slots_one_dispatch(self):
+        """Greedy, top-k and unfiltered requests coexist in one pool:
+        the greedy request's tokens must be bit-stable regardless of
+        its neighbors' sampling settings."""
+        _, _, eng = self._engine()
+        try:
+            want = eng.generate([1, 2, 3], max_new_tokens=4)
+            reqs = [
+                eng.submit([1, 2, 3], max_new_tokens=4),
+                eng.submit([4, 5, 6], max_new_tokens=4,
+                           temperature=2.0, top_k=3),
+                eng.submit([7, 8, 9], max_new_tokens=4, temperature=1.5),
+            ]
+            outs = [r.wait(300) for r in reqs]
+        finally:
+            eng.stop()
+        assert outs[0] == want
+        assert all(len(o) == 4 for o in outs)
+
+    def test_openai_payload_passthrough(self):
+        from kubeflow_tpu.serving.storage import register_mem
+        from kubeflow_tpu.serving.text import TextGenerator
+
+        cfg, params, eng = self._engine()
+        eng.stop()
+        ref = register_mem("samplellama", (cfg, params))
+        m = TextGenerator("t", {"params_ref": ref, "max_new_tokens": 4,
+                                "warmup_groups": []})
+        m.start()
+        try:
+            out = m.openai_completions({
+                "prompt": "ab", "max_tokens": 4,
+                "temperature": 0.9, "top_p": 0.01, "top_k": 1})
+            greedy = m.openai_completions({"prompt": "ab", "max_tokens": 4})
+            assert (out["choices"][0]["text"]
+                    == greedy["choices"][0]["text"])
+        finally:
+            m.stop()
