@@ -494,8 +494,18 @@ def serve_main(ctx: bootstrap.PodContext) -> None:
         groups = conf.get("warmup_groups")
         if groups != []:
             engine.warmup([tuple(g) for g in groups] if groups else None)
-        model = contlib.ContinuousLlamaGenerator(
-            conf.get("model_name", "model"), conf, engine=engine)
+        if conf.get("runtime") == "text":
+            # OpenAI completions on a multi-host predictor: rank 0 owns
+            # the tokenizer + /openai/v1/completions surface; set eos_id
+            # in the config for stop-token behavior (the engine is built
+            # before the tokenizer here)
+            from .text import TextGenerator
+
+            model = TextGenerator(
+                conf.get("model_name", "model"), conf, engine=engine)
+        else:
+            model = contlib.ContinuousLlamaGenerator(
+                conf.get("model_name", "model"), conf, engine=engine)
         server = ModelServer(port=int(conf["serve_port"]))
         server.register(model)
         # the frontend port is stable across gang restarts; the previous

@@ -112,26 +112,28 @@ class TextGenerator(Model):
 
     self_batching = True
 
-    def __init__(self, name: str, config: Optional[dict[str, Any]] = None):
+    def __init__(self, name: str, config: Optional[dict[str, Any]] = None,
+                 engine=None):
         super().__init__(name, config)
-        self.engine = None
+        #: a prebuilt engine (the serving gang's rank-0 GangEngine) —
+        #: load() then attaches only the tokenizer: OpenAI completions
+        #: on a multi-host predictor
+        self.engine = engine
         self.tokenizer = None
 
     def load(self) -> None:
-        from .continuous import build_engine
+        from .continuous import build_engine, resolve_model_source
 
         self.tokenizer = resolve_tokenizer(self.config)
-        ref = self.config.get("params_ref")
-        if ref:
-            cfg, params = fetch_mem(ref[len("mem://"):])
-        elif self.config.get("storage_path"):
-            from ..models import llama as llamalib
-
-            cfg, params = llamalib.load_pretrained(
-                self.config["storage_path"])
-        else:
-            raise RuntimeError(
-                f"model {self.name}: need params_ref or storage_uri")
+        if self.engine is not None:
+            if getattr(self.tokenizer, "vocab_size", 0) > \
+                    self.engine.cfg.vocab_size:
+                raise ValueError(
+                    f"tokenizer needs vocab {self.tokenizer.vocab_size} "
+                    f"but the model has {self.engine.cfg.vocab_size}")
+            self.ready = True
+            return
+        cfg, params = resolve_model_source(self.config, name=self.name)
         if getattr(self.tokenizer, "vocab_size", 0) > cfg.vocab_size:
             raise ValueError(
                 f"tokenizer needs vocab {self.tokenizer.vocab_size} but the "

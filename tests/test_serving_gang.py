@@ -225,3 +225,50 @@ class TestServingGang:
         op, needed, arr = out["msgs"][0]
         assert (op, needed) == ("decode", 128)
         assert np.array_equal(arr, big)
+
+
+@pytest.mark.e2e
+class TestGangOpenAI:
+    def test_openai_completions_on_gang(self, platform, tmp_path):
+        """The OpenAI surface on a multi-host predictor: rank 0 serves
+        /openai/v1/completions with the byte tokenizer over the gang
+        engine; text equals the single-process TP=8 text path."""
+        snap = _snapshot(tmp_path)
+        conf = {**ENGINE_CONF, "runtime": "text", "tokenizer": "bytes"}
+
+        from kubeflow_tpu.serving.storage import register_mem
+        from kubeflow_tpu.serving.text import TextGenerator
+
+        cfg, params = llamalib.load_pretrained(snap)
+        ref_key = register_mem("gangtext", (cfg, params))
+        single = TextGenerator("s", {
+            "params_ref": ref_key, "tokenizer": "bytes",
+            "mesh_axes": {"model": 8}, **{
+                k: v for k, v in ENGINE_CONF.items()}})
+        single.start()
+        try:
+            want = single.openai_completions(
+                {"prompt": "hi", "max_tokens": 4})["choices"][0]["text"]
+        finally:
+            single.stop()
+
+        isvc = InferenceService(
+            metadata=ObjectMeta(name="oaigang"),
+            spec=InferenceServiceSpec(predictor=ComponentSpec(
+                handler="kubeflow_tpu.serving.text:TextGenerator",
+                storage_uri=f"file://{snap}",
+                gang=GangSpec(
+                    hosts=2, mesh_axes={"model": 8}, chips_per_host=4),
+                config=conf,
+            )))
+        platform.store.create(isvc)
+        isvc = _wait_phase(platform.store, "oaigang",
+                           InferenceServicePhase.READY)
+        req = urllib.request.Request(
+            f"{isvc.status.url}/openai/v1/completions",
+            data=json.dumps({"model": "oaigang", "prompt": "hi",
+                             "max_tokens": 4}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=300) as resp:
+            body = json.loads(resp.read())
+        assert body["choices"][0]["text"] == want
