@@ -196,6 +196,7 @@ class TextGenerator(Model):
         sent = [""] * len(reqs)
         finished = [False] * len(reqs)
         model = payload.get("model", self.name)
+        stops = self._stop_sequences(payload)
         try:
             while not all(finished):
                 progressed = False
@@ -204,6 +205,19 @@ class TextGenerator(Model):
                         continue
                     done = req.done.is_set()
                     full = self.tokenizer.decode(list(req.tokens))
+                    if stops:
+                        # OpenAI ``stop`` while streaming: truncate at the
+                        # earliest stop sequence and end this choice (its
+                        # slot frees at the next chunk boundary).  Never
+                        # truncate BEHIND already-sent text — a stop that
+                        # straddled an emitted boundary can't be unsent,
+                        # so the choice just ends where it stands.
+                        cut, hit = self._apply_stop(full, stops)
+                        if hit:
+                            full = cut if len(cut) >= len(sent[i]) \
+                                else sent[i]
+                            done = True
+                            req.cancel()
                     if done:
                         # final decode is authoritative; flush everything
                         delta = (full[len(sent[i]):]
@@ -243,10 +257,13 @@ class TextGenerator(Model):
         max_tokens = payload.get("max_tokens")
         temp = payload.get("temperature")
         tp, tk = payload.get("top_p"), payload.get("top_k")
+        # OpenAI ``n``: independent samples per prompt — each is its own
+        # engine request, coalescing in the slot pool like any burst
+        n = max(1, int(payload.get("n", 1)))
         reqs = [
             self.engine.submit(self.tokenizer.encode(p), max_tokens,
                                temperature=temp, top_p=tp, top_k=tk)
-            for p in prompts
+            for p in prompts for _ in range(n)
         ]
         try:
             return self._collect_completions(payload, reqs)
@@ -257,18 +274,41 @@ class TextGenerator(Model):
                 if not r.done.is_set():
                     r.cancel()
 
+    @staticmethod
+    def _stop_sequences(payload) -> list[str]:
+        stop = payload.get("stop")
+        if stop is None:
+            return []
+        return [stop] if isinstance(stop, str) else [str(x) for x in stop]
+
+    def _apply_stop(self, text: str, stops: list[str]):
+        """OpenAI ``stop``: truncate at the EARLIEST stop sequence (the
+        sequence itself excluded).  Returns (text, hit)."""
+        cut = None
+        for ss in stops:
+            if not ss:
+                continue
+            i = text.find(ss)
+            if i >= 0 and (cut is None or i < cut):
+                cut = i
+        return (text if cut is None else text[:cut]), cut is not None
+
     def _collect_completions(self, payload, reqs) -> dict:
+        stops = self._stop_sequences(payload)
         choices = []
         completion_tokens = 0
         for i, r in enumerate(reqs):
             ids = r.wait(300.0)
             completion_tokens += len(ids)  # TOKENS, not decoded chars
+            text = self.tokenizer.decode(ids)
+            text, stop_hit = self._apply_stop(text, stops)
+            eos_hit = (self.engine.eos_id is not None and ids
+                       and ids[-1] == self.engine.eos_id)
             choices.append({
                 "index": i,
-                "text": self.tokenizer.decode(ids),
+                "text": text,
                 "finish_reason": (
-                    "stop" if self.engine.eos_id is not None
-                    and ids and ids[-1] == self.engine.eos_id else "length"),
+                    "stop" if (stop_hit or eos_hit) else "length"),
             })
         return {
             "object": "text_completion",

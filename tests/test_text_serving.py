@@ -178,3 +178,73 @@ class TestTieredTextServing:
             assert out["usage"]["completion_tokens"] >= 1
         finally:
             m.stop()
+
+
+class TestOpenAiStopAndN:
+    def _model(self):
+        import jax
+        import jax.numpy as jnp
+
+        from kubeflow_tpu.models import llama as llamalib
+        from kubeflow_tpu.serving.storage import register_mem
+        from kubeflow_tpu.serving.text import TextGenerator
+
+        cfg = llamalib.tiny()
+        params = llamalib.Llama(cfg).init(
+            jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32))["params"]
+        ref = register_mem("stopllama", (cfg, params))
+        m = TextGenerator("t", {"params_ref": ref, "max_new_tokens": 6,
+                                "warmup_groups": []})
+        m.start()
+        return m
+
+    def test_stop_sequence_truncates(self):
+        m = self._model()
+        try:
+            base = m.openai_completions({"prompt": "ab", "max_tokens": 6})
+            text = base["choices"][0]["text"]
+            assert len(text) >= 2
+            stop_seq = text[1]  # guaranteed to occur
+            out = m.openai_completions({
+                "prompt": "ab", "max_tokens": 6, "stop": stop_seq})
+            c = out["choices"][0]
+            assert c["text"] == text.split(stop_seq)[0]
+            assert c["finish_reason"] == "stop"
+            # list form + no-hit stop keeps full text with length reason
+            out2 = m.openai_completions({
+                "prompt": "ab", "max_tokens": 6, "stop": ["\x00zz"]})
+            assert out2["choices"][0]["text"] == text
+            assert out2["choices"][0]["finish_reason"] == "length"
+        finally:
+            m.stop()
+
+    def test_n_choices(self):
+        m = self._model()
+        try:
+            out = m.openai_completions({
+                "prompt": "ab", "max_tokens": 4, "n": 3})
+            assert len(out["choices"]) == 3
+            assert [c["index"] for c in out["choices"]] == [0, 1, 2]
+            # greedy: all three samples identical; with temperature they
+            # are independent draws
+            assert len({c["text"] for c in out["choices"]}) == 1
+        finally:
+            m.stop()
+
+    def test_streaming_stop(self):
+        m = self._model()
+        try:
+            base = m.openai_completions({"prompt": "ab", "max_tokens": 6})
+            text = base["choices"][0]["text"]
+            stop_seq = text[2]
+            chunks = list(m.openai_stream({
+                "prompt": "ab", "max_tokens": 6, "stop": stop_seq}))
+            import json as jsonlib
+
+            body = "".join(
+                jsonlib.loads(c[len(b"data: "):].decode())["choices"][0]
+                ["text"]
+                for c in chunks if c.startswith(b"data: {"))
+            assert body == text.split(stop_seq)[0]
+        finally:
+            m.stop()
