@@ -383,12 +383,18 @@ class ModelServer:
             name = path[len("/v2/models/"):-len("/infer")]
             self._predict_v2(h, name, payload)
             return
-        # OpenAI completions (huggingfaceserver parity): routed to models
-        # that implement openai_completions (serving/text.py)
-        if path == "/openai/v1/completions":
+        # OpenAI completions + chat completions (huggingfaceserver
+        # parity): routed to models implementing openai_completions /
+        # openai_chat (serving/text.py)
+        if path in ("/openai/v1/completions",
+                    "/openai/v1/chat/completions"):
+            chat = path.endswith("/chat/completions")
+            call_attr = "openai_chat" if chat else "openai_completions"
+            stream_attr = ("openai_chat_stream" if chat
+                           else "openai_stream")
             name = payload.get("model", "")
             m = self._models.get(name)
-            if m is None or not hasattr(m, "openai_completions"):
+            if m is None or not hasattr(m, call_attr):
                 h._send(404, {"error": f"no completions model {name!r}"})
                 return
             t0 = time.perf_counter()
@@ -396,20 +402,20 @@ class ModelServer:
                 self.metrics.inflight += 1
             streaming = False  # SSE headers already on the wire?
             try:
-                if payload.get("stream") and hasattr(m, "openai_stream"):
+                if payload.get("stream") and hasattr(m, stream_attr):
                     # SSE: tokens stream as the engine emits decode chunks
                     h.send_response(200)
                     h.send_header("Content-Type", "text/event-stream")
                     h.send_header("Cache-Control", "no-cache")
                     h.end_headers()
                     streaming = True
-                    for chunk in m.openai_stream(payload):
+                    for chunk in getattr(m, stream_attr)(payload):
                         h.wfile.write(chunk)
                         h.wfile.flush()
                     self.metrics.observe(
                         name, time.perf_counter() - t0, error=False)
                     return
-                out = m.openai_completions(payload)
+                out = getattr(m, call_attr)(payload)
                 self.metrics.observe(name, time.perf_counter() - t0, error=False)
                 h._send(200, out)
             except BrokenPipeError:

@@ -293,6 +293,61 @@ class TextGenerator(Model):
                 cut = i
         return (text if cut is None else text[:cut]), cut is not None
 
+    # -- OpenAI chat completions ------------------------------------------
+
+    def _chat_prompt(self, messages: list) -> str:
+        """Messages -> one prompt string: the tokenizer's own chat
+        template when it has one (HF tokenizers), else a transparent
+        role-tagged transcript ending with the assistant cue."""
+        tok = getattr(self.tokenizer, "_tok", None)
+        if tok is not None and getattr(tok, "chat_template", None):
+            return tok.apply_chat_template(
+                messages, tokenize=False, add_generation_prompt=True)
+        lines = [
+            f"{m.get('role', 'user')}: {m.get('content', '')}"
+            for m in messages
+        ]
+        return "\n".join(lines) + "\nassistant:"
+
+    def openai_chat(self, payload: dict) -> dict:
+        """``POST /openai/v1/chat/completions`` — rendered through the
+        chat template onto the same engine path as completions (stop, n,
+        temperature/top_p/top_k all apply)."""
+        comp = {**payload,
+                "prompt": self._chat_prompt(payload.get("messages", []))}
+        out = self.openai_completions(comp)
+        return {
+            "object": "chat.completion",
+            "model": out["model"],
+            "choices": [{
+                "index": c["index"],
+                "message": {"role": "assistant", "content": c["text"]},
+                "finish_reason": c["finish_reason"],
+            } for c in out["choices"]],
+            "usage": out["usage"],
+        }
+
+    def openai_chat_stream(self, payload: dict):
+        """``stream: true`` chat — completions chunks re-labeled as
+        chat.completion.chunk deltas."""
+        import json as jsonlib
+
+        comp = {**payload,
+                "prompt": self._chat_prompt(payload.get("messages", []))}
+        for chunk in self.openai_stream(comp):
+            if not chunk.startswith(b"data: {"):
+                yield chunk
+                continue
+            d = jsonlib.loads(chunk[len(b"data: "):])
+            yield ("data: " + jsonlib.dumps({
+                "object": "chat.completion.chunk",
+                "model": d["model"],
+                "choices": [{
+                    "index": c["index"],
+                    "delta": {"content": c["text"]},
+                } for c in d["choices"]],
+            }) + "\n\n").encode()
+
     def _collect_completions(self, payload, reqs) -> dict:
         stops = self._stop_sequences(payload)
         choices = []

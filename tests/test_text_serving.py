@@ -248,3 +248,81 @@ class TestOpenAiStopAndN:
             assert body == text.split(stop_seq)[0]
         finally:
             m.stop()
+
+
+class TestOpenAiChat:
+    def _model(self):
+        import jax
+        import jax.numpy as jnp
+
+        from kubeflow_tpu.models import llama as llamalib
+        from kubeflow_tpu.serving.storage import register_mem
+        from kubeflow_tpu.serving.text import TextGenerator
+
+        cfg = llamalib.tiny()
+        params = llamalib.Llama(cfg).init(
+            jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32))["params"]
+        ref = register_mem("chatllama", (cfg, params))
+        m = TextGenerator("c", {"params_ref": ref, "max_new_tokens": 4,
+                                "warmup_groups": []})
+        m.start()
+        return m
+
+    def test_chat_equals_templated_completion(self):
+        m = self._model()
+        try:
+            messages = [{"role": "system", "content": "be brief"},
+                        {"role": "user", "content": "hi"}]
+            chat = m.openai_chat({"messages": messages, "max_tokens": 4})
+            comp = m.openai_completions({
+                "prompt": m._chat_prompt(messages), "max_tokens": 4})
+            c = chat["choices"][0]
+            assert chat["object"] == "chat.completion"
+            assert c["message"]["role"] == "assistant"
+            assert c["message"]["content"] == comp["choices"][0]["text"]
+            assert "finish_reason" in c
+        finally:
+            m.stop()
+
+    def test_chat_stream_chunks(self):
+        import json as jsonlib
+
+        m = self._model()
+        try:
+            messages = [{"role": "user", "content": "hi"}]
+            full = m.openai_chat({"messages": messages, "max_tokens": 4})
+            chunks = list(m.openai_chat_stream(
+                {"messages": messages, "max_tokens": 4}))
+            body = "".join(
+                jsonlib.loads(c[len(b"data: "):].decode())["choices"][0]
+                ["delta"]["content"]
+                for c in chunks if c.startswith(b"data: {"))
+            assert body == full["choices"][0]["message"]["content"]
+            assert chunks[-1] == b"data: [DONE]\n\n"
+        finally:
+            m.stop()
+
+    def test_chat_route_over_http(self):
+        import json as jsonlib
+        import urllib.request
+
+        from kubeflow_tpu.serving.server import ModelServer
+
+        m = self._model()
+        srv = ModelServer()
+        srv.register(m)
+        srv.start()
+        try:
+            req = urllib.request.Request(
+                srv.url + "/openai/v1/chat/completions",
+                data=jsonlib.dumps({
+                    "model": "c",
+                    "messages": [{"role": "user", "content": "hi"}],
+                    "max_tokens": 4}).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=60) as resp:
+                out = jsonlib.loads(resp.read())
+            assert out["object"] == "chat.completion"
+            assert out["choices"][0]["message"]["content"]
+        finally:
+            srv.stop()
