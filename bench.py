@@ -168,7 +168,7 @@ def bench_serving() -> None:
     rng = np.random.default_rng(0)
     prompts = rng.integers(
         1, cfg.vocab_size, size=(8, 128)).tolist()
-    eng = ContinuousEngine(cfg, params, num_slots=9, decode_chunk=16,
+    eng = ContinuousEngine(cfg, params, num_slots=8, decode_chunk=16,
                            pipeline_depth=3, prefix_cache=False)
     try:
         eng.warmup([(8, 128), (1, 128)])

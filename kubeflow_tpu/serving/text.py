@@ -24,7 +24,6 @@ from __future__ import annotations
 from typing import Any, Optional
 
 from .model import Model
-from .storage import fetch_mem
 
 
 class ByteTokenizer:
@@ -131,6 +130,12 @@ class TextGenerator(Model):
                 raise ValueError(
                     f"tokenizer needs vocab {self.tokenizer.vocab_size} "
                     f"but the model has {self.engine.cfg.vocab_size}")
+            if self.engine.eos_id is None:
+                # the gang builds the engine before the tokenizer exists;
+                # default the stop token the same way the standalone path
+                # does, or gang and in-process deployments of one config
+                # would stop differently
+                self.engine.eos_id = getattr(self.tokenizer, "eos_id", None)
             self.ready = True
             return
         cfg, params = resolve_model_source(self.config, name=self.name)
@@ -188,10 +193,11 @@ class TextGenerator(Model):
         max_tokens = payload.get("max_tokens")
         temp = payload.get("temperature")
         tp, tk = payload.get("top_p"), payload.get("top_k")
+        n = max(1, int(payload.get("n", 1)))  # same fan-out as blocking
         reqs = [
             self.engine.submit(self.tokenizer.encode(str(p)), max_tokens,
                                temperature=temp, top_p=tp, top_k=tk)
-            for p in prompts
+            for p in prompts for _ in range(n)
         ]
         sent = [""] * len(reqs)
         finished = [False] * len(reqs)
@@ -348,12 +354,36 @@ class TextGenerator(Model):
                 } for c in d["choices"]],
             }) + "\n\n").encode()
 
+    def _wait_with_stops(self, r, stops: list[str]) -> list[int]:
+        """Wait for a request, but with stop sequences the wait POLLS and
+        cancels at the first hit — a stop at token 3 must not hold a
+        decode slot (or the client) for the remaining max_tokens."""
+        if not stops:
+            return r.wait(300.0)
+        import time as timelib
+
+        deadline = timelib.monotonic() + 300.0
+        while True:
+            done = r.done.is_set()
+            ids = list(r.tokens)
+            _, hit = self._apply_stop(self.tokenizer.decode(ids), stops)
+            if hit:
+                r.cancel()
+                return ids
+            if done:
+                if r.error is not None:
+                    raise r.error
+                return ids
+            if timelib.monotonic() > deadline:
+                raise TimeoutError("generation did not complete in time")
+            timelib.sleep(0.02)
+
     def _collect_completions(self, payload, reqs) -> dict:
         stops = self._stop_sequences(payload)
         choices = []
         completion_tokens = 0
         for i, r in enumerate(reqs):
-            ids = r.wait(300.0)
+            ids = self._wait_with_stops(r, stops)
             completion_tokens += len(ids)  # TOKENS, not decoded chars
             text = self.tokenizer.decode(ids)
             text, stop_hit = self._apply_stop(text, stops)

@@ -326,3 +326,32 @@ class TestOpenAiChat:
             assert out["choices"][0]["message"]["content"]
         finally:
             srv.stop()
+
+
+class TestStreamN:
+    def test_streaming_n_choices(self):
+        import jax
+        import jax.numpy as jnp
+        import json as jsonlib
+
+        from kubeflow_tpu.models import llama as llamalib
+        from kubeflow_tpu.serving.storage import register_mem
+        from kubeflow_tpu.serving.text import TextGenerator
+
+        cfg = llamalib.tiny()
+        params = llamalib.Llama(cfg).init(
+            jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32))["params"]
+        ref = register_mem("streamn", (cfg, params))
+        m = TextGenerator("s", {"params_ref": ref, "max_new_tokens": 4,
+                                "warmup_groups": []})
+        m.start()
+        try:
+            chunks = list(m.openai_stream(
+                {"prompt": "ab", "max_tokens": 4, "n": 3}))
+            idx = {
+                jsonlib.loads(c[len(b"data: "):].decode())["choices"][0]
+                ["index"]
+                for c in chunks if c.startswith(b"data: {")}
+            assert idx == {0, 1, 2}
+        finally:
+            m.stop()
