@@ -47,6 +47,36 @@ SCALE_IDLE_SECONDS = 2.0  # idle window before scale-down (KPA-ish)
 ACTIVATION_TIMEOUT = 15.0
 
 
+class _GangMetrics:
+    """Concurrency probe for a gang replica: rank 0's /metrics exposes
+    the server's ``kft_requests_inflight`` gauge — the same signal the
+    in-process autoscaler reads directly, fetched over HTTP with a short
+    cache (the reconcile loop runs at 4 Hz)."""
+
+    def __init__(self, url: str) -> None:
+        self._url = url
+        self._val = 0
+        self._ts = 0.0
+
+    @property
+    def inflight(self) -> int:
+        now = time.monotonic()
+        if now - self._ts > 0.5:
+            self._ts = now
+            val = 0
+            try:
+                with urllib.request.urlopen(
+                        self._url + "/metrics", timeout=0.5) as r:
+                    for line in r.read().decode().splitlines():
+                        if line.startswith("kft_requests_inflight"):
+                            val = int(float(line.split()[-1]))
+                            break
+            except (OSError, ValueError):
+                val = 0
+            self._val = val
+        return self._val
+
+
 class _GangPredictor:
     """ModelServer-shaped handle for a gang-placed predictor.
 
@@ -55,12 +85,13 @@ class _GangPredictor:
     this handle allocates and freezes into the job's env, so ``url`` is
     known before the gang is even admitted — readiness is probed, not
     assumed.  Restarts belong to the JaxJob controller (gang semantics);
-    this handle only creates/deletes the job.
+    this handle only creates/deletes the job.  Gang REPLICAS scale like
+    in-process ones (min/max, concurrency via the /metrics probe,
+    activator): one handle per gang, ordinal-named.
     """
 
-    def __init__(self, store: Store, isvc, rev: int, gang, cfg: dict):
-        import types
-
+    def __init__(self, store: Store, isvc, rev: int, gang, cfg: dict,
+                 ordinal: int = 0):
         from ..api.common import (
             Container, ObjectMeta, ReplicaSpec, Resources, RestartPolicy,
             RunPolicy,
@@ -70,9 +101,9 @@ class _GangPredictor:
 
         self.store = store
         self.namespace = isvc.metadata.namespace
-        self.job_name = f"{isvc.metadata.name}-gang-r{rev}"
+        self.job_name = f"{isvc.metadata.name}-gang-r{rev}-g{ordinal}"
         self.port = allocate_port()
-        self.metrics = types.SimpleNamespace(inflight=0)
+        self.metrics = _GangMetrics(f"http://127.0.0.1:{self.port}")
         self._ready_at: float = 0.0
         import secrets
 
@@ -304,6 +335,8 @@ class _Revision:
         self.predictors: list[ModelServer] = []
         self.transformers: list[ModelServer] = []
         self.explainers: list[ModelServer] = []
+        #: monotonically increasing ordinal for gang-replica job names
+        self.gang_counter = 0
 
     @property
     def servers(self) -> list[ModelServer]:
@@ -453,12 +486,10 @@ class InferenceServiceController(Controller):
     # -- scaling ----------------------------------------------------------
 
     def _desired_replicas(self, dep: _Deployment, rev: _Revision) -> int:
+        # gang replicas use the SAME policy as in-process ones: the unit
+        # is just N host processes instead of one server, and inflight
+        # concurrency comes from rank 0's /metrics probe (_GangMetrics)
         pred = rev.spec.predictor
-        if pred.gang is not None:
-            # a gang is a fixed placement unit: one JaxJob, restarts and
-            # sizing owned by the JaxJob controller — concurrency
-            # autoscaling / scale-to-zero don't apply at this tier
-            return 1
         n = len(rev.predictors)
         # during a canary split BOTH revisions must hold the road: a
         # revision idling to zero would silently forfeit its traffic
@@ -486,15 +517,25 @@ class InferenceServiceController(Controller):
     ) -> bool:
         gang = rev.spec.predictor.gang
         if gang is not None:
-            if not rev.predictors and desired > 0:
-                rev.predictors.append(_GangPredictor(
-                    self.store, isvc, rev.rev, gang, rev.cfg))
+            changed = False
+            while len(rev.predictors) < desired:
+                rev.gang_counter += 1
+                handle = _GangPredictor(
+                    self.store, isvc, rev.rev, gang, rev.cfg,
+                    ordinal=rev.gang_counter - 1)
+                rev.predictors.append(handle)
                 self.emit_event(
                     isvc, "GangPlaced",
-                    f"rev {rev.rev} JaxJob "
-                    f"{rev.predictors[0].job_name} x{gang.hosts} hosts")
-                return True
-            return False
+                    f"rev {rev.rev} JaxJob {handle.job_name} "
+                    f"x{gang.hosts} hosts")
+                changed = True
+            while len(rev.predictors) > desired:
+                handle = rev.predictors.pop()
+                self._wire(isvc, dep)  # drop from router before deleting
+                handle.stop()
+                self.emit_event(isvc, "GangStopped", handle.job_name)
+                changed = True
+            return changed
         changed = False
         while len(rev.predictors) < desired:
             server = ModelServer()

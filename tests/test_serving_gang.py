@@ -127,7 +127,7 @@ class TestServingGang:
 
         # (b) restart like a JaxJob: SIGKILL rank 0 -> gang restart ->
         # same URL serves the same tokens again
-        pod = platform.store.get(KIND_POD, "gangllama-gang-r1-worker-0")
+        pod = platform.store.get(KIND_POD, "gangllama-gang-r1-g0-worker-0")
         assert pod.status.pid
         os.kill(pod.status.pid, signal.SIGKILL)
         deadline = time.time() + 300
@@ -194,6 +194,53 @@ class TestServingGang:
         got = [_predict(isvc.status.url, "seggang", [p])[0]
                for p in prompts]
         assert got == want
+
+    def test_gang_replicas_scale(self, platform, tmp_path):
+        """Gang REPLICAS scale like in-process ones: min_replicas=2
+        places two ordinal-named JaxJob gangs behind the router; both
+        serve; teardown deletes both."""
+        from kubeflow_tpu.api.jaxjob import KIND_JAXJOB
+
+        snap = _snapshot(tmp_path)
+        isvc = InferenceService(
+            metadata=ObjectMeta(name="multigang"),
+            spec=InferenceServiceSpec(predictor=ComponentSpec(
+                handler=(
+                    "kubeflow_tpu.serving.continuous:"
+                    "ContinuousLlamaGenerator"),
+                storage_uri=f"file://{snap}",
+                min_replicas=2, max_replicas=2,
+                gang=GangSpec(
+                    hosts=2, mesh_axes={"model": 8}, chips_per_host=4),
+                config=dict(ENGINE_CONF),
+            )))
+        platform.store.create(isvc)
+        isvc = _wait_phase(platform.store, "multigang",
+                           InferenceServicePhase.READY)
+        deadline = time.time() + 300
+        while time.time() < deadline:
+            jobs = sorted(
+                j.metadata.name
+                for j in platform.store.list(KIND_JAXJOB)
+                if j.metadata.name.startswith("multigang-gang-"))
+            if len(jobs) == 2:
+                break
+            time.sleep(0.5)
+        assert jobs == ["multigang-gang-r1-g0", "multigang-gang-r1-g1"]
+        # both gangs take traffic through the router
+        outs = [_predict(isvc.status.url, "multigang", [[1, 2, 3]])[0]
+                for _ in range(4)]
+        assert all(o == outs[0] for o in outs)
+        platform.store.delete(KIND_INFERENCE_SERVICE, "multigang",
+                              "default")
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            left = [j for j in platform.store.list(KIND_JAXJOB)
+                    if j.metadata.name.startswith("multigang-gang-")]
+            if not left:
+                break
+            time.sleep(0.5)
+        assert not left, [j.metadata.name for j in left]
 
     def test_gang_channel_roundtrip(self):
         """Framing unit test: big numpy payloads survive the stream."""
