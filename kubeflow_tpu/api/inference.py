@@ -63,6 +63,17 @@ class GangSpec(_Model):
         return self
 
 
+class LoggerSpec(_Model):
+    """Inference payload logging [upstream: kserve -> pkg/agent/logger,
+    the ISvc ``logger`` field]: every request/response POSTs to ``url``
+    with CloudEvents binary-mode headers, asynchronously (a dead sink
+    drops events, never backpressures predicts)."""
+
+    url: str
+    #: "all" | "request" | "response"
+    mode: str = "all"
+
+
 class ComponentSpec(_Model):
     """One serving component (predictor/transformer/explainer)."""
 
@@ -83,6 +94,8 @@ class ComponentSpec(_Model):
     #: place the predictor as a multi-host gang instead of in-process
     #: replicas (predictor only; see GangSpec)
     gang: Optional[GangSpec] = None
+    #: payload logging to a collector sink (see LoggerSpec)
+    logger: Optional[LoggerSpec] = None
 
 
 class InferenceServiceSpec(_Model):

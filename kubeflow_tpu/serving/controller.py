@@ -546,6 +546,11 @@ class InferenceServiceController(Controller):
                 batch_max_size=pred.batch_max_size,
                 batch_timeout_ms=pred.batch_timeout_ms,
             )
+            if pred.logger is not None:
+                # payload logging (kserve agent/logger analog)
+                server.set_logger(
+                    pred.logger.url, pred.logger.mode,
+                    service=isvc.metadata.name)
             server.start()
             rev.predictors.append(server)
             self.emit_event(

@@ -155,6 +155,64 @@ class ServerMetrics:
         return "\n".join(lines) + "\n"
 
 
+class InferenceLogger:
+    """Async request/response payload logging to a sink URL [upstream:
+    kserve -> pkg/agent/logger — the ISvc ``logger`` field POSTs
+    CloudEvents-framed copies of every inference to a collector].
+    Fire-and-forget off a bounded queue: a slow or dead sink drops log
+    events (counted) instead of backpressuring the predict path."""
+
+    def __init__(self, url: str, mode: str = "all",
+                 service: str = "") -> None:
+        if mode not in ("all", "request", "response"):
+            raise ValueError(f"logger mode {mode!r}: all|request|response")
+        self.url = url
+        self.mode = mode
+        self.service = service
+        self.dropped = 0
+        self._q: "queue.Queue" = queue.Queue(maxsize=256)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._pump, name="inference-logger", daemon=True)
+        self._thread.start()
+
+    def log(self, kind: str, model: str, req_id: str, payload) -> None:
+        if self.mode != "all" and self.mode != kind:
+            return
+        try:
+            self._q.put_nowait((kind, model, req_id, payload))
+        except queue.Full:
+            self.dropped += 1
+
+    def _pump(self) -> None:
+        import urllib.request as _rq
+
+        while not self._stop.is_set():
+            try:
+                kind, model, req_id, payload = self._q.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            body = json.dumps(payload).encode()
+            req = _rq.Request(self.url, data=body, headers={
+                "Content-Type": "application/json",
+                # CloudEvents binary-mode framing (the kserve contract)
+                "ce-specversion": "1.0",
+                "ce-type": f"org.kubeflow.serving.inference.{kind}",
+                "ce-source": self.service or model,
+                "ce-id": req_id,
+                "ce-modelid": model,
+            })
+            try:
+                with _rq.urlopen(req, timeout=2.0):
+                    pass
+            except OSError:
+                self.dropped += 1
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=2)
+
+
 class ModelServer:
     """Hosts models behind the V1/V2 HTTP protocols (one per replica)."""
 
@@ -170,6 +228,8 @@ class ModelServer:
         #: threads and model instances
         self._repo_lock = threading.Lock()
         self.metrics = ServerMetrics()
+        #: optional request/response payload logger (set_logger)
+        self.logger: Optional[InferenceLogger] = None
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self._grpc = None
@@ -302,6 +362,9 @@ class ModelServer:
         if self._grpc is not None:
             self._grpc.stop()
             self._grpc = None
+        if self.logger is not None:
+            self.logger.stop()
+            self.logger = None
         for name in list(self._models):
             self.unregister(name)
         if self._httpd:
@@ -481,12 +544,25 @@ class ModelServer:
             with self.metrics.lock:
                 self.metrics.inflight -= 1
 
+    def set_logger(self, url: str, mode: str = "all",
+                   service: str = "") -> None:
+        """Enable payload logging (the ISvc ``logger`` field)."""
+        if self.logger is not None:
+            self.logger.stop()
+        self.logger = InferenceLogger(url, mode, service)
+
     def _predict_v1(self, h, name: str, payload: dict) -> None:
         t0 = time.perf_counter()
+        req_id = f"{name}-{time.time_ns()}"
+        if self.logger is not None:
+            self.logger.log("request", name, req_id, payload)
         try:
             instances = payload["instances"]
             out = self._dispatch(name, instances)
             self.metrics.observe(name, time.perf_counter() - t0, error=False)
+            if self.logger is not None:
+                self.logger.log("response", name, req_id,
+                                {"predictions": out})
             h._send(200, {"predictions": out})
         except KeyError as e:
             self.metrics.observe(name, time.perf_counter() - t0, error=True)
@@ -547,11 +623,17 @@ class ModelServer:
 
     def _predict_v2(self, h, name: str, payload: dict) -> None:
         t0 = time.perf_counter()
+        req_id = payload.get("id") or f"{name}-{time.time_ns()}"
+        if self.logger is not None:
+            self.logger.log("request", name, req_id, payload)
         try:
             instances = self.v2_to_instances(payload)
             out = self._dispatch(name, instances)
             self.metrics.observe(name, time.perf_counter() - t0, error=False)
-            h._send(200, self.v2_response(name, out))
+            resp = self.v2_response(name, out)
+            if self.logger is not None:
+                self.logger.log("response", name, req_id, resp)
+            h._send(200, resp)
         except KeyError as e:
             self.metrics.observe(name, time.perf_counter() - t0, error=True)
             h._send(404 if str(e).strip("'") == name else 400, {"error": str(e)})

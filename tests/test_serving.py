@@ -672,3 +672,119 @@ class TestRepositoryApi:
 def _post_like_get(url):
     code, out = _post(url, {})
     return code, out
+
+
+class TestInferenceLogger:
+    """kserve agent/logger parity: the ISvc ``logger`` field POSTs
+    CloudEvents-framed request/response copies to a collector sink
+    without blocking the predict path."""
+
+    def _sink(self):
+        import threading
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        from kubeflow_tpu.utils.net import allocate_port
+
+        events = []
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", "0"))
+                events.append({
+                    "type": self.headers.get("ce-type"),
+                    "id": self.headers.get("ce-id"),
+                    "source": self.headers.get("ce-source"),
+                    "body": json.loads(self.rfile.read(n)),
+                })
+                self.send_response(200)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+
+        port = allocate_port()
+        httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        httpd.daemon_threads = True
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        return f"http://127.0.0.1:{port}", events, httpd
+
+    def test_request_and_response_logged(self):
+        import time as timelib
+
+        from kubeflow_tpu.serving.runtimes import EchoModel
+
+        url, events, httpd = self._sink()
+        srv = ModelServer()
+        srv.register(EchoModel("echo"))
+        srv.set_logger(url, "all", service="my-isvc")
+        srv.start()
+        try:
+            code, out = _post(srv.url + "/v1/models/echo:predict",
+                              {"instances": [1, 2]})
+            assert code == 200 and out["predictions"] == [1, 2]
+            deadline = timelib.monotonic() + 10
+            while len(events) < 2 and timelib.monotonic() < deadline:
+                timelib.sleep(0.05)
+            kinds = sorted(e["type"] for e in events)
+            assert kinds == [
+                "org.kubeflow.serving.inference.request",
+                "org.kubeflow.serving.inference.response"]
+            req = next(e for e in events if e["type"].endswith("request"))
+            resp = next(e for e in events if e["type"].endswith("response"))
+            assert req["body"] == {"instances": [1, 2]}
+            assert resp["body"] == {"predictions": [1, 2]}
+            assert req["id"] == resp["id"]  # correlated
+            assert req["source"] == "my-isvc"
+        finally:
+            srv.stop()
+            httpd.shutdown()
+
+    def test_mode_request_only_and_dead_sink(self):
+        import time as timelib
+
+        from kubeflow_tpu.serving.runtimes import EchoModel
+
+        url, events, httpd = self._sink()
+        srv = ModelServer()
+        srv.register(EchoModel("echo"))
+        srv.set_logger(url, "request")
+        srv.start()
+        try:
+            _post(srv.url + "/v1/models/echo:predict", {"instances": [3]})
+            deadline = timelib.monotonic() + 10
+            while not events and timelib.monotonic() < deadline:
+                timelib.sleep(0.05)
+            timelib.sleep(0.2)  # a response event would have landed too
+            assert [e["type"].rsplit(".", 1)[-1] for e in events] == [
+                "request"]
+            # dead sink: predicts keep working, drops are counted
+            httpd.shutdown()
+            code, out = _post(srv.url + "/v1/models/echo:predict",
+                              {"instances": [4]})
+            assert code == 200 and out["predictions"] == [4]
+        finally:
+            srv.stop()
+
+    def test_isvc_logger_field(self, serving_cluster):
+        import time as timelib
+
+        from kubeflow_tpu.api.inference import LoggerSpec
+
+        url, events, httpd = self._sink()
+        serving_cluster.store.create(InferenceService(
+            metadata=ObjectMeta(name="logged"),
+            spec=InferenceServiceSpec(predictor=ComponentSpec(
+                handler="kubeflow_tpu.serving.runtimes:EchoModel",
+                logger=LoggerSpec(url=url),
+            ))))
+        isvc = _wait_ready(serving_cluster, "logged")
+        code, out = _post(isvc.status.url + "/v1/models/logged:predict",
+                          {"instances": [7]})
+        assert code == 200 and out["predictions"] == [7]
+        deadline = timelib.monotonic() + 10
+        while len(events) < 2 and timelib.monotonic() < deadline:
+            timelib.sleep(0.05)
+        assert len(events) >= 2
+        assert any(e["source"] == "logged" for e in events)
+        httpd.shutdown()
