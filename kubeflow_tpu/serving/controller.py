@@ -115,6 +115,10 @@ class _GangPredictor:
         conf["gang_token"] = secrets.token_hex(16)
         conf["mesh_axes"] = dict(gang.mesh_axes)
         conf.setdefault("model_name", isvc.metadata.name)
+        logger = isvc.spec.predictor.logger
+        if logger is not None:
+            conf["logger_url"] = logger.url
+            conf["logger_mode"] = logger.mode
         env = {ENV_SERVE_CONFIG: json.dumps(conf)}
         import os as _os
 

@@ -508,6 +508,12 @@ def serve_main(ctx: bootstrap.PodContext) -> None:
                 conf.get("model_name", "model"), conf, engine=engine)
         server = ModelServer(port=int(conf["serve_port"]))
         server.register(model)
+        if conf.get("logger_url"):
+            # payload logging on the gang frontend (rank 0 sees every
+            # request), same CloudEvents contract as in-process replicas
+            server.set_logger(conf["logger_url"],
+                              conf.get("logger_mode", "all"),
+                              service=conf.get("model_name", "model"))
         # the frontend port is stable across gang restarts; the previous
         # incarnation's listener may need its SIGTERM grace to vacate it
         deadline = time.monotonic() + 15.0

@@ -382,6 +382,7 @@ class TextGenerator(Model):
         stops = self._stop_sequences(payload)
         choices = []
         completion_tokens = 0
+        prompt_tokens = sum(len(r.prompt) for r in reqs)
         for i, r in enumerate(reqs):
             ids = self._wait_with_stops(r, stops)
             completion_tokens += len(ids)  # TOKENS, not decoded chars
@@ -399,5 +400,7 @@ class TextGenerator(Model):
             "object": "text_completion",
             "model": payload.get("model", self.name),
             "choices": choices,
-            "usage": {"completion_tokens": completion_tokens},
+            "usage": {"prompt_tokens": prompt_tokens,
+                      "completion_tokens": completion_tokens,
+                      "total_tokens": prompt_tokens + completion_tokens},
         }
