@@ -73,6 +73,14 @@ class LoggerSpec(_Model):
     #: "all" | "request" | "response"
     mode: str = "all"
 
+    @model_validator(mode="after")
+    def _mode_ok(self) -> "LoggerSpec":
+        # reject at admission, not deep inside reconcile (or a gang pod)
+        if self.mode not in ("all", "request", "response"):
+            raise ValueError(
+                f"logger mode {self.mode!r}: all|request|response")
+        return self
+
 
 class ComponentSpec(_Model):
     """One serving component (predictor/transformer/explainer)."""

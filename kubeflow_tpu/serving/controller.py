@@ -57,23 +57,28 @@ class _GangMetrics:
         self._url = url
         self._val = 0
         self._ts = 0.0
+        self._fail_ts = -10.0
 
     @property
     def inflight(self) -> int:
         now = time.monotonic()
+        if now - self._fail_ts < 2.0:
+            return 0  # negative cache: a booting/restarting gang must
+            # not stall the shared reconcile worker on every pass
         if now - self._ts > 0.5:
             self._ts = now
-            val = 0
             try:
                 with urllib.request.urlopen(
-                        self._url + "/metrics", timeout=0.5) as r:
+                        self._url + "/metrics", timeout=0.3) as r:
+                    val = 0
                     for line in r.read().decode().splitlines():
                         if line.startswith("kft_requests_inflight"):
                             val = int(float(line.split()[-1]))
                             break
+                self._val = val
             except (OSError, ValueError):
-                val = 0
-            self._val = val
+                self._fail_ts = now
+                self._val = 0
         return self._val
 
 
@@ -500,14 +505,22 @@ class InferenceServiceController(Controller):
         # share (the router drops empty pools, and with the other pool
         # still serving, the activator never fires to bring it back)
         floor = max(pred.min_replicas, 1 if dep.canary is not None else 0)
+        if pred.gang is not None:
+            # gangs do not scale to zero: cold start is a full JaxJob
+            # placement + distributed init + model load, far beyond the
+            # activator's wait — an idle-scaled gang would answer its
+            # next caller with timeouts
+            floor = max(floor, 1)
         if dep.wants_scale_up and rev is dep.stable:
             dep.wants_scale_up = False
             return max(n, 1, floor)
-        inflight = sum(
-            s.metrics.inflight for s in rev.predictors
-        )
-        if n and inflight / n > pred.scale_target_concurrency:
-            return min(n + 1, pred.max_replicas)
+        if n and n < pred.max_replicas:
+            # only probe concurrency when another replica could actually
+            # be added (the gang probe is an HTTP fetch; pointless work
+            # stalls the shared reconcile worker)
+            inflight = sum(s.metrics.inflight for s in rev.predictors)
+            if inflight / n > pred.scale_target_concurrency:
+                return min(n + 1, pred.max_replicas)
         idle = (
             dep.router is not None
             and time.time() - dep.router.last_request_time > SCALE_IDLE_SECONDS
@@ -536,8 +549,10 @@ class InferenceServiceController(Controller):
             while len(rev.predictors) > desired:
                 handle = rev.predictors.pop()
                 self._wire(isvc, dep)  # drop from router before deleting
-                handle.stop()
-                self.emit_event(isvc, "GangStopped", handle.job_name)
+                # same drain contract as in-process replicas: in-flight
+                # requests (visible via the rank-0 metrics probe) finish
+                # before the JaxJob is deleted
+                self._drain_stop_server(isvc, handle)
                 changed = True
             return changed
         changed = False

@@ -192,20 +192,22 @@ class InferenceLogger:
                 kind, model, req_id, payload = self._q.get(timeout=0.2)
             except queue.Empty:
                 continue
-            body = json.dumps(payload).encode()
-            req = _rq.Request(self.url, data=body, headers={
-                "Content-Type": "application/json",
-                # CloudEvents binary-mode framing (the kserve contract)
-                "ce-specversion": "1.0",
-                "ce-type": f"org.kubeflow.serving.inference.{kind}",
-                "ce-source": self.service or model,
-                "ce-id": req_id,
-                "ce-modelid": model,
-            })
             try:
+                # one unserializable payload costs ONE event, never the
+                # logger thread (json.dumps can raise on exotic outputs)
+                body = json.dumps(payload, default=str).encode()
+                req = _rq.Request(self.url, data=body, headers={
+                    "Content-Type": "application/json",
+                    # CloudEvents binary-mode framing (the kserve contract)
+                    "ce-specversion": "1.0",
+                    "ce-type": f"org.kubeflow.serving.inference.{kind}",
+                    "ce-source": self.service or model,
+                    "ce-id": req_id,
+                    "ce-modelid": model,
+                })
                 with _rq.urlopen(req, timeout=2.0):
                     pass
-            except OSError:
+            except Exception:  # noqa: BLE001
                 self.dropped += 1
 
     def stop(self) -> None:

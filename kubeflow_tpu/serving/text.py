@@ -382,7 +382,10 @@ class TextGenerator(Model):
         stops = self._stop_sequences(payload)
         choices = []
         completion_tokens = 0
-        prompt_tokens = sum(len(r.prompt) for r in reqs)
+        # each prompt appears n times in reqs (one per choice) but the
+        # OpenAI contract counts it ONCE
+        n = max(1, int(payload.get("n", 1)))
+        prompt_tokens = sum(len(r.prompt) for r in reqs) // n
         for i, r in enumerate(reqs):
             ids = self._wait_with_stops(r, stops)
             completion_tokens += len(ids)  # TOKENS, not decoded chars
