@@ -185,6 +185,14 @@ def config_from_hf(path: str):
     if mt not in ("llama", "mistral"):
         raise ValueError(
             f"unsupported checkpoint model_type {mt!r} (llama-family only)")
+    sw = d.get("sliding_window")
+    if sw and int(sw) < int(d.get("max_position_embeddings", sw)):
+        # attending past the trained window silently degrades output —
+        # refuse loudly like the rope_scaling guard below
+        raise ValueError(
+            f"sliding_window={sw} attention is not implemented by "
+            "models/llama.py; refusing a checkpoint that would silently "
+            "mis-generate past the window")
     rs = d.get("rope_scaling") or {}
     if rs and rs.get("rope_type", rs.get("type")) not in (None, "default"):
         # silently dropping llama3/linear/yarn rope scaling would load a

@@ -88,7 +88,7 @@ class LocalKubelet:
             self.store.stop_watch(self._watch)
         for key in list(self._procs):
             self._kill(key)
-        for t in self._kill_threads:
+        for t in list(self._kill_threads):
             t.join(timeout=2 * GRACE_SECONDS + 1)
         self._kill_threads.clear()
 
@@ -260,6 +260,10 @@ class LocalKubelet:
         t = threading.Thread(
             target=grace_kill, name=f"pod-kill-{popen.pid}", daemon=True)
         t.start()
+        # prune finished grace threads as we go — a long-lived kubelet
+        # restarting gangs must not accumulate one dead Thread per kill
+        self._kill_threads = [
+            x for x in self._kill_threads if x.is_alive()]
         self._kill_threads.append(t)
 
     # -- status writes ---------------------------------------------------------

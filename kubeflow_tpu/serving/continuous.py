@@ -476,6 +476,10 @@ class ContinuousEngine:
         if self.prefix_segments > 0:
             if self.segment_len <= 0:
                 raise ValueError("prefix_segments needs segment_len > 0")
+            if self.segment_len < int(min_prefix):
+                raise ValueError(
+                    f"segment_len {segment_len} < min_prefix {min_prefix}:"
+                    " every created segment would be unusable")
             if not cfg.scan_layers:
                 raise ValueError(
                     "shared-prefix segments require scan_layers=True")
@@ -1206,21 +1210,30 @@ class ContinuousEngine:
             lcp = _lcp(content, p_arr, cap)
             if lcp > blen:
                 best, blen = i, lcp
+        def feasible(bl: int) -> bool:
+            # the FULL requested generation must fit the suffix slot —
+            # shrinking max_new here would make token counts depend on
+            # cache state (segment hit vs miss); infeasible plans fall
+            # back to the legacy path, which truncates the PROMPT and
+            # preserves max_new like every non-segment engine
+            sfx = len(prompt) - bl
+            return (0 < sfx <= self.seq_buckets[-1]
+                    and sfx + req.max_new_tokens <= self.cfg.max_seq_len - 1)
+
         created = False
         if blen < self.min_prefix and cap >= self.min_prefix:
             # too little shared with ANY segment (a 1-token BOS overlap
-            # must not block a new prompt from getting its own segment)
+            # must not block a new prompt from getting its own segment).
+            # Feasibility is checked BEFORE the creation prefill: an
+            # abandoned plan must not burn a dispatch + a segment row.
             want = min(self.segment_len, cap)
-            made = self._create_segment(prompt[:want])
-            if made >= 0:
-                best, blen, created = made, want, True
-        if best < 0 or blen < self.min_prefix:
+            if want >= self.min_prefix and feasible(want):
+                made = self._create_segment(prompt[:want])
+                if made >= 0:
+                    best, blen, created = made, want, True
+        if best < 0 or blen < self.min_prefix or not feasible(blen):
             return None
         suffix = prompt[blen:]
-        room = self.cfg.max_seq_len - 1 - len(suffix)
-        if room <= 0 or len(suffix) > self.seq_buckets[-1]:
-            return None  # suffix alone overflows the slot
-        req.max_new_tokens = min(req.max_new_tokens, room)
         self._seg_reserved.add(best)
         if not created:
             self.segment_hits += 1
@@ -1464,6 +1477,13 @@ class TieredEngine:
         # back to defaults if none survive) — silently dropping an
         # operator-tuned knob would regress admission latency
         seq_buckets = kw.pop("seq_buckets", None)
+        if not kw.get("mesh_axes"):
+            # commit host params to the device ONCE before building the
+            # pools: each ContinuousEngine device_puts its params, and
+            # N+1 pools must share one copy of the weights, not hold
+            # N+1 (device_put on an already-committed array is a no-op;
+            # the mesh case is likewise idempotent through place_params)
+            params = jax.device_put(params)
         self.pools: list[ContinuousEngine] = []
         for cap, n in zip(tier_lens, tier_slots):
             tb = None

@@ -110,6 +110,7 @@ class _GangPredictor:
         self.port = allocate_port()
         self.metrics = _GangMetrics(f"http://127.0.0.1:{self.port}")
         self._ready_at: float = 0.0
+        self._ready_fail_at: float = -10.0
         import secrets
 
         conf = dict(cfg)
@@ -164,6 +165,9 @@ class _GangPredictor:
         now = time.monotonic()
         if now < self._ready_at + 1.0:
             return True
+        if now < self._ready_fail_at + 1.0:
+            return False  # negative cache: a booting gang must not stall
+            # the shared reconcile worker on every 4 Hz pass
         try:
             with urllib.request.urlopen(
                     self.url + "/v2/health/ready", timeout=0.5) as resp:
@@ -172,6 +176,8 @@ class _GangPredictor:
             ok = False
         if ok:
             self._ready_at = now
+        else:
+            self._ready_fail_at = now
         return ok
 
     def stop(self) -> None:

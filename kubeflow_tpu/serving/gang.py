@@ -473,10 +473,11 @@ def serve_main(ctx: bootstrap.PodContext) -> None:
     ``gang_port`` (the control stream).
     """
     conf = json.loads(os.environ[ENV_SERVE_CONFIG])
-    if conf.get("short_pool_len"):
+    if conf.get("short_pool_len") or conf.get("tier_lens"):
         raise ValueError(
-            "short_pool_len (TieredEngine) is not gang-capable yet: the "
-            "control stream drives ONE engine's dispatch order")
+            "tiered pools (short_pool_len / tier_lens) are not "
+            "gang-capable yet: the control stream drives ONE engine's "
+            "dispatch order")
     cfg, params = contlib.resolve_model_source(
         conf, name=conf.get("model_name", "model"))
     cfg, params = contlib.apply_serving_quant(cfg, params, conf)

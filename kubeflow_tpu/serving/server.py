@@ -463,6 +463,11 @@ class ModelServer:
                 h._send(404, {"error": f"no completions model {name!r}"})
                 return
             t0 = time.perf_counter()
+            req_id = f"{name}-{time.time_ns()}"
+            if self.logger is not None:
+                # the LoggerSpec contract covers EVERY request, the
+                # OpenAI surface included (streams log the request side)
+                self.logger.log("request", name, req_id, payload)
             with self.metrics.lock:  # inflight gauge covers completions too
                 self.metrics.inflight += 1
             streaming = False  # SSE headers already on the wire?
@@ -482,6 +487,8 @@ class ModelServer:
                     return
                 out = getattr(m, call_attr)(payload)
                 self.metrics.observe(name, time.perf_counter() - t0, error=False)
+                if self.logger is not None:
+                    self.logger.log("response", name, req_id, out)
                 h._send(200, out)
             except BrokenPipeError:
                 # client hung up mid-stream: not a server error

@@ -98,8 +98,10 @@ def compile_candidate(devs, cfg, *, tp, num_slots, decode_chunk=16,
     decode = contlib.make_decode_program(
         cfg, cfg.max_seq_len, decode_chunk, mesh)
     temps = jax.ShapeDtypeStruct((num_slots,), jnp.float32)
+    top_ps = jax.ShapeDtypeStruct((num_slots,), jnp.float32)
+    top_ks = jax.ShapeDtypeStruct((num_slots,), jnp.int32)
     compiled = decode.lower(params, pool, logits, positions, active,
-                            temps, key).compile()
+                            temps, top_ps, top_ks, key).compile()
     out["decode_compile_seconds"] = round(time.perf_counter() - t0, 1)
     mem = compiled.memory_analysis()
     # donated pool aliases its output; live set = arguments + temps
