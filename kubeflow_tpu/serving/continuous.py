@@ -854,32 +854,40 @@ class ContinuousEngine:
             # targets are out of range (row prefix_segments, slot
             # num_slots) or inactive, so every write drops.
             sb = self.seq_buckets[0]
-            top = self.segment_len
-            self._seg_cache = self._seg_merge(
-                self._seg_cache,
-                self._seg_prefill_for(top)(
-                    self.params, np.zeros((1, top), np.int32),
-                    np.ones(1, np.int32))[1],
-                np.full(1, self.prefix_segments, np.int32))
-            row_logits, row_cache = self._suffix_admit_for(sb, top, sb)(
-                self.params, self._seg_cache, np.zeros((1, sb), np.int32),
-                np.zeros(1, np.int32), np.full(1, top, np.int32),
-                np.ones(1, np.int32))
-            self._pool_cache, self._pool_logits = self._merge(
-                self._pool_cache, self._pool_logits, row_cache, row_logits,
-                np.full(1, self.num_slots, np.int32))
-            self._pool_cache, self._pool_logits, toks = (
-                self._prefix_decode_for(sb + self.decode_chunk, top)(
-                    self.params, self._pool_cache, self._pool_logits,
+            # warm the WHOLE segment attend ladder, not just the largest
+            # bucket: a burst sharing an 896-token prefix uses the 1024
+            # bucket, and a mid-serving compile there stalls the whole
+            # pool — the exact class warmup exists to remove.  Operators
+            # who cannot afford the load-time compiles (3 programs per
+            # ladder entry) opt out with warmup_groups=[].
+            for sa in self._seg_attends:
+                self._seg_cache = self._seg_merge(
                     self._seg_cache,
-                    np.full(self.num_slots, self.cfg.max_seq_len, np.int32),
-                    np.zeros(self.num_slots, np.int32),
-                    np.zeros(self.num_slots, np.int32),
-                    np.zeros(self.num_slots, bool),
-                    np.zeros(self.num_slots, np.float32),
-                    np.ones(self.num_slots, np.float32),
-                    np.zeros(self.num_slots, np.int32),
-                    np.asarray(jax.random.PRNGKey(0))))
+                    self._seg_prefill_for(sa)(
+                        self.params, np.zeros((1, sa), np.int32),
+                        np.ones(1, np.int32))[1],
+                    np.full(1, self.prefix_segments, np.int32))
+                row_logits, row_cache = self._suffix_admit_for(sb, sa, sb)(
+                    self.params, self._seg_cache,
+                    np.zeros((1, sb), np.int32),
+                    np.zeros(1, np.int32), np.full(1, sa, np.int32),
+                    np.ones(1, np.int32))
+                self._pool_cache, self._pool_logits = self._merge(
+                    self._pool_cache, self._pool_logits, row_cache,
+                    row_logits, np.full(1, self.num_slots, np.int32))
+                self._pool_cache, self._pool_logits, toks = (
+                    self._prefix_decode_for(sb + self.decode_chunk, sa)(
+                        self.params, self._pool_cache, self._pool_logits,
+                        self._seg_cache,
+                        np.full(self.num_slots, self.cfg.max_seq_len,
+                                np.int32),
+                        np.zeros(self.num_slots, np.int32),
+                        np.zeros(self.num_slots, np.int32),
+                        np.zeros(self.num_slots, bool),
+                        np.zeros(self.num_slots, np.float32),
+                        np.ones(self.num_slots, np.float32),
+                        np.zeros(self.num_slots, np.int32),
+                        np.asarray(jax.random.PRNGKey(0))))
             jax.block_until_ready(toks)
         if self.prefix_cache:
             # warm the prefix-admit programs for the warmed prompt buckets
