@@ -209,6 +209,18 @@ class RunPolicy(_Model):
     backoff_limit: int = 0
     scheduling_policy: Optional[SchedulingPolicy] = None
     suspend: bool = False
+    # Gang-restart pacing: the delay before restart #n is
+    # ``min(restart_backoff_seconds * 2**(n-1), restart_backoff_max_seconds)``
+    # with +-50% deterministic jitter, so a flapping node cannot drive a
+    # fixed-interval restart storm (ISSUE 1: the 0.05 s requeue was the
+    # storm).
+    restart_backoff_seconds: float = 0.1
+    restart_backoff_max_seconds: float = 5.0
+    # Restart-budget window: after this many seconds of stable running,
+    # ``status.restart_count`` resets to 0 — a long-lived job is judged by
+    # its recent behavior, not by backoff_limit accumulated over weeks.
+    # None = the classic lifetime budget.
+    restart_window_seconds: Optional[float] = None
 
 
 # ---------------------------------------------------------------------------

@@ -122,6 +122,9 @@ class InferenceServicePhase(str, enum.Enum):
     PENDING = "Pending"
     LOADING = "Loading"
     READY = "Ready"
+    #: serving, but below strength: some replica (e.g. a gang re-forming
+    #: after a member loss) is not taking traffic; healthy replicas are
+    DEGRADED = "Degraded"
     FAILED = "Failed"
 
 

@@ -90,6 +90,11 @@ class JaxJobStatus(_Model):
     start_time: Optional[float] = None
     completion_time: Optional[float] = None
     restart_count: int = 0
+    # Recovery probes (scripts/recovery_bench.py): when the last gang
+    # restart was decided, and how long that restart took to bring every
+    # worker back to Running (restart decision -> gang re-running).
+    last_restart_time: Optional[float] = None
+    last_recovery_seconds: Optional[float] = None
     # Gang-startup probe: wall-clock seconds from job creation to every
     # process past its first collective barrier (a headline BASELINE metric).
     gang_startup_seconds: Optional[float] = None

@@ -1,0 +1,19 @@
+"""Fault injection for the control plane and the serving gang.
+
+``FaultPlan`` is the one entry point: build a seeded plan, then hand it
+to ``FakeKubelet(..., chaos=plan)`` (pod crashes, kubelet stalls, node
+drains) and/or to ``GangChannel`` via ``plan.socket_wrapper(role)``
+(control-stream drops/delays).  See chaos/plan.py for the fault model
+and tests/test_chaos.py for the recovery paths it exercises.
+"""
+
+from .net import ChaosSocket
+from .plan import PREEMPTION_EXIT_CODE, Fault, FaultKind, FaultPlan
+
+__all__ = [
+    "ChaosSocket",
+    "Fault",
+    "FaultKind",
+    "FaultPlan",
+    "PREEMPTION_EXIT_CODE",
+]

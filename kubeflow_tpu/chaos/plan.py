@@ -1,0 +1,290 @@
+"""FaultPlan: a deterministic, seed-driven fault-injection schedule.
+
+The chaos layer that makes every recovery path in this platform testable
+without real processes (ISSUE 1 tentpole; SURVEY §4's envtest gap —
+restart policies go untested upstream because nothing ever *fails* in
+envtest).  A plan is a list of faults with firing conditions; the same
+seed always yields the same member choices and the same schedule, so a
+failing chaos test reproduces byte-for-byte.
+
+Integration points:
+
+- ``plan.script_fn()`` -> a :class:`~..controlplane.fake_kubelet.ScriptFn`
+  for :class:`FakeKubelet`: pod-level faults (crash at t, barrier hang,
+  flaky-then-succeed, coordinator kill) become multi-phase
+  :class:`PodScript`s, tracked per pod *incarnation* so a fault can hit
+  the first N lives of a pod and spare the rest;
+- ``FakeKubelet(..., chaos=plan)`` -> cluster-level faults: kubelet
+  stalls (the loop stops stepping pods, modelling detection latency) and
+  node drains/preemptions (the Node object vanishes and its pods fail
+  with the preemption exit code);
+- ``plan.socket_wrapper(role)`` -> an injectable wrapper for
+  :class:`~..serving.gang.GangChannel` sockets: connection drops and
+  send delays on the gang control stream (chaos/net.py).
+
+Times are relative to ``plan.activate()`` (called by the kubelet's
+``start()``/first tick, or explicitly by a test).
+"""
+
+from __future__ import annotations
+
+import enum
+import random
+import threading
+import time
+from collections import defaultdict
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+#: the exit code a preempted/drained pod dies with (SIGKILL-style,
+#: retryable under RestartPolicy.EXIT_CODE)
+PREEMPTION_EXIT_CODE = 137
+
+
+class FaultKind(str, enum.Enum):
+    CRASH = "crash"                  # pod dies at t with exit_code
+    BARRIER_HANG = "barrier_hang"    # pod runs but never reaches the barrier
+    FLAKY = "flaky"                  # first N incarnations fail, then succeed
+    KUBELET_STALL = "kubelet_stall"  # kubelet loop pauses for a window
+    NODE_DRAIN = "node_drain"        # node vanishes; its pods are preempted
+    SOCKET_DROP = "socket_drop"      # gang control socket dies mid-stream
+    SOCKET_DELAY = "socket_delay"    # gang control sends are delayed
+
+
+@dataclass
+class Fault:
+    kind: FaultKind
+    #: worker replica index the fault targets (pod-level faults)
+    index: Optional[int] = None
+    #: job-name filter; None = any job
+    job: Optional[str] = None
+    #: seconds after activation (cluster faults) or after pod start
+    #: (pod faults) when the fault fires
+    at: float = 0.0
+    duration: float = 0.0
+    exit_code: int = PREEMPTION_EXIT_CODE
+    #: how many pod incarnations the fault applies to (CRASH/FLAKY)
+    times: int = 1
+    node: Optional[str] = None
+    #: "leader" | "follower" — which side's sockets a net fault wraps
+    role: str = "follower"
+    #: SOCKET_DROP: sendall/recv calls on the wrapped socket before the
+    #: drop (None = drop on connect)
+    after_calls: Optional[int] = None
+    delay: float = 0.0
+    #: bookkeeping: consumed count (pod faults), fired flag (cluster)
+    fired: int = field(default=0, compare=False)
+
+
+class FaultPlan:
+    """Seed-driven fault schedule; see module docstring for the hooks."""
+
+    def __init__(self, seed: int = 0) -> None:
+        self.seed = seed
+        self.rng = random.Random(seed)
+        self.faults: list[Fault] = []
+        self._lock = threading.Lock()
+        self._t0: Optional[float] = None
+        #: pod-name -> incarnations seen (a new uid = a new life)
+        self._lives: dict[str, set[str]] = defaultdict(set)
+
+    # -- builders (chainable) ---------------------------------------------
+
+    def crash_pod(self, index: int, at: float = 0.0,
+                  exit_code: int = PREEMPTION_EXIT_CODE, times: int = 1,
+                  job: Optional[str] = None) -> "FaultPlan":
+        """Worker ``index`` dies ``at`` seconds into its run, for the
+        first ``times`` incarnations."""
+        self.faults.append(Fault(FaultKind.CRASH, index=index, at=at,
+                                 exit_code=exit_code, times=times, job=job))
+        return self
+
+    def crash_random_member(self, world: int, at: float = 0.0,
+                            exit_code: int = PREEMPTION_EXIT_CODE,
+                            times: int = 1,
+                            job: Optional[str] = None) -> "FaultPlan":
+        """Seeded random gang member dies mid-run — the canonical chaos
+        scenario (the choice is frozen at plan-build time, so the same
+        seed kills the same rank)."""
+        return self.crash_pod(self.rng.randrange(world), at=at,
+                              exit_code=exit_code, times=times, job=job)
+
+    def coordinator_kill(self, at: float = 0.0,
+                         exit_code: int = PREEMPTION_EXIT_CODE,
+                         times: int = 1,
+                         job: Optional[str] = None) -> "FaultPlan":
+        """Kill rank 0 — the worst member to lose (it is the
+        jax.distributed coordinator AND the serving-gang leader)."""
+        return self.crash_pod(0, at=at, exit_code=exit_code, times=times,
+                              job=job)
+
+    def flaky(self, index: int, failures: int = 1, run_seconds: float = 0.02,
+              exit_code: int = PREEMPTION_EXIT_CODE,
+              job: Optional[str] = None) -> "FaultPlan":
+        """First ``failures`` incarnations of worker ``index`` die early,
+        then it behaves — the flapping-node shape that used to trigger a
+        fixed-interval restart storm."""
+        self.faults.append(Fault(FaultKind.FLAKY, index=index,
+                                 at=run_seconds, exit_code=exit_code,
+                                 times=failures, job=job))
+        return self
+
+    def barrier_hang(self, index: int,
+                     job: Optional[str] = None) -> "FaultPlan":
+        """Worker ``index`` runs but never reaches its first collective
+        barrier (a wedged rendezvous)."""
+        self.faults.append(Fault(FaultKind.BARRIER_HANG, index=index, job=job))
+        return self
+
+    def kubelet_stall(self, at: float = 0.0,
+                      duration: float = 1.0) -> "FaultPlan":
+        """The kubelet loop freezes for ``duration`` seconds starting
+        ``at`` seconds after activation: pods bound in the window start
+        late, failures in the window are detected late."""
+        self.faults.append(
+            Fault(FaultKind.KUBELET_STALL, at=at, duration=duration))
+        return self
+
+    def node_drain(self, node: str, at: float = 0.0) -> "FaultPlan":
+        """Node ``node`` vanishes ``at`` seconds after activation
+        (preemption/maintenance): its non-terminal pods die with the
+        preemption exit code and the gang must re-form elsewhere."""
+        self.faults.append(Fault(FaultKind.NODE_DRAIN, node=node, at=at))
+        return self
+
+    def socket_drop(self, role: str = "follower",
+                    after_calls: Optional[int] = None,
+                    times: int = 1) -> "FaultPlan":
+        """Drop a gang control-stream socket after ``after_calls``
+        send/recv calls (None = at connect) — the follower-reconnect
+        scenario.  Applies to the first ``times`` sockets wrapped for
+        ``role``; reconnected sockets beyond that are clean."""
+        self.faults.append(Fault(FaultKind.SOCKET_DROP, role=role,
+                                 after_calls=after_calls, times=times))
+        return self
+
+    def socket_delay(self, role: str = "leader", delay: float = 0.01,
+                     times: int = 1) -> "FaultPlan":
+        """Add ``delay`` seconds to every send on the next ``times``
+        sockets wrapped for ``role`` (a slow cross-host link)."""
+        self.faults.append(Fault(FaultKind.SOCKET_DELAY, role=role,
+                                 delay=delay, times=times))
+        return self
+
+    # -- activation / clock ------------------------------------------------
+
+    def activate(self, now: Optional[float] = None) -> "FaultPlan":
+        """Start the plan clock (idempotent).  FakeKubelet calls this on
+        ``start()``; tests may call it explicitly."""
+        with self._lock:
+            if self._t0 is None:
+                self._t0 = time.time() if now is None else now
+        return self
+
+    def elapsed(self, now: Optional[float] = None) -> float:
+        if self._t0 is None:
+            return 0.0
+        return (time.time() if now is None else now) - self._t0
+
+    # -- FakeKubelet integration ------------------------------------------
+
+    def kubelet_stalled(self, now: Optional[float] = None) -> bool:
+        """True while a KUBELET_STALL window is open."""
+        t = self.elapsed(now)
+        return any(
+            f.kind == FaultKind.KUBELET_STALL
+            and f.at <= t < f.at + f.duration
+            for f in self.faults
+        )
+
+    def apply_cluster_faults(self, store, now: Optional[float] = None) -> None:
+        """Fire due cluster-level faults (node drains) against the store.
+
+        Called from ``FakeKubelet.step()`` — the kubelet is the one
+        component that already touches every pod, so it doubles as the
+        chaos actuator, exactly once per fault.
+        """
+        from ..controlplane.objects import KIND_NODE, KIND_POD, PodPhase
+
+        t = self.elapsed(now)
+        for f in self.faults:
+            if f.kind != FaultKind.NODE_DRAIN or f.fired or t < f.at:
+                continue
+            f.fired = 1
+            store.try_delete(KIND_NODE, f.node)
+            for pod in store.list(KIND_POD):
+                if pod.spec.node_name != f.node or pod.terminal:
+                    continue
+
+                def preempt(o, code=f.exit_code):
+                    o.status.phase = PodPhase.FAILED
+                    o.status.exit_code = code
+                    o.status.message = f"node {o.spec.node_name} drained"
+                    o.status.finish_time = time.time()
+
+                try:
+                    store.update_with_retry(
+                        KIND_POD, pod.metadata.name,
+                        pod.metadata.namespace, preempt)
+                except Exception:  # noqa: BLE001 — pod raced deletion
+                    pass
+
+    def _incarnation(self, pod) -> int:
+        """0-based life count for this pod name (a new uid = a new life)."""
+        with self._lock:
+            lives = self._lives[
+                f"{pod.metadata.namespace}/{pod.metadata.name}"]
+            lives.add(pod.metadata.uid)
+            return len(lives) - 1
+
+    def pod_script(self, pod, default=None):
+        """Resolve the PodScript for one pod incarnation (the ScriptFn
+        body); ``default`` supplies the healthy behavior."""
+        from ..controlplane.fake_kubelet import DEFAULT_SCRIPT, PodScript
+
+        base = default(pod) if default is not None else DEFAULT_SCRIPT
+        job = pod.metadata.labels.get("job-name")
+        try:
+            idx = int(pod.metadata.labels.get("replica-index", -1))
+        except (TypeError, ValueError):
+            idx = -1
+        incarnation = self._incarnation(pod)
+        for f in self.faults:
+            if f.job is not None and f.job != job:
+                continue
+            if f.kind == FaultKind.BARRIER_HANG and f.index == idx:
+                return PodScript(hang=True, barrier_after=None)
+            if f.kind in (FaultKind.CRASH, FaultKind.FLAKY) and f.index == idx:
+                if incarnation < f.times:
+                    return PodScript(run_seconds=f.at,
+                                     exit_code=f.exit_code,
+                                     barrier_after=base.barrier_after)
+        return base
+
+    def script_fn(self, default=None) -> Callable:
+        """A ScriptFn for FakeKubelet: chaos faults first, ``default``
+        (healthy behavior) otherwise."""
+        return lambda pod: self.pod_script(pod, default=default)
+
+    # -- gang-socket integration ------------------------------------------
+
+    def socket_wrapper(self, role: str) -> Callable:
+        """A ``sock -> sock`` wrapper for GangChannel injection: applies
+        the next unconsumed SOCKET_* fault for ``role``; clean
+        pass-through once the plan's net faults are spent."""
+        from .net import ChaosSocket
+
+        def wrap(sock):
+            with self._lock:
+                for f in self.faults:
+                    if f.role != role or f.fired >= f.times:
+                        continue
+                    if f.kind == FaultKind.SOCKET_DROP:
+                        f.fired += 1
+                        return ChaosSocket(sock, drop_after_calls=f.after_calls)
+                    if f.kind == FaultKind.SOCKET_DELAY:
+                        f.fired += 1
+                        return ChaosSocket(sock, send_delay=f.delay)
+            return sock
+
+        return wrap

@@ -3,7 +3,7 @@
 from .cluster import Cluster
 from .controller import Controller, Result, WorkQueue, events_for
 from .expectations import Expectations
-from .fake_kubelet import FakeKubelet, PodScript
+from .fake_kubelet import FakeKubelet, PodScript, ScriptPhase
 from .jaxjob_controller import JaxJobController
 from .objects import (
     GROUP_NAME_ANNOTATION,

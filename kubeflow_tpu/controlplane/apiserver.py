@@ -90,6 +90,94 @@ def resolve_kind(token: str) -> str:
     raise KeyError(token)
 
 
+#: what a scrubbed credential reads back as (a write round-tripping this
+#: sentinel preserves the stored secret — the kubectl-apply-a-GET flow)
+REDACTED = "**redacted**"
+
+
+def redact_for_read(d: dict) -> dict:
+    """Scrub credential material from an object dict before it leaves on
+    a READ.  Reads are cluster-wide (the dashboard surface), so without
+    this any profile-token holder could lift every other tenant's bearer
+    token from ``GET /apis/profiles`` and impersonate it (ADVICE r5
+    high), or a legacy inline gang token from a JaxJob env.  Mutates and
+    returns ``d`` (the dict is already a per-response copy)."""
+    kind = d.get("kind")
+    if kind == "Profile":
+        spec = d.get("spec") or {}
+        if spec.get("api_token"):
+            spec["api_token"] = REDACTED
+    elif kind in ("JaxJob", "Pod"):
+        for env in _env_blocks(d):
+            raw = env.get("KFT_SERVE_CONFIG")
+            if not isinstance(raw, str) or "gang_token" not in raw:
+                continue
+            try:
+                conf = json.loads(raw)
+                if conf.pop("gang_token", None) is not None:
+                    env["KFT_SERVE_CONFIG"] = json.dumps(conf)
+            except (TypeError, ValueError):
+                continue
+    return d
+
+
+def _env_blocks(d: dict) -> list[dict]:
+    """Container env dicts reachable in a JaxJob/Pod manifest."""
+    spec = d.get("spec") or {}
+    out = []
+    container = spec.get("container")
+    if isinstance(container, dict) and isinstance(container.get("env"), dict):
+        out.append(container["env"])
+    for rspec in (spec.get("replica_specs") or {}).values():
+        tmpl = rspec.get("template") if isinstance(rspec, dict) else None
+        if isinstance(tmpl, dict) and isinstance(tmpl.get("env"), dict):
+            out.append(tmpl["env"])
+    return out
+
+
+def _typed_env_blocks(obj) -> dict[str, dict]:
+    """Keyed container env dicts on a TYPED JaxJob/Pod (for pairing an
+    incoming write against the stored object)."""
+    out: dict[str, dict] = {}
+    spec = getattr(obj, "spec", None)
+    container = getattr(spec, "container", None)
+    if container is not None and isinstance(getattr(container, "env", None), dict):
+        out["container"] = container.env
+    for rtype, rspec in (getattr(spec, "replica_specs", None) or {}).items():
+        tmpl = getattr(rspec, "template", None)
+        if tmpl is not None and isinstance(getattr(tmpl, "env", None), dict):
+            out[f"replica:{rtype}"] = tmpl.env
+    return out
+
+
+def restore_redacted_on_write(kind: str, obj, cur) -> None:
+    """A write round-tripping a redacted READ must not destroy the stored
+    credential: Profile.api_token carrying the sentinel keeps the stored
+    token, and a JaxJob/Pod env whose KFT_SERVE_CONFIG lost its (legacy
+    inline) gang_token to redact_for_read gets it re-attached from the
+    stored object.  ``cur`` is the stored object (may be None)."""
+    if kind == "Profile":
+        if getattr(obj.spec, "api_token", None) == REDACTED:
+            obj.spec.api_token = (
+                getattr(cur.spec, "api_token", None) if cur else None)
+        return
+    if kind not in ("JaxJob", "Pod") or cur is None:
+        return
+    stored = _typed_env_blocks(cur)
+    for key, env in _typed_env_blocks(obj).items():
+        raw, raw_cur = env.get("KFT_SERVE_CONFIG"), stored.get(key, {}).get(
+            "KFT_SERVE_CONFIG")
+        if not raw or not raw_cur or "gang_token" not in raw_cur:
+            continue
+        try:
+            conf, conf_cur = json.loads(raw), json.loads(raw_cur)
+        except (TypeError, ValueError):
+            continue
+        if "gang_token" not in conf and "gang_token" in conf_cur:
+            conf["gang_token"] = conf_cur["gang_token"]
+            env["KFT_SERVE_CONFIG"] = json.dumps(conf)
+
+
 class ApiServer:
     """HTTP facade over a Store (one per cluster)."""
 
@@ -336,7 +424,8 @@ class ApiServer:
         h._send(200, {
             "cursor": cursor,
             "items": [
-                {"type": ev.type, "seq": seq, "object": to_dict(ev.obj)}
+                {"type": ev.type, "seq": seq,
+                 "object": redact_for_read(to_dict(ev.obj))}
                 for seq, ev in matched
             ],
         })
@@ -376,8 +465,18 @@ class ApiServer:
                 obj = from_dict(manifest)
                 if forbidden(obj.metadata.namespace):
                     return
+                if (kind == "Profile"
+                        and getattr(obj.spec, "api_token", None) == REDACTED):
+                    # a DELETE+POST replace of a redacted GET would store
+                    # the PUBLIC sentinel as a live bearer token; with no
+                    # stored object left to restore from, reject loudly
+                    h._send(422, {
+                        "error": "spec.api_token is the redaction "
+                                 "sentinel; supply the real credential",
+                        "reason": "Invalid"})
+                    return
                 created = self.store.create(obj)
-                h._send(201, to_dict(created))
+                h._send(201, redact_for_read(to_dict(created)))
                 return
             ns = q.get("namespace", [None])[0]
             if (method == "GET"
@@ -391,13 +490,13 @@ class ApiServer:
                             int(cur) if cur is not None else None)
                 return
             objs = self.store.list(kind, ns)
-            h._send(200, {"items": [to_dict(o) for o in objs]})
+            h._send(200, {"items": [redact_for_read(to_dict(o)) for o in objs]})
             return
         if len(parts) == 3:
             # /apis/<kind>/<ns> — namespace-scoped list (also the natural
             # exploratory URL; must not 400 on a missing name segment)
             objs = self.store.list(kind, parts[2])
-            h._send(200, {"items": [to_dict(o) for o in objs]})
+            h._send(200, {"items": [redact_for_read(to_dict(o)) for o in objs]})
             return
         ns, name = parts[2], parts[3]
         if len(parts) == 5 and parts[4] == "events":
@@ -416,7 +515,7 @@ class ApiServer:
                 h._send(404, {"error": f"no logs: {e}"})
             return
         if method == "GET":
-            h._send(200, to_dict(self.store.get(kind, name, ns)))
+            h._send(200, redact_for_read(to_dict(self.store.get(kind, name, ns))))
             return
         if method == "PUT":
             if forbidden(ns):
@@ -425,7 +524,12 @@ class ApiServer:
             manifest.setdefault("kind", kind)
             obj = from_dict(manifest)
             obj.metadata.name, obj.metadata.namespace = name, ns
-            h._send(200, to_dict(self.store.update(obj)))
+            if kind in ("Profile", "JaxJob", "Pod"):
+                # GET -> edit -> PUT round-trip: redacted credentials mean
+                # "keep the stored secret", never clobber it
+                restore_redacted_on_write(
+                    kind, obj, self.store.try_get(kind, name, ns))
+            h._send(200, redact_for_read(to_dict(self.store.update(obj))))
             return
         if method == "DELETE":
             if forbidden(ns):

@@ -32,7 +32,14 @@ class _QueueItem:
 
 
 class WorkQueue:
-    """Deduplicating delay queue (client-go workqueue analog)."""
+    """Deduplicating delay queue (client-go workqueue analog).
+
+    In-flight dedup, client-go style: a key handed to a worker is
+    *processing* until ``done(key)``; adds for it meanwhile are parked
+    (dirty-set) and re-queued at ``done``.  Without this, two workers of
+    one controller can reconcile the SAME key concurrently and double-
+    apply a transition (e.g. two restart_count bumps for one gang
+    failure — the storm chaos testing surfaced, ISSUE 1)."""
 
     def __init__(self) -> None:
         self._lock = threading.Condition()
@@ -42,10 +49,19 @@ class WorkQueue:
         #: an immediate add always tightens a far-future TTL requeue
         #: (client-go Add vs AddAfter semantics).
         self._queued: dict[str, float] = {}
+        #: keys currently held by a worker (get() .. done())
+        self._processing: set[str] = set()
+        #: key -> earliest re-add time requested while processing
+        self._dirty: dict[str, float] = {}
 
     def add(self, key: str, delay: float = 0.0) -> None:
         at = time.time() + delay
         with self._lock:
+            if key in self._processing:
+                cur = self._dirty.get(key)
+                if cur is None or at < cur:
+                    self._dirty[key] = at
+                return
             earliest = self._queued.get(key)
             if earliest is not None and earliest <= at:
                 return
@@ -58,14 +74,22 @@ class WorkQueue:
             deadline = time.time() + timeout
             while True:
                 now = time.time()
+                popped = None
                 if self._heap and self._heap[0].at <= now:
-                    item = heapq.heappop(self._heap)
-                    remaining = [it.at for it in self._heap if it.key == item.key]
+                    popped = heapq.heappop(self._heap)
+                    remaining = [it.at for it in self._heap if it.key == popped.key]
                     if remaining:
-                        self._queued[item.key] = min(remaining)
+                        self._queued[popped.key] = min(remaining)
                     else:
-                        self._queued.pop(item.key, None)
-                    return item.key
+                        self._queued.pop(popped.key, None)
+                    if popped.key in self._processing:
+                        # another worker holds this key: park it dirty
+                        cur = self._dirty.get(popped.key)
+                        if cur is None or popped.at < cur:
+                            self._dirty[popped.key] = popped.at
+                        continue
+                    self._processing.add(popped.key)
+                    return popped.key
                 wait = min(
                     self._heap[0].at - now if self._heap else timeout,
                     deadline - now,
@@ -73,6 +97,21 @@ class WorkQueue:
                 if wait <= 0:
                     return None
                 self._lock.wait(wait)
+
+    def done(self, key: str) -> None:
+        """Worker finished ``key``: release it and re-queue any add that
+        arrived while it was processing."""
+        with self._lock:
+            self._processing.discard(key)
+            at = self._dirty.pop(key, None)
+            if at is None:
+                return
+            earliest = self._queued.get(key)
+            if earliest is not None and earliest <= at:
+                return
+            heapq.heappush(self._heap, _QueueItem(at, key))
+            self._queued[key] = at
+            self._lock.notify()
 
     def __len__(self) -> int:
         with self._lock:
@@ -222,10 +261,15 @@ class Controller:
                 log.exception("reconcile %s %s failed", self.kind, key)
                 back = min(self._backoff.get(key, 0.05) * 2, 5.0)
                 self._backoff[key] = back
+                self.queue.done(key)
                 self.queue.add(key, delay=back)
                 continue
             self.metrics.observe(time.perf_counter() - t0, error=False)
             self._backoff.pop(key, None)
+            # release BEFORE the requeue so the requeue lands in the heap,
+            # not the dirty set (watch events that arrived mid-reconcile
+            # are flushed by done() as well)
+            self.queue.done(key)
             if res and res.requeue_after is not None:
                 self.queue.add(key, delay=res.requeue_after)
 

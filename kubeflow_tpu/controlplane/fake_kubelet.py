@@ -7,17 +7,44 @@ a scripted outcome (success, exit code, hang) so reconciler + scheduler
 behavior — including failure/restart paths — is testable deterministically.
 The *real* kubelet is ``kubeflow_tpu.runtime.launcher``, which runs actual
 processes.
+
+Scripts come in two shapes:
+
+- the classic single-phase :class:`PodScript` (run N seconds, then exit /
+  hang), kept for every existing test;
+- multi-phase scripts (``PodScript.phases``): an ordered list of
+  :class:`ScriptPhase` steps the pod walks through while RUNNING — a
+  barrier crossing, healthy activity, an activity stall — before the
+  terminal outcome.  This is what the chaos layer
+  (:mod:`kubeflow_tpu.chaos`) drives: a pod that runs fine, goes quiet,
+  then dies is three phases, not a new kubelet.
+
+Passing ``chaos=FaultPlan(...)`` additionally lets the plan stall this
+kubelet's loop (detection-latency faults) and fire cluster-level faults
+(node drains) from ``step()``.
 """
 
 from __future__ import annotations
 
 import threading
 import time
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Callable, Optional
 
 from .objects import KIND_POD, Pod, PodPhase
 from .store import NotFound, Store
+
+
+@dataclass
+class ScriptPhase:
+    """One step of a multi-phase pod life (all while RUNNING)."""
+
+    duration: float = 0.0
+    #: the pod crosses its first collective barrier entering this phase
+    barrier: bool = False
+    #: whether the pod keeps reporting activity heartbeats in this phase
+    #: (False models a wedged-but-alive process going quiet)
+    activity: bool = True
 
 
 @dataclass
@@ -28,6 +55,10 @@ class PodScript:
     exit_code: int = 0
     barrier_after: Optional[float] = 0.0  # None = never reaches the barrier
     hang: bool = False
+    #: multi-phase mode: walk these steps, then apply exit_code/hang.
+    #: ``run_seconds``/``barrier_after`` are ignored when phases are set
+    #: (the phases carry the timing and the barrier crossing).
+    phases: list[ScriptPhase] = field(default_factory=list)
 
 
 DEFAULT_SCRIPT = PodScript()
@@ -35,16 +66,30 @@ DEFAULT_SCRIPT = PodScript()
 ScriptFn = Callable[[Pod], PodScript]
 
 
+@dataclass
+class _Running:
+    start: float
+    script: PodScript
+    phase: int = 0          # index into script.phases
+    phase_start: float = 0.0
+
+
 class FakeKubelet:
-    def __init__(self, store: Store, script: Optional[ScriptFn] = None, interval: float = 0.01):
+    def __init__(self, store: Store, script: Optional[ScriptFn] = None,
+                 interval: float = 0.01, chaos=None):
         self.store = store
+        if script is None and chaos is not None:
+            script = chaos.script_fn()
         self.script: ScriptFn = script or (lambda pod: DEFAULT_SCRIPT)
         self.interval = interval
+        self.chaos = chaos
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
-        self._running: dict[str, tuple[float, PodScript]] = {}  # key -> (start, script)
+        self._running: dict[str, _Running] = {}
 
     def start(self) -> None:
+        if self.chaos is not None:
+            self.chaos.activate()
         self._thread = threading.Thread(target=self._loop, name="fake-kubelet", daemon=True)
         self._thread.start()
 
@@ -56,6 +101,9 @@ class FakeKubelet:
     def _loop(self) -> None:
         while not self._stop.is_set():
             try:
+                if self.chaos is not None and self.chaos.kubelet_stalled():
+                    self._stop.wait(self.interval)
+                    continue
                 self.step()
             except Exception:  # noqa: BLE001
                 pass
@@ -63,31 +111,68 @@ class FakeKubelet:
 
     def step(self) -> None:
         now = time.time()
+        if self.chaos is not None:
+            self.chaos.apply_cluster_faults(self.store, now)
         for pod in self.store.list(KIND_POD):
             assert isinstance(pod, Pod)
             key = f"{pod.metadata.namespace}/{pod.metadata.name}/{pod.metadata.uid}"
             if pod.status.phase == PodPhase.PENDING and pod.spec.node_name:
                 script = self.script(pod)
-                self._running[key] = (now, script)
+                self._running[key] = _Running(now, script, phase_start=now)
                 self._mutate(pod, lambda o: self._start(o, now, script))
             elif pod.status.phase == PodPhase.RUNNING and key in self._running:
-                start, script = self._running[key]
-                if script.hang:
+                run = self._running[key]
+                if run.script.phases:
+                    self._step_phases(pod, run, now, key)
                     continue
-                if now - start >= script.run_seconds:
+                if run.script.hang:
+                    continue
+                if now - run.start >= run.script.run_seconds:
                     del self._running[key]
-                    self._mutate(pod, lambda o: self._finish(o, script, now))
+                    self._mutate(pod, lambda o: self._finish(o, run.script, now))
+
+    def _step_phases(self, pod: Pod, run: _Running, now: float, key: str) -> None:
+        """Advance a multi-phase script: cross due phase boundaries, stamp
+        barrier/activity status, finish after the last phase."""
+        while run.phase < len(run.script.phases):
+            phase = run.script.phases[run.phase]
+            if now - run.phase_start < phase.duration:
+                # heartbeat at ~10 Hz, not every kubelet tick: each write
+                # is a store update fanning out to every watch
+                if phase.activity and (pod.status.last_activity is None
+                                       or now - pod.status.last_activity >= 0.1):
+                    self._mutate(pod, lambda o: setattr(
+                        o.status, "last_activity", now))
+                return
+            run.phase += 1
+            run.phase_start = now
+            if run.phase < len(run.script.phases):
+                nxt = run.script.phases[run.phase]
+                if nxt.barrier:
+                    self._mutate(pod, lambda o: setattr(
+                        o.status, "barrier_time",
+                        o.status.barrier_time or now))
+        if run.script.hang:
+            return
+        del self._running[key]
+        self._mutate(pod, lambda o: self._finish(o, run.script, now))
 
     @staticmethod
     def _start(pod: Pod, now: float, script: PodScript) -> None:
         pod.status.phase = PodPhase.RUNNING
         pod.status.start_time = now
-        if script.barrier_after is not None and script.barrier_after <= 0:
+        if script.phases:
+            if script.phases[0].barrier:
+                pod.status.barrier_time = now
+            if script.phases[0].activity:
+                pod.status.last_activity = now
+        elif script.barrier_after is not None and script.barrier_after <= 0:
             pod.status.barrier_time = now
 
     @staticmethod
     def _finish(pod: Pod, script: PodScript, now: float) -> None:
-        if script.barrier_after is not None and pod.status.barrier_time is None:
+        if (not script.phases and script.barrier_after is not None
+                and pod.status.barrier_time is None):
             pod.status.barrier_time = (pod.status.start_time or now) + script.barrier_after
         pod.status.phase = PodPhase.SUCCEEDED if script.exit_code == 0 else PodPhase.FAILED
         pod.status.exit_code = script.exit_code

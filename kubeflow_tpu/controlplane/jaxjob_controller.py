@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import json
 import time
+import zlib
 from typing import Optional
 
 from ..api.common import (
@@ -30,6 +31,7 @@ from ..api.common import (
     OwnerReference,
     ReplicaStatus,
     RestartPolicy,
+    get_condition,
     has_condition,
     is_retryable_exit,
     replica_pod_name,
@@ -97,6 +99,17 @@ class JaxJobController(Controller):
     # -- reconcile ------------------------------------------------------------
 
     def reconcile(self, namespace: str, name: str) -> Optional[Result]:
+        try:
+            return self._reconcile(namespace, name)
+        except NotFound:
+            # the job (or an object mid-update) vanished under this pass —
+            # a benign race with deletion, not a reconcile error: the
+            # deletion's own watch event re-enqueues the key and the next
+            # pass runs orphan cleanup (controller-runtime's IsNotFound
+            # convention)
+            return Result(requeue_after=0.02)
+
+    def _reconcile(self, namespace: str, name: str) -> Optional[Result]:
         key = f"{namespace}/{name}"
         job = self.store.try_get(KIND_JAXJOB, name, namespace)
         if job is None:
@@ -136,6 +149,13 @@ class JaxJobController(Controller):
             return None
 
         job = self._resolve_coordinator_port(job)
+
+        # restart pacing: while a gang restart's backoff window is open,
+        # hold pod re-creation (a requeue alone would not — any owned-pod
+        # event re-enqueues the key immediately)
+        hold = self._restart_hold(job)
+        if hold > 0:
+            return Result(requeue_after=hold)
         self._ensure_pods_services(job, pods)
 
         # refresh pod view after creations for status aggregation
@@ -336,6 +356,15 @@ class JaxJobController(Controller):
             job = self._set_cond(job, JobConditionType.RUNNING, "JobRunning", "workers running")
             job = self._update_job(job, lambda o: setattr(o.status, "start_time", o.status.start_time or time.time()))
 
+        running_workers = sum(
+            1 for p in pods
+            if p.metadata.labels.get(LABEL_REPLICA_TYPE) == WORKER
+            and p.status.phase == PodPhase.RUNNING
+        )
+        if not failed_pods and workers_total > 0 and running_workers == workers_total:
+            job = self._observe_recovery(job)
+            job = self._maybe_reset_restart_budget(job)
+
         # deadline
         rp = job.spec.run_policy
         if rp.active_deadline_seconds and job.status.start_time:
@@ -357,6 +386,61 @@ class JaxJobController(Controller):
 
         # keep polling while pods run (deadline / straggler watching)
         return Result(requeue_after=0.05) if any_running or worker_rs.active else None
+
+    def _observe_recovery(self, job: JaxJob) -> JaxJob:
+        """Every worker is Running again after a gang restart: close the
+        Restarting condition and record restart->RUNNING latency (the
+        recovery metric scripts/recovery_bench.py tracks the way
+        gang_startup_bench.py tracks startup)."""
+        cond = get_condition(job.status.conditions, JobConditionType.RESTARTING)
+        if cond is None or not cond.status:
+            return job
+        # a resize also rides the Restarting condition but does not stamp
+        # last_restart_time; its re-forming must not mint a bogus
+        # recovery-latency sample off a stale failure timestamp
+        recovery = (
+            time.time() - job.status.last_restart_time
+            if cond.reason == "PodsRestarting"
+            and job.status.last_restart_time is not None else None
+        )
+
+        def mut(o):
+            assert isinstance(o, JaxJob)
+            o.status.conditions = set_condition(
+                o.status.conditions,
+                JobCondition(type=JobConditionType.RESTARTING, status=False,
+                             reason="GangRecovered", message="gang re-formed"),
+            )
+            if recovery is not None:
+                o.status.last_recovery_seconds = recovery
+
+        job = self._update_job(job, mut)
+        self.emit_event(
+            job, "GangRecovered",
+            json.dumps({"restart": job.status.restart_count,
+                        "recovery_seconds":
+                            round(recovery, 3) if recovery is not None else None}))
+        return job
+
+    def _maybe_reset_restart_budget(self, job: JaxJob) -> JaxJob:
+        """Stable past the restart window -> restart_count goes back to 0,
+        so backoff_limit bounds *flapping*, not lifetime bad luck."""
+        rp = job.spec.run_policy
+        if (rp.restart_window_seconds is None or not job.status.restart_count
+                or has_condition(job.status.conditions, JobConditionType.RESTARTING)):
+            return job
+        anchor = job.status.last_restart_time or job.status.start_time
+        if anchor is None:
+            return job
+        anchor += job.status.last_recovery_seconds or 0.0
+        if time.time() - anchor <= rp.restart_window_seconds:
+            return job
+        job = self._update_job(
+            job, lambda o: setattr(o.status, "restart_count", 0))
+        self.emit_event(
+            job, "RestartBudgetReset",
+            f"stable for {rp.restart_window_seconds}s; restart budget restored")
+        return job
 
     def _handle_failures(
         self, job: JaxJob, pods: list[Pod], failed_pods: list[Pod]
@@ -394,6 +478,7 @@ class JaxJobController(Controller):
 
         def bump(o):
             o.status.restart_count += 1
+            o.status.last_restart_time = time.time()
             if not o.spec.coordinator_port:
                 # fresh coordinator port for the new incarnation: the old
                 # coordinator process may hold the previous port through
@@ -405,9 +490,41 @@ class JaxJobController(Controller):
                 # the new port in their env
                 o.status.coordinator_port = None
 
-        self._update_job(job, bump)
-        self.emit_event(job, "Restarting", f"gang restart #{job.status.restart_count + 1}", "Warning")
-        return Result(requeue_after=0.05)
+        job = self._update_job(job, bump)
+        delay = self._restart_backoff(job)
+        self.emit_event(
+            job, "Restarting",
+            json.dumps({"restart": job.status.restart_count,
+                        "backoff_seconds": round(delay, 3)}),
+            "Warning")
+        return Result(requeue_after=delay)
+
+    # -- restart pacing --------------------------------------------------------
+
+    def _restart_backoff(self, job: JaxJob) -> float:
+        """Delay before the gang's next incarnation: exponential in the
+        restart count, capped, with deterministic +-50% jitter (stable
+        across reconcile passes — a random draw here would make the hold
+        gate flicker — but decorrelated across jobs, so N gangs felled by
+        one node do not re-form in lockstep)."""
+        rp = job.spec.run_policy
+        n = max(job.status.restart_count - 1, 0)
+        base = min(rp.restart_backoff_seconds * (2 ** n),
+                   rp.restart_backoff_max_seconds)
+        salt = f"{job.metadata.uid}:{job.status.restart_count}".encode()
+        jitter = 0.5 + (zlib.crc32(salt) % 1000) / 1000.0
+        return base * jitter
+
+    def _restart_hold(self, job: JaxJob) -> float:
+        """Seconds the backoff window still has open, 0 when clear."""
+        if not has_condition(job.status.conditions, JobConditionType.RESTARTING):
+            return 0.0
+        if job.status.last_restart_time is None:
+            return 0.0
+        return max(
+            0.0,
+            job.status.last_restart_time + self._restart_backoff(job) - time.time(),
+        )
 
     # -- terminal helpers ------------------------------------------------------
 

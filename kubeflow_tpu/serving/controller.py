@@ -111,14 +111,28 @@ class _GangPredictor:
         self.metrics = _GangMetrics(f"http://127.0.0.1:{self.port}")
         self._ready_at: float = 0.0
         self._ready_fail_at: float = -10.0
+        import os
         import secrets
+        import tempfile
 
         conf = dict(cfg)
         conf["serve_port"] = self.port
         conf["gang_port"] = allocate_port()
-        # per-job shared secret guarding the gang control stream: only
-        # processes holding this job's env may occupy a follower slot
-        conf["gang_token"] = secrets.token_hex(16)
+        # per-job shared secret guarding the gang control stream,
+        # delivered over a side channel: a 0600 token FILE (the
+        # Secret-mount analog), because the JaxJob env is cluster-readable
+        # through the API server and an inline token would let any tenant
+        # who can GET the job join the stream (ADVICE r5).  Only the
+        # file's PATH enters the env.
+        fd, token_path = tempfile.mkstemp(
+            prefix=f"kft-gang-{self.job_name}-", suffix=".token")
+        try:
+            os.fchmod(fd, 0o600)
+            os.write(fd, secrets.token_hex(16).encode())
+        finally:
+            os.close(fd)
+        self._token_path = token_path
+        conf["gang_token_file"] = token_path
         conf["mesh_axes"] = dict(gang.mesh_axes)
         conf.setdefault("model_name", isvc.metadata.name)
         logger = isvc.spec.predictor.logger
@@ -181,11 +195,17 @@ class _GangPredictor:
         return ok
 
     def stop(self) -> None:
+        import os
+
         from ..api.jaxjob import KIND_JAXJOB
 
         try:
             self.store.delete(KIND_JAXJOB, self.job_name, self.namespace)
         except NotFound:
+            pass
+        try:
+            os.unlink(self._token_path)
+        except OSError:
             pass
 
 
@@ -377,8 +397,8 @@ class _Deployment:
 class InferenceServiceController(Controller):
     kind = KIND_INFERENCE_SERVICE
     # one worker: reconciles mutate live _Deployment state (servers, router
-    # backends); two workers on the same key would race — the workqueue only
-    # dedups queued keys, not in-flight ones
+    # backends); the workqueue serializes per key, but one worker keeps the
+    # cross-key server/port churn sequential too
     workers = 1
 
     def __init__(self, store: Store) -> None:
@@ -482,14 +502,32 @@ class InferenceServiceController(Controller):
             _up(dep.stable) or dep.stable.spec.predictor.min_replicas == 0)
         canary_ready = dep.canary is None or _up(dep.canary)
         ready = stable_ready and canary_ready
+        # Degraded: serving (some replica answers) but below strength — a
+        # gang re-forming after a member loss, say.  The router already
+        # routes around the non-ready replicas (_wire_revision filters);
+        # the phase makes the reduced capacity observable instead of
+        # masquerading as fully Ready.
+        total_preds = sum(len(r.predictors) for r in dep.revisions)
+        ready_preds = sum(
+            1 for r in dep.revisions for s in r.predictors
+            if getattr(s, "ready", True))
+        degraded = ready and ready_preds < total_preds
+        if degraded:
+            phase = InferenceServicePhase.DEGRADED
+        elif ready:
+            phase = InferenceServicePhase.READY
+        else:
+            phase = InferenceServicePhase.LOADING
         stable_spec = dep.stable.spec.model_dump(mode="json")
         stable_spec.pop("canary_traffic_percent", None)
         self._set_status(
             isvc,
-            phase=InferenceServicePhase.READY if ready else InferenceServicePhase.LOADING,
+            phase=phase,
             url=dep.router.url,
             active_replicas=sum(len(r.predictors) for r in dep.revisions),
-            message="",
+            message=(f"{total_preds - ready_preds}/{total_preds} replicas "
+                     "re-forming; routing to healthy replicas"
+                     if degraded else ""),
             stable_revision=dep.stable.rev,
             canary_revision=dep.canary.rev if dep.canary else None,
             canary_traffic=dep.pct,

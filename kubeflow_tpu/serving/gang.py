@@ -35,10 +35,27 @@ the AOT artifact, scripts/aot_7b_serving.py) compiles — the gang changes
 where processes sit, not what runs.  ``__graft_entry__.dryrun_multichip``'s
 serving leg therefore covers the gang's data plane.
 
-Failure semantics ride the JaxJob machinery: the InferenceService
-controller places the gang as a JaxJob (serving/controller.py
-``_GangPredictor``); a crashed member fails its pod, the JaxJob
-controller gang-restarts, and rank 0 re-binds the same frontend port.
+Failure semantics are layered (ISSUE 1):
+
+- the control stream heals itself first: rank 0 heartbeats the stream
+  and keeps a bounded replay log; a follower whose socket drops (the
+  process is alive — only the TCP link died) reconnects with exponential
+  backoff, re-authenticates, reports the last sequence it applied, and
+  rank 0 replays exactly the missed frames.  Rank 0 *evicts* a dead
+  connection instead of wedging the scheduler, and re-admits the same
+  rank on reconnect (an extra token-valid connection replaces its
+  predecessor — it never consumes another follower slot);
+- only when a follower stays gone past the re-attach grace (its process
+  is actually dead) or falls off the replay log does the failure
+  escalate to the JaxJob machinery: rank 0's engine goes fatal, the pod
+  exits non-zero, the JaxJob controller gang-restarts (with jittered
+  backoff), and rank 0 re-binds the same frontend port.  While the gang
+  re-forms, the InferenceService controller marks the revision Degraded
+  and keeps routing to its healthy replicas.
+
+Chaos testing: every socket the channel creates passes through an
+injectable ``sock_wrap`` (``kubeflow_tpu.chaos.FaultPlan.socket_wrapper``),
+so drops/delays can be injected at exact protocol points.
 """
 
 from __future__ import annotations
@@ -77,109 +94,421 @@ class GangChannel:
     request payloads to the outside world and never device data.
 
     Trust boundary: the stream is pickle between processes of ONE JaxJob,
-    so admission to it is guarded by a per-job shared ``token`` (frozen
-    into the gang's env by the ISvc controller, like the pod's other
-    credentials) — a follower must present it before it may occupy a
-    slot, and rank 0 closes anything that doesn't.  Deserialization
-    still trusts rank 0, which is the same trust a follower already
-    extends to the process that chose its dispatch stream.
+    so admission is guarded by a per-job shared ``token`` (delivered to
+    the gang's pods through a side channel — a 0600 token file, the
+    Secret-mount analog — NOT the cluster-readable env).  A follower must
+    present it before it may occupy a slot; a token-valid connection for
+    an already-connected rank REPLACES that rank's connection (reconnect
+    semantics) rather than consuming another slot, and rank 0 closes
+    anything that fails the handshake.  Deserialization still trusts
+    rank 0, which is the same trust a follower already extends to the
+    process that chose its dispatch stream.
+
+    Liveness + recovery (module docstring): rank 0 heartbeats every
+    ``hb_interval`` and keeps the last ``replay_log`` published frames;
+    followers ack their applied sequence.  A follower socket that errors
+    or goes silent past ``dead_peer_timeout`` is EVICTED (publishing
+    continues into the log); a follower that reconnects within
+    ``reattach_timeout`` re-auths, reports its last applied seq and has
+    exactly the missed frames replayed.  Past the grace — or off the end
+    of the replay log — the channel goes fatal and the JaxJob gang
+    restart takes over.
+
+    ``sock_wrap`` wraps every socket the channel creates (chaos
+    injection seam, kubeflow_tpu.chaos).
     """
 
-    def __init__(self, conns: list[socket.socket], rank: int) -> None:
-        self._conns = conns
+    #: wire frame tags (leader->follower: msg/hb/gone; follower->leader:
+    #: hello/ack)
+    _MSG, _HB, _GONE, _HELLO, _ACK = "msg", "hb", "gone", "hello", "ack"
+
+    def __init__(self, rank: int, *, token: str = "",
+                 hb_interval: float = 0.5, dead_peer_timeout: float = 3.0,
+                 reattach_timeout: float = 10.0,
+                 reconnect_timeout: float = 10.0, replay_log: int = 1024,
+                 sock_wrap=None) -> None:
         self.rank = rank
+        self._token = token
+        self._hb_interval = hb_interval
+        self._dead_peer_timeout = dead_peer_timeout
+        self._reattach_timeout = reattach_timeout
+        self._reconnect_timeout = reconnect_timeout
+        self._sock_wrap = sock_wrap or (lambda s: s)
         self._lock = threading.Lock()
+        self._joined = threading.Condition(self._lock)
+        self._closing = threading.Event()
+        # leader state
+        self._srv: Optional[socket.socket] = None
+        self._want = 0
+        self._followers: dict[int, Any] = {}
+        self._last_ack: dict[int, float] = {}
+        self._lost: dict[int, float] = {}
+        from collections import deque
+
+        self._log: "deque[tuple[int, bytes]]" = deque(maxlen=max(replay_log, 1))
+        self._seq = 0
+        self._dead: Optional[Exception] = None
+        # follower state
+        self._sock: Optional[Any] = None
+        self._addr: Optional[tuple[str, int]] = None
+        #: highest sequence this follower has returned from next()
+        self.last_seq = 0
 
     # -- construction ------------------------------------------------------
 
     @classmethod
     def listen(cls, port: int, num_followers: int, token: str = "",
-               timeout: float = 60.0) -> "GangChannel":
+               timeout: float = 60.0, **kw) -> "GangChannel":
         """Rank 0: accept every follower (they dial after the gang
-        barrier, so all are alive or the job already failed).  A
-        connection that fails the token handshake is dropped without
-        consuming a follower slot."""
-        import hmac
-
-        want = token.encode()
+        barrier, so all are alive or the job already failed), then keep
+        the listener open for re-attaches.  A connection that fails the
+        token handshake is dropped without consuming a follower slot."""
+        ch = cls(0, token=token, **kw)
+        ch._want = num_followers
         srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         srv.bind(("0.0.0.0", port))
         srv.listen(max(num_followers, 1))
-        srv.settimeout(timeout)
+        srv.settimeout(0.2)
+        ch._srv = srv
+        threading.Thread(
+            target=ch._accept_loop, name="gang-accept", daemon=True).start()
+        threading.Thread(
+            target=ch._hb_loop, name="gang-hb", daemon=True).start()
         deadline = time.monotonic() + timeout
-        conns: list[socket.socket] = []
-        try:
-            while len(conns) < num_followers:
-                if time.monotonic() > deadline:
-                    raise TimeoutError(
-                        f"only {len(conns)}/{num_followers} followers "
-                        "passed the gang handshake")
-                c, _addr = srv.accept()
-                try:
-                    c.settimeout(5.0)
-                    (n,) = _LEN.unpack(cls._read_exact(c, _LEN.size))
-                    got = cls._read_exact(c, n) if n <= 4096 else b""
-                    if not hmac.compare_digest(got, want):
-                        raise ChannelClosed("bad gang token")
-                    c.settimeout(None)
-                    c.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-                    conns.append(c)
-                except (OSError, ChannelClosed, struct.error):
-                    c.close()
-        finally:
-            srv.close()
-        return cls(conns, rank=0)
+        with ch._lock:
+            while len(ch._followers) < num_followers:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                ch._joined.wait(remaining)
+            got = len(ch._followers)
+        if got < num_followers:
+            ch.close()
+            raise TimeoutError(
+                f"only {got}/{num_followers} followers "
+                "passed the gang handshake")
+        return ch
 
     @classmethod
     def connect(cls, host: str, port: int, rank: int, token: str = "",
-                timeout: float = 60.0) -> "GangChannel":
-        payload = token.encode()
+                timeout: float = 60.0, **kw) -> "GangChannel":
+        ch = cls(rank, token=token, **kw)
+        ch._addr = (host, port)
+        ch._dial(timeout)
+        threading.Thread(
+            target=ch._ack_loop, name=f"gang-ack-{rank}", daemon=True).start()
+        return ch
+
+    # -- leader: accept / admit / evict / heartbeat ------------------------
+
+    #: handshake frames are JSON (never pickle: they arrive from
+    #: UNAUTHENTICATED peers — pre-auth pickle.loads would be arbitrary
+    #: code execution) and length-capped before the body is even read
+    _HELLO_MAX = 4096
+
+    def _accept_loop(self) -> None:
+        import hmac
+
+        while not self._closing.is_set():
+            srv = self._srv
+            if srv is None:  # close() raced us and nulled the listener
+                return
+            try:
+                raw, _addr = srv.accept()
+            except (socket.timeout, TimeoutError):
+                continue
+            except OSError:
+                return
+            c = self._sock_wrap(raw)
+            try:
+                c.settimeout(5.0)
+                (n,) = _LEN.unpack(self._read_exact(c, _LEN.size))
+                if n > self._HELLO_MAX:
+                    raise ChannelClosed("oversized handshake")
+                hello = json.loads(self._read_exact(c, n).decode())
+                if not isinstance(hello, dict) or hello.get("t") != self._HELLO:
+                    raise ChannelClosed("bad handshake")
+                if not hmac.compare_digest(
+                        str(hello.get("token", "")), self._token):
+                    raise ChannelClosed("bad gang token")
+                rank = int(hello.get("rank", -1))
+                last_seq = int(hello.get("last_seq", 0))
+                if rank < 1 or (self._want and rank > self._want):
+                    raise ChannelClosed(f"rank {rank} out of range")
+                # bounded sends from here on: a wedged-but-alive follower
+                # whose receive buffer fills must stall the leader for at
+                # most dead_peer_timeout, not forever (see publish)
+                c.settimeout(self._dead_peer_timeout)
+                try:
+                    c.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                except OSError:
+                    pass
+                self._admit(c, rank, last_seq)
+            except (OSError, ChannelClosed, EOFError, struct.error,
+                    ValueError):
+                try:
+                    c.close()
+                except OSError:
+                    pass
+
+    def _admit(self, c, rank: int, last_seq: int) -> None:
+        """Install (or re-install) a follower connection after a valid
+        handshake, replaying exactly the frames it missed."""
+        with self._lock:
+            if last_seq < self._seq:
+                oldest = self._log[0][0] if self._log else self._seq + 1
+                if last_seq + 1 < oldest:
+                    # the gap rolled off the replay log: this follower can
+                    # no longer be resynced at the channel layer — tell it
+                    # to die so the JaxJob gang restart takes over
+                    try:
+                        c.sendall(self._frame(
+                            (self._GONE, "replay log exhausted")))
+                    except OSError:
+                        pass
+                    try:
+                        c.close()
+                    except OSError:
+                        pass
+                    return
+                for s, fb in list(self._log):
+                    if s > last_seq:
+                        c.sendall(fb)  # OSError -> caller drops the conn
+            old = self._followers.pop(rank, None)
+            self._followers[rank] = c
+            self._lost.pop(rank, None)
+            self._last_ack[rank] = time.monotonic()
+            self._joined.notify_all()
+        if old is not None:
+            # an extra token-valid connection REPLACES its rank's slot
+            try:
+                old.close()
+            except OSError:
+                pass
+        threading.Thread(
+            target=self._ack_reader, args=(rank, c),
+            name=f"gang-ackr-{rank}", daemon=True).start()
+
+    def _ack_reader(self, rank: int, c) -> None:
+        """Per-follower reader: acks refresh liveness; EOF/error evicts.
+        A recv timeout alone is NOT an eviction — the socket carries
+        dead_peer_timeout so leader SENDS stay bounded, and ack staleness
+        is judged by _hb_loop against _last_ack."""
+        while not self._closing.is_set():
+            try:
+                frame = self._recv_frame(c)
+            except (socket.timeout, TimeoutError):
+                continue
+            except (ChannelClosed, OSError, EOFError, struct.error,
+                    pickle.UnpicklingError):
+                self._evict(rank, c)
+                return
+            if (isinstance(frame, tuple) and len(frame) == 3
+                    and frame[0] == self._ACK):
+                with self._lock:
+                    if self._followers.get(rank) is c:
+                        self._last_ack[rank] = time.monotonic()
+
+    def _evict(self, rank: int, c=None) -> None:
+        with self._lock:
+            self._evict_locked(rank, c)
+
+    def _evict_locked(self, rank: int, c=None) -> None:
+        cur = self._followers.get(rank)
+        if cur is None or (c is not None and cur is not c):
+            return
+        del self._followers[rank]
+        self._last_ack.pop(rank, None)
+        self._lost[rank] = time.monotonic()
+        try:
+            cur.close()
+        except OSError:
+            pass
+
+    def _hb_loop(self) -> None:
+        """Leader liveness pump: heartbeat every interval (so an idle
+        stream still proves rank 0 alive), evict silent followers, and go
+        fatal when an evicted rank overstays the re-attach grace."""
+        while not self._closing.wait(self._hb_interval):
+            now = time.monotonic()
+            with self._lock:
+                frame = self._frame((self._HB, self._seq))
+                for rank, c in list(self._followers.items()):
+                    if now - self._last_ack.get(rank, now) > self._dead_peer_timeout:
+                        self._evict_locked(rank)
+                        continue
+                    try:
+                        c.sendall(frame)
+                    except OSError:
+                        self._evict_locked(rank)
+                if self._dead is None:
+                    for rank, t in self._lost.items():
+                        if now - t > self._reattach_timeout:
+                            self._dead = ChannelClosed(
+                                f"follower rank {rank} gone for "
+                                f"{self._reattach_timeout:.1f}s; "
+                                "gang must restart")
+                            break
+
+    @property
+    def missing_ranks(self) -> list[int]:
+        """Evicted followers awaiting re-attach (leader side)."""
+        with self._lock:
+            return sorted(self._lost)
+
+    # -- follower: dial / reconnect / ack ----------------------------------
+
+    def _dial(self, timeout: float) -> None:
         deadline = time.monotonic() + timeout
+        delay = 0.05
         while True:
             try:
-                c = socket.create_connection((host, port), timeout=5.0)
-                c.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-                c.sendall(_LEN.pack(len(payload)) + payload)
-                c.settimeout(None)
-                return cls([c], rank=rank)
+                raw = socket.create_connection(self._addr, timeout=5.0)
+                c = self._sock_wrap(raw)
+                try:
+                    c.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                except OSError:
+                    pass
+                hello = json.dumps({
+                    "t": self._HELLO, "token": self._token,
+                    "rank": self.rank, "last_seq": self.last_seq,
+                }).encode()
+                c.sendall(_LEN.pack(len(hello)) + hello)
+                c.settimeout(self._dead_peer_timeout)
+                with self._lock:
+                    old, self._sock = self._sock, c
+                if old is not None:
+                    try:
+                        old.close()
+                    except OSError:
+                        pass
+                return
             except OSError:
                 if time.monotonic() > deadline:
                     raise
-                time.sleep(0.05)
+                time.sleep(delay)
+                delay = min(delay * 2, 1.0)
+
+    def _reconnect(self) -> None:
+        with self._lock:
+            old, self._sock = self._sock, None
+        if old is not None:
+            try:
+                old.close()
+            except OSError:
+                pass
+        try:
+            self._dial(self._reconnect_timeout)
+        except OSError as e:
+            raise ChannelClosed(
+                f"rank 0 unreachable after {self._reconnect_timeout:.1f}s "
+                f"of reconnect attempts: {e}") from e
+
+    def _ack_loop(self) -> None:
+        while not self._closing.wait(self._hb_interval):
+            with self._lock:
+                c = self._sock
+            if c is None:
+                continue
+            try:
+                c.sendall(self._frame((self._ACK, self.rank, self.last_seq)))
+            except OSError:
+                pass  # next() notices the dead socket and reconnects
 
     # -- wire --------------------------------------------------------------
 
     def publish(self, msg: tuple) -> None:
-        payload = pickle.dumps(msg, protocol=pickle.HIGHEST_PROTOCOL)
-        frame = _LEN.pack(len(payload)) + payload
+        """Leader: sequence, log, and fan out one control frame.  A send
+        failure evicts that follower (the frame is in the replay log for
+        its re-attach); the call only raises once a follower has
+        overstayed the re-attach grace — the point where the gang can no
+        longer heal at this layer."""
         with self._lock:
-            for c in self._conns:
+            if self._dead is not None:
+                raise self._dead
+            self._seq += 1
+            frame = self._frame((self._MSG, self._seq, msg))
+            self._log.append((self._seq, frame))
+            for rank, c in list(self._followers.items()):
                 try:
                     c.sendall(frame)
-                except OSError as e:
-                    raise ChannelClosed(f"follower gone: {e}") from e
+                except OSError:
+                    self._evict_locked(rank)
 
     def next(self) -> tuple:
-        (c,) = self._conns
-        header = self._read_exact(c, _LEN.size)
-        (n,) = _LEN.unpack(header)
-        return pickle.loads(self._read_exact(c, n))
+        """Follower: the next control message, transparently surviving
+        socket drops (reconnect + leader-side replay) and filtering
+        liveness frames."""
+        while True:
+            with self._lock:
+                c = self._sock
+            if c is None:
+                self._reconnect()
+                continue
+            try:
+                frame = self._recv_frame(c)
+            except (socket.timeout, TimeoutError):
+                # no data and no heartbeat for dead_peer_timeout: the
+                # leader is silent — treat as a dead link and re-dial
+                self._reconnect()
+                continue
+            except (ChannelClosed, OSError, EOFError, struct.error,
+                    pickle.UnpicklingError):
+                if self._closing.is_set():
+                    raise ChannelClosed("channel closed")
+                self._reconnect()
+                continue
+            tag = frame[0] if isinstance(frame, tuple) and frame else None
+            if tag == self._HB:
+                continue
+            if tag == self._MSG:
+                _, seq, payload = frame
+                if seq <= self.last_seq:
+                    continue  # replay overlap after a reconnect race
+                self.last_seq = seq
+                return payload
+            if tag == self._GONE:
+                raise ChannelClosed(f"rank 0 rejected re-attach: {frame[1]}")
+            raise ChannelClosed(f"unknown control frame {tag!r}")
+
+    @classmethod
+    def _frame(cls, obj) -> bytes:
+        payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+        return _LEN.pack(len(payload)) + payload
+
+    @classmethod
+    def _recv_frame(cls, c):
+        (n,) = _LEN.unpack(cls._read_exact(c, _LEN.size))
+        return pickle.loads(cls._read_exact(c, n))
 
     @staticmethod
-    def _read_exact(c: socket.socket, n: int) -> bytes:
+    def _read_exact(c, n: int) -> bytes:
         buf = b""
         while len(buf) < n:
             chunk = c.recv(n - len(buf))
             if not chunk:
-                raise ChannelClosed("rank 0 closed the control stream")
+                raise ChannelClosed("peer closed the control stream")
             buf += chunk
         return buf
 
     def close(self) -> None:
-        for c in self._conns:
+        self._closing.set()
+        with self._lock:
+            socks = list(self._followers.values())
+            self._followers.clear()
+            if self._sock is not None:
+                socks.append(self._sock)
+                self._sock = None
+            srv, self._srv = self._srv, None
+        for s in socks:
             try:
-                c.close()
+                s.close()
+            except OSError:
+                pass
+        if srv is not None:
+            try:
+                srv.close()
             except OSError:
                 pass
 
@@ -461,6 +790,22 @@ def follow(engine: contlib.ContinuousEngine, channel: GangChannel) -> None:
 # ---------------------------------------------------------------------------
 
 
+def _resolve_gang_token(conf: dict) -> str:
+    """The gang token arrives over a side channel — ``gang_token_file``,
+    a 0600 file the ISvc controller writes (the Secret-mount analog) —
+    NOT the JaxJob env: JaxJobs are cluster-readable through the API
+    server, and an inline token would let any tenant who can GET the job
+    join the control stream (ADVICE r5).  Inline ``gang_token`` is kept
+    for hand-rolled/test configs."""
+    path = conf.get("gang_token_file")
+    if path:
+        # a missing/unreadable file must fail the pod loudly — falling
+        # back to an empty token would silently open the gang
+        with open(path) as f:
+            return f.read().strip()
+    return str(conf.get("gang_token", ""))
+
+
 def serve_main(ctx: bootstrap.PodContext) -> None:
     """Entrypoint for every member of a serving gang (via pod_main:
     ``jax.distributed`` is already initialized and the gang barrier
@@ -484,13 +829,20 @@ def serve_main(ctx: bootstrap.PodContext) -> None:
     kw = contlib.engine_kwargs(conf, default_eos=conf.get("eos_id"))
     kw["seq_buckets"] = conf.get("seq_buckets")
     gang_port = int(conf["gang_port"])
-    token = str(conf.get("gang_token", ""))
+    token = _resolve_gang_token(conf)
+    chan_kw = dict(
+        hb_interval=float(conf.get("gang_hb_interval", 0.5)),
+        dead_peer_timeout=float(conf.get("gang_dead_peer_timeout", 3.0)),
+        reattach_timeout=float(conf.get("gang_reattach_timeout", 10.0)),
+        reconnect_timeout=float(conf.get("gang_reconnect_timeout", 10.0)),
+    )
     followers = ctx.num_processes - 1
 
     if ctx.process_id == 0:
         from .server import ModelServer
 
-        channel = GangChannel.listen(gang_port, followers, token=token)
+        channel = GangChannel.listen(
+            gang_port, followers, token=token, **chan_kw)
         engine = GangEngine(cfg, params, channel=channel, **kw)
         groups = conf.get("warmup_groups")
         if groups != []:
@@ -531,9 +883,11 @@ def serve_main(ctx: bootstrap.PodContext) -> None:
         try:
             while not stop.is_set():
                 # a dead follower surfaces as a ChannelClosed publish
-                # failure inside the scheduler -> engine error; exit
-                # non-zero so the JaxJob controller gang-restarts
-                if engine._error is not None:
+                # failure inside the scheduler -> engine error; an IDLE
+                # gang publishes nothing, so also watch the channel's own
+                # fatal flag (a follower past its re-attach grace).  Exit
+                # non-zero so the JaxJob controller gang-restarts.
+                if engine._error is not None or channel._dead is not None:
                     raise SystemExit(1)
                 stop.wait(0.2)
         finally:
@@ -543,7 +897,7 @@ def serve_main(ctx: bootstrap.PodContext) -> None:
         host, _, _ = bootstrap.resolve_coordinator(
             ctx.coordinator_address or "127.0.0.1:0").rpartition(":")
         channel = GangChannel.connect(
-            host, gang_port, rank=ctx.process_id, token=token)
+            host, gang_port, rank=ctx.process_id, token=token, **chan_kw)
         engine = contlib.ContinuousEngine(cfg, params, **kw)
         try:
             follow(engine, channel)

@@ -26,10 +26,38 @@ from typing import Any, Optional
 from .model import Model
 
 
+class _ByteIncrementalDecoder:
+    """Stateful id-stream decoder for :class:`ByteTokenizer`: feeds new
+    ids only, holding incomplete UTF-8 tails instead of re-decoding the
+    whole accumulated list (the O(len^2) fix in ``_StopScanner``)."""
+
+    def __init__(self) -> None:
+        import codecs
+
+        self._dec = codecs.getincrementaldecoder("utf-8")("replace")
+
+    def decode(self, ids) -> str:
+        out: list[str] = []
+        for i in ids:
+            i = int(i)
+            if 0 <= i < 256:
+                out.append(self._dec.decode(bytes([i])))
+            else:
+                # mirror ByteTokenizer.decode: flush any partial char as
+                # U+FFFD, then mark the out-of-range id
+                out.append(self._dec.decode(b"", True))
+                self._dec.reset()
+                out.append("�")
+        return "".join(out)
+
+
 class ByteTokenizer:
     """UTF-8 bytes as token ids.  Asset-free; round-trips any text."""
 
     vocab_size = 256
+
+    def incremental_decoder(self) -> _ByteIncrementalDecoder:
+        return _ByteIncrementalDecoder()
 
     def encode(self, text: str) -> list[int]:
         return list(text.encode("utf-8"))
@@ -75,6 +103,53 @@ class HfTokenizer:
 
     def decode(self, ids: list[int]) -> str:
         return self._tok.decode(ids, skip_special_tokens=True)
+
+
+class _StopScanner:
+    """Incremental stop-sequence search over a growing token stream.
+
+    The 20 ms stop poll used to re-decode the whole accumulated id list
+    AND re-scan every stop sequence over the whole text — O(len^2) per
+    request (ADVICE r5).  This keeps a decoded-prefix cursor: tokenizers
+    exposing ``incremental_decoder()`` (bytes) decode only the NEW ids
+    each poll, and the stop search always resumes at the scanned-text
+    cursor minus a max-stop-length overlap, so each poll costs O(new
+    text), not O(all text).  Tokenizers without incremental decode (HF)
+    still re-decode but get the tail-only scan.
+    """
+
+    def __init__(self, tokenizer, stops: list[str]) -> None:
+        self._tok = tokenizer
+        self._stops = [s for s in stops if s]
+        self._overlap = max((len(s) for s in self._stops), default=1) - 1
+        mk = getattr(tokenizer, "incremental_decoder", None)
+        self._dec = mk() if callable(mk) else None
+        #: True when ``text`` is maintained by incremental decode (an
+        #: exact stable prefix of the full decode, minus any held
+        #: incomplete UTF-8 tail) — callers may then reuse it instead of
+        #: re-decoding the whole stream
+        self.incremental = self._dec is not None
+        self._n_ids = 0
+        self.text = ""
+        self._scanned = 0
+
+    def scan(self, ids) -> Optional[int]:
+        """Feed the full id list so far; returns the char index of the
+        earliest (newly visible) stop hit, else None."""
+        if self._dec is not None:
+            if len(ids) > self._n_ids:
+                self.text += self._dec.decode(ids[self._n_ids:])
+                self._n_ids = len(ids)
+        else:
+            self.text = self._tok.decode(ids)
+        start = max(0, self._scanned - self._overlap)
+        cut = None
+        for ss in self._stops:
+            i = self.text.find(ss, start)
+            if i >= 0 and (cut is None or i < cut):
+                cut = i
+        self._scanned = len(self.text)
+        return cut
 
 
 def resolve_tokenizer(config: dict):
@@ -203,6 +278,8 @@ class TextGenerator(Model):
         finished = [False] * len(reqs)
         model = payload.get("model", self.name)
         stops = self._stop_sequences(payload)
+        scanners = ([_StopScanner(self.tokenizer, stops) for _ in reqs]
+                    if stops else None)
         try:
             while not all(finished):
                 progressed = False
@@ -210,20 +287,30 @@ class TextGenerator(Model):
                     if finished[i]:
                         continue
                     done = req.done.is_set()
-                    full = self.tokenizer.decode(list(req.tokens))
-                    if stops:
+                    ids = list(req.tokens)
+                    cut = scanners[i].scan(ids) if scanners is not None \
+                        else None
+                    if scanners is not None and not done:
+                        # mid-stream the scanner's text IS the decode —
+                        # the stable incremental prefix (bytes) or the
+                        # full decode scan() just computed (HF) — so no
+                        # second O(len) decode per 20 ms poll (ADVICE
+                        # r5); the final flush below still uses the
+                        # authoritative full decode
+                        full = scanners[i].text
+                    else:
+                        full = self.tokenizer.decode(ids)
+                    if cut is not None:
                         # OpenAI ``stop`` while streaming: truncate at the
                         # earliest stop sequence and end this choice (its
                         # slot frees at the next chunk boundary).  Never
                         # truncate BEHIND already-sent text — a stop that
                         # straddled an emitted boundary can't be unsent,
                         # so the choice just ends where it stands.
-                        cut, hit = self._apply_stop(full, stops)
-                        if hit:
-                            full = cut if len(cut) >= len(sent[i]) \
-                                else sent[i]
-                            done = True
-                            req.cancel()
+                        full = full[:cut] if cut >= len(sent[i]) \
+                            else sent[i]
+                        done = True
+                        req.cancel()
                     if done:
                         # final decode is authoritative; flush everything
                         delta = (full[len(sent[i]):]
@@ -357,17 +444,19 @@ class TextGenerator(Model):
     def _wait_with_stops(self, r, stops: list[str]) -> list[int]:
         """Wait for a request, but with stop sequences the wait POLLS and
         cancels at the first hit — a stop at token 3 must not hold a
-        decode slot (or the client) for the remaining max_tokens."""
+        decode slot (or the client) for the remaining max_tokens.  The
+        poll is incremental (:class:`_StopScanner`): each pass decodes
+        and scans only the tokens that landed since the last one."""
         if not stops:
             return r.wait(300.0)
         import time as timelib
 
+        scanner = _StopScanner(self.tokenizer, stops)
         deadline = timelib.monotonic() + 300.0
         while True:
             done = r.done.is_set()
             ids = list(r.tokens)
-            _, hit = self._apply_stop(self.tokenizer.decode(ids), stops)
-            if hit:
+            if scanner.scan(ids) is not None:
                 r.cancel()
                 return ids
             if done:

@@ -1,0 +1,192 @@
+"""Gang recovery latency p50 — restart -> RUNNING, phase-decomposed.
+
+The recovery counterpart of scripts/gang_startup_bench.py: a seeded
+:class:`~kubeflow_tpu.chaos.FaultPlan` kills a random gang member
+mid-run; the JaxJob controller detects the failure, tears the gang down,
+holds the jittered restart backoff, re-schedules, and the gang returns
+to RUNNING.  Each trial decomposes that into:
+
+- ``detect_s``     pod crash -> Restarting decision (event timestamp)
+- ``backoff_s``    the jittered hold the controller actually applied
+- ``respawn_s``    hold expiry -> first new pod running
+- ``reform_s``     first new pod running -> every worker running
+
+``restart_to_running_s`` (the sum, as measured end-to-end by the
+controller's ``status.last_recovery_seconds`` + detection) is the
+headline; the controller also stamps it on the job, so production jobs
+report the same number this bench tracks.
+
+Runs against the in-process cluster + FakeKubelet (no real processes) —
+this measures CONTROLLER recovery machinery, deterministically;
+gang_startup_bench.py's restart leg measures the full process-runtime
+path on top.
+
+Usage: python scripts/recovery_bench.py [trials] [workers] [seed]
+"""
+
+from __future__ import annotations
+
+import json
+import statistics
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+
+def _percentiles(samples: list[float]) -> dict:
+    samples = sorted(samples)
+    return {
+        "value": round(statistics.median(samples), 3),
+        "p90": round(samples[int(0.9 * (len(samples) - 1))], 3),
+        "min": round(samples[0], 3),
+        "max": round(samples[-1], 3),
+    }
+
+
+class _CrashWatcher:
+    """Polls pod statuses to timestamp the crash: the failed pod is
+    deleted by the gang restart, so its finish_time must be caught live."""
+
+    def __init__(self, store, job_name: str):
+        import threading
+
+        self.store = store
+        self.job = job_name
+        self.crash_t = None
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        from kubeflow_tpu.controlplane.objects import KIND_POD, PodPhase
+
+        while not self._stop.is_set() and self.crash_t is None:
+            for p in self.store.list(KIND_POD):
+                if (p.metadata.name.startswith(self.job + "-")
+                        and p.status.phase == PodPhase.FAILED):
+                    self.crash_t = p.status.finish_time or time.time()
+                    break
+            self._stop.wait(0.005)
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join(timeout=2)
+
+
+def run_trial(i: int, workers: int, seed: int) -> dict:
+    from kubeflow_tpu.api import (
+        Container,
+        JaxJob,
+        ObjectMeta,
+        ReplicaSpec,
+        Resources,
+    )
+    from kubeflow_tpu.api.common import RestartPolicy
+    from kubeflow_tpu.api.jaxjob import KIND_JAXJOB
+    from kubeflow_tpu.chaos import FaultPlan
+    from kubeflow_tpu.controlplane import (
+        Cluster,
+        FakeKubelet,
+        KIND_POD,
+        PodScript,
+        events_for,
+    )
+    from kubeflow_tpu.controlplane.objects import PodPhase
+
+    name = f"recover-{i}"
+    plan = FaultPlan(seed=seed + i).crash_random_member(world=workers, at=0.2)
+    c = Cluster()
+    c.add_tpu_slice("s0", num_hosts=workers, chips_per_host=4)
+    kubelet = FakeKubelet(
+        c.store,
+        plan.script_fn(default=lambda pod: PodScript(run_seconds=30.0)),
+        chaos=plan)
+    with c:
+        kubelet.start()
+        watcher = _CrashWatcher(c.store, name)
+        try:
+            c.store.create(JaxJob(
+                metadata=ObjectMeta(name=name),
+                spec={
+                    "replica_specs": {
+                        "worker": ReplicaSpec(
+                            replicas=workers,
+                            restart_policy=RestartPolicy.ON_FAILURE,
+                            template=Container(
+                                resources=Resources(cpu=1, memory_gb=1, tpu=4)),
+                        )
+                    },
+                    "run_policy": {"backoff_limit": 3,
+                                   "restart_backoff_seconds": 0.1},
+                },
+            ))
+            deadline = time.time() + 60
+            job = None
+            while time.time() < deadline:
+                job = c.store.get(KIND_JAXJOB, name)
+                if job.status.last_recovery_seconds is not None:
+                    break
+                time.sleep(0.02)
+            assert job is not None and job.status.last_recovery_seconds is not None, (
+                f"{name} never recovered: {job.status if job else None}")
+
+            watcher.stop()
+            crash_t = watcher.crash_t
+            restart_ev = next(
+                e for e in events_for(c.store, KIND_JAXJOB, name)
+                if e.reason == "Restarting")
+            backoff = json.loads(restart_ev.message)["backoff_seconds"]
+            restart_t = job.status.last_restart_time
+            first_new_running = min(
+                (p.status.start_time for p in c.store.list(KIND_POD)
+                 if p.metadata.name.startswith(name + "-")
+                 and p.status.phase == PodPhase.RUNNING
+                 and p.status.start_time),
+                default=None)
+            recovered_t = restart_t + job.status.last_recovery_seconds
+            detect = (restart_t - crash_t) if crash_t else None
+            respawn = (first_new_running - (restart_t + backoff)
+                       if first_new_running else None)
+            reform = (recovered_t - first_new_running
+                      if first_new_running else None)
+            return {
+                "restart_to_running_s": job.status.last_recovery_seconds,
+                "detect_s": detect,
+                "backoff_s": backoff,
+                "respawn_s": respawn,
+                "reform_s": reform,
+            }
+        finally:
+            watcher.stop()
+            kubelet.stop()
+
+
+def main() -> None:
+    trials = int(sys.argv[1]) if len(sys.argv) > 1 else 12
+    workers = int(sys.argv[2]) if len(sys.argv) > 2 else 4
+    seed = int(sys.argv[3]) if len(sys.argv) > 3 else 0
+
+    rows = []
+    for i in range(trials):
+        row = run_trial(i, workers, seed)
+        rows.append(row)
+        print("# trial", i, json.dumps({
+            k: (round(v, 3) if isinstance(v, float) else v)
+            for k, v in row.items()}), file=sys.stderr)
+
+    phase_p50 = {}
+    for key in rows[0]:
+        vals = sorted(v for r in rows for v in [r[key]] if v is not None)
+        phase_p50[key] = round(vals[len(vals) // 2], 3) if vals else None
+    print(json.dumps({
+        "metric": "restart_to_running_p50_seconds",
+        "unit": (f"s (seeded chaos kill -> all workers Running, "
+                 f"n={trials}, workers={workers}, FakeKubelet cluster)"),
+        **_percentiles([r["restart_to_running_s"] for r in rows]),
+        "phase_p50": phase_p50,
+    }))
+
+
+if __name__ == "__main__":
+    main()

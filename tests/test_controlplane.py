@@ -95,6 +95,33 @@ class TestStore:
         assert job.spec.run_policy.scheduling_policy.min_available == 3
 
 
+class TestWorkQueue:
+    def test_inflight_dedup_serializes_key(self):
+        """client-go semantics: a key handed to a worker is not handed to
+        a second worker until done(); adds meanwhile park and re-queue at
+        done() — one failure can never be double-reconciled."""
+        from kubeflow_tpu.controlplane import WorkQueue
+
+        q = WorkQueue()
+        q.add("ns/a")
+        assert q.get(timeout=0.1) == "ns/a"
+        q.add("ns/a")  # arrives while processing: parked, not handed out
+        assert q.get(timeout=0.1) is None
+        q.done("ns/a")  # flushes the parked add
+        assert q.get(timeout=0.3) == "ns/a"
+        q.done("ns/a")
+        assert q.get(timeout=0.05) is None
+
+    def test_done_without_dirty_is_noop(self):
+        from kubeflow_tpu.controlplane import WorkQueue
+
+        q = WorkQueue()
+        q.add("ns/b")
+        assert q.get(timeout=0.1) == "ns/b"
+        q.done("ns/b")
+        assert q.get(timeout=0.05) is None
+
+
 class TestGangScheduler:
     def test_all_or_nothing(self):
         c = Cluster()
@@ -293,6 +320,48 @@ class TestJaxJobLifecycle:
                 job = self._await_terminal(c, "retry")
                 assert has_condition(job.status.conditions, JobConditionType.SUCCEEDED)
                 assert job.status.restart_count == 1
+            finally:
+                kubelet.stop()
+
+    def test_restart_backoff_holds_pod_recreation(self):
+        """A gang restart waits out the jittered backoff window before the
+        new incarnation's pods exist — no fixed 0.05 s restart storm
+        (ISSUE 1).  base=1.0 s with jitter in [0.5, 1.5) means no new pod
+        sooner than 0.5 s after the restart decision."""
+        fails = {"n": 0}
+
+        def script(pod: Pod) -> PodScript:
+            if pod.metadata.labels["replica-index"] == "0" and fails["n"] == 0:
+                fails["n"] += 1
+                return PodScript(run_seconds=0.05, exit_code=137)
+            return PodScript(hang=True)
+
+        c, kubelet = self.run_cluster(script)
+        with c:
+            kubelet.start()
+            try:
+                job = make_job(name="paced", replicas=2, backoff_limit=2,
+                               restart_backoff_seconds=1.0)
+                job.spec.replica_specs["worker"].restart_policy = RestartPolicy.EXIT_CODE
+                c.store.create(job)
+                job = wait_for(
+                    lambda: (j := c.store.get(KIND_JAXJOB, "paced"))
+                    and j.status.last_restart_time and j,
+                    desc="restart decided",
+                )
+                # inside the hold window: the old pods are gone and no new
+                # incarnation exists yet
+                time.sleep(0.25)
+                assert not c.store.list(KIND_POD, labels={LABEL_JOB_NAME: "paced"})
+                pods = wait_for(
+                    lambda: (
+                        ps := c.store.list(KIND_POD, labels={LABEL_JOB_NAME: "paced"})
+                    )
+                    and len(ps) == 2 and ps,
+                    desc="new incarnation",
+                )
+                earliest = min(p.metadata.creation_timestamp for p in pods)
+                assert earliest - job.status.last_restart_time >= 0.5
             finally:
                 kubelet.stop()
 

@@ -274,6 +274,60 @@ class TestServingGang:
         assert np.array_equal(arr, big)
 
 
+class TestGangChannelRecovery:
+    """Control-stream self-healing (ISSUE 1), no processes: the dispatch
+    replay a follower needs after a socket drop is exactly the replay an
+    engine follower would apply, op tuples and numpy payloads included."""
+
+    def test_dispatch_stream_survives_follower_socket_drop(self):
+        import threading
+
+        import numpy as np
+
+        from kubeflow_tpu.chaos import FaultPlan
+        from kubeflow_tpu.serving.gang import GangChannel
+        from kubeflow_tpu.utils.net import allocate_port
+
+        port = allocate_port()
+        plan = FaultPlan(seed=0).socket_drop(role="follower", after_calls=20)
+        chan = dict(hb_interval=0.05, dead_peer_timeout=0.5,
+                    reattach_timeout=5.0, reconnect_timeout=5.0)
+        out = {}
+
+        def follower():
+            ch = GangChannel.connect(
+                "127.0.0.1", port, rank=1, token="t",
+                sock_wrap=plan.socket_wrapper("follower"), **chan)
+            msgs = []
+            while True:
+                m = ch.next()
+                if m == ("stop",):
+                    break
+                msgs.append(m)
+            out["msgs"] = msgs
+            ch.close()
+
+        t = threading.Thread(target=follower)
+        t.start()
+        leader = GangChannel.listen(port, 1, token="t", **chan)
+        sent = []
+        for step in range(12):
+            msg = ("decode", step, np.arange(
+                200, dtype=np.int32) + step)
+            leader.publish(msg)
+            sent.append(msg)
+            time.sleep(0.01)
+        leader.publish(("stop",))
+        t.join(timeout=20)
+        leader.close()
+        assert not t.is_alive(), "follower hung after socket drop"
+        got = out["msgs"]
+        assert len(got) == len(sent)
+        for g, s in zip(got, sent):
+            assert g[:2] == s[:2]
+            assert __import__("numpy").array_equal(g[2], s[2])
+
+
 @pytest.mark.e2e
 class TestGangOpenAI:
     def test_openai_completions_on_gang(self, platform, tmp_path):
