@@ -112,8 +112,65 @@ def report(metric: str, model, batch, seq, tps_chip: float) -> None:
     }), flush=True)
 
 
+PROBE_TIMEOUT_S = 120.0  # generous for a healthy chip; bounds a dead one
+
+
+def _devices_or_skip():
+    """jax.devices() with graceful degradation (BENCH_r05 regression: a
+    registered-but-unreachable TPU/axon plugin crashed the whole bench
+    with rc=1 and an unparseable traceback — and its init can BLOCK for
+    minutes before failing).  Order: probe the default backend in a
+    short-lived subprocess so a dead plugin costs a bounded timeout, not
+    a hang; fall back to CPU (the config update restricts platform
+    discovery, so the retry cannot re-trip the dead plugin); and if even
+    CPU is unusable, ONE parseable "skipped" row in the driver's schema
+    and exit 0 — a bench that cannot run must record that fact, not a
+    stack trace."""
+    import os
+    import subprocess
+    import sys
+
+    err = "default backend probe failed"
+    if os.environ.get("JAX_PLATFORMS", "") != "cpu":
+        # probe for ANY non-cpu platform selection (pinned or default):
+        # the subprocess inherits the env, so a pinned-but-dead plugin
+        # still fails inside the bounded probe, never in-process.  On a
+        # healthy accelerator this double-inits the backend (~seconds) —
+        # accepted: the bench itself runs for minutes, and the hang this
+        # guards against cost a whole BENCH round (r05)
+        try:
+            probe = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; print(jax.devices()[0].platform)"],
+                capture_output=True, timeout=PROBE_TIMEOUT_S, text=True)
+            ok = probe.returncode == 0
+            err = (probe.stderr or "").strip().splitlines()[-1:] or [err]
+            err = err[0]
+        except subprocess.TimeoutExpired:
+            ok = False
+            err = f"backend init exceeded {PROBE_TIMEOUT_S:.0f}s"
+        if not ok:
+            jax.config.update("jax_platforms", "cpu")
+    try:
+        return jax.devices()
+    except RuntimeError as e:
+        err = str(e)
+    try:
+        jax.config.update("jax_platforms", "cpu")
+        return jax.devices()
+    except Exception:  # noqa: BLE001 — no backend at all
+        print(json.dumps({
+            "metric": "llama_train_tokens_per_sec_per_chip",
+            "value": 0.0,
+            "unit": f"skipped: no usable jax backend ({err})"[:200],
+            "vs_baseline": 0.0,
+            "skipped": True,
+        }), flush=True)
+        raise SystemExit(0)
+
+
 def main() -> None:
-    on_tpu = jax.devices()[0].platform == "tpu"
+    on_tpu = _devices_or_skip()[0].platform == "tpu"
 
     # -- line 1: the frozen driver row ----------------------------------
     if on_tpu:

@@ -67,8 +67,12 @@ class shard_context:
 
     def __init__(self, mesh: Mesh, overrides: Optional[Sequence[tuple[str, Any]]] = None):
         self.mesh = mesh
+        # jax < 0.6 has no jax.sharding.set_mesh; there the plain
+        # ``with mesh:`` resource env IS what flax's global_mesh_defined()
+        # checks, so the constraints land in the HLO either way
+        set_mesh = getattr(jax.sharding, "set_mesh", None)
         self._ctxs = [
-            jax.sharding.set_mesh(mesh),
+            set_mesh(mesh) if set_mesh is not None else mesh,
             nn.logical_axis_rules(rules_for_mesh(mesh, overrides)),
             active_mesh(mesh),
         ]

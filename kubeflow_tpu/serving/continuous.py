@@ -367,6 +367,113 @@ def _sample_step(logits, temps, top_ps, top_ks, key):
     return jnp.where(temps > 0, sampled, greedy).astype(jnp.int32)
 
 
+def _chunk_prefill_body(cfg, wmodel, budget: int, batch_axes, mesh):
+    """Shared transform of the chunked-prefill programs: run ``budget``
+    prompt tokens of ONE admitting slot's prefill against the pool —
+    slice the slot row, forward the chunk at global positions
+    [start, start+budget), scatter the mutated row back, and (on the
+    final chunk only) write the last real token's logits into the pool
+    logits at ``write_slot``.
+
+    Sarathi-style chunked prefill: the prompt's KV lands in its slot
+    incrementally across dispatches, each bounded by ``budget`` tokens,
+    instead of one monolithic [1, prompt_bucket] program that freezes
+    the decode stream for every live request (ISSUE 2).  Non-final
+    chunks pass ``write_slot = num_slots`` so the logits write drops;
+    the final chunk passes the real slot and ``length`` marks the last
+    real token (padding beyond it writes masked garbage, the same
+    stale-KV argument the slot pool already relies on).
+    """
+    from jax import lax
+
+    def body(params, pool_cache, pool_logits, slot, toks, start, length,
+             write_slot):
+        row = jax.tree.map(
+            lambda c, a: c if a is None
+            else lax.dynamic_slice_in_dim(c, slot, 1, axis=a),
+            pool_cache, batch_axes)
+        positions = (start + jnp.arange(budget, dtype=jnp.int32))[None, :]
+        logits_all, mutated = wmodel.apply(
+            {"params": params, "cache": row}, toks[None], positions,
+            decode=True, mutable=["cache"])
+        last = jnp.take_along_axis(
+            logits_all, (length - 1)[None, None, None], axis=1)[:, 0]
+
+        def scatter_leaf(c, r, a):
+            if a is None:
+                return c
+            idx = (slice(None),) * a + (slot,)
+            # mode="drop": the warmup sentinel (slot == num_slots) must
+            # discard, not clamp onto the last real slot
+            return c.at[idx].set(jnp.take(r, 0, axis=a), mode="drop")
+
+        pool_cache = shardedlib.constrain_cache(
+            jax.tree.map(scatter_leaf, pool_cache, mutated["cache"],
+                         batch_axes), mesh)
+        pool_logits = shardedlib.constrain_logits(
+            pool_logits.at[write_slot].set(last[0], mode="drop"), mesh)
+        return pool_cache, pool_logits
+
+    return body
+
+
+def make_chunk_prefill_program(cfg, attend: int, budget: int, batch_axes,
+                               mesh=None):
+    """One ``budget``-token prefill chunk as its own dispatch — used when
+    the fused program cannot ride a decode dispatch (no live decode work,
+    or the live pool decodes through the segment-aware program).
+    Signature: (params, pool_cache, pool_logits, slot, toks [budget],
+    start, length, write_slot) -> (pool_cache, pool_logits); pool
+    buffers donated."""
+    wmodel = llamalib.Llama(cfg, decode_attend_len=attend)
+    body = _chunk_prefill_body(cfg, wmodel, budget, batch_axes, mesh)
+    return shardedlib.mesh_jit(mesh, body, donate_argnums=(1, 2))
+
+
+def make_fused_step_program(cfg, attend: int, chunk: int, budget: int,
+                            batch_axes, mesh=None):
+    """STALL-FREE step: one dispatch = one prefill chunk of the admitting
+    request + ``chunk`` decode sampling steps for the whole live pool —
+    the HFTA move (PAPERS) applied to serving: heterogeneous work fused
+    into one program so the decode stream never waits on a monolithic
+    prefill.  The decode half is byte-identical math to
+    :func:`make_decode_program` for active slots; inactive rows (the
+    admitting one included) KEEP their logits through the scan, so the
+    final chunk's last-token logits survive the ride-along decode and
+    seed the slot's first sampled token at the next dispatch.
+    """
+    wmodel = llamalib.Llama(cfg, decode_attend_len=attend)
+    body = _chunk_prefill_body(cfg, wmodel, budget, batch_axes, mesh)
+
+    def fused(params, cache, logits, slot, toks, start, length, write_slot,
+              positions, active, temps, top_ps, top_ks, key):
+        cache, logits = body(params, cache, logits, slot, toks, start,
+                             length, write_slot)
+        safe = jnp.where(active, positions, cfg.max_seq_len)
+
+        def step(carry, key):
+            cache, logits, pos = carry
+            tok = _sample_step(logits, temps, top_ps, top_ks, key)
+            l, mutated = wmodel.apply(
+                {"params": params, "cache": cache}, tok[:, None],
+                pos[:, None], decode=True, mutable=["cache"])
+            nxt = jnp.where(active, pos + 1, cfg.max_seq_len)
+            # inactive rows hold their logits (the plain decode program
+            # may clobber them — nothing reads those; here the admitting
+            # row's fresh prefill logits MUST survive to the next step)
+            kept = jnp.where(active[:, None], l[:, -1, :], logits)
+            return (shardedlib.constrain_cache(mutated["cache"], mesh),
+                    shardedlib.constrain_logits(kept, mesh),
+                    nxt), tok
+
+        keys = jax.random.split(key, chunk)
+        (cache, logits, pos), out = jax.lax.scan(
+            step, (cache, logits, safe), keys)
+        return cache, logits, shardedlib.constrain_replicated(out.T, mesh)
+
+    return shardedlib.mesh_jit(mesh, fused, donate_argnums=(1, 2))
+
+
 def make_decode_program(cfg, attend: int, chunk: int, mesh=None):
     """``chunk`` sampling steps for the whole slot pool in one program,
     attending only over cache slots [0, attend).
@@ -425,6 +532,33 @@ class ContinuousEngine:
                     ICI), serving models bigger than one chip's HBM —
                     the pool stays ONE jit program spanning the mesh
                     (serving/sharded.py).
+    prefill_budget: 0 = legacy whole-prompt admission (one [1, bucket]
+                    prefill dispatch per prompt — a long prompt freezes
+                    token emission for every live request while it runs).
+                    > 0 = STALL-FREE chunked admission: prompts prefill
+                    ``prefill_budget`` tokens per dispatch, fused into
+                    the pool decode program (make_fused_step_program),
+                    so decode inter-token latency during an admission is
+                    bounded by one chunk's compute instead of the whole
+                    prompt's.  The first token emerges from the final
+                    chunk's logits exactly as a merged prefill's would —
+                    greedy tokens are bit-identical to the legacy path.
+                    Tradeoff (documented, not hidden): admissions are
+                    FIFO, one chunk per dispatch, so a cold BURST of g
+                    prompts pays g+ dispatches where the legacy path
+                    batches it as one [g, bucket] prefill + one merge —
+                    the Sarathi bargain: admission throughput traded for
+                    a per-dispatch prefill bound no burst can break
+                    (later burst members start decoding fused with
+                    earlier members' chunks, so the pool is never idle
+                    while it drains).  The prefix-cache route honors the
+                    bound too: it is only taken when the suffix fits one
+                    budget (longer suffixes re-prefill chunked).  Known
+                    carve-out: SHARED-PREFIX SEGMENTS (opt-in,
+                    prefix_segments > 0) still create/admit with
+                    monolithic dispatches bounded by segment_len, not
+                    prefill_budget — an operator enabling both chooses
+                    segment capacity economics over the strict bound.
     prefix_cache:   reuse KV across requests sharing a prompt prefix
                     (min_prefix tokens or more) with any slot's current
                     content: admission becomes an on-device prefix copy +
@@ -440,6 +574,7 @@ class ContinuousEngine:
         *,
         num_slots: int = 8,
         decode_chunk: int = 1,
+        prefill_budget: int = 0,
         temperature: float = 0.0,
         eos_id: Optional[int] = None,
         seq_buckets: Optional[list[int]] = None,
@@ -455,6 +590,8 @@ class ContinuousEngine:
             raise ValueError("num_slots must be >= 1")
         if decode_chunk < 1:
             raise ValueError("decode_chunk must be >= 1")
+        if prefill_budget < 0:
+            raise ValueError("prefill_budget must be >= 0 (0 = off)")
         if pipeline_depth < 1:
             raise ValueError("pipeline_depth must be >= 1")
         self.cfg = cfg
@@ -471,6 +608,7 @@ class ContinuousEngine:
         self.params = params
         self.num_slots = num_slots
         self.decode_chunk = decode_chunk
+        self.prefill_budget = int(prefill_budget)
         self.prefix_segments = int(prefix_segments)
         self.segment_len = int(segment_len)
         if self.prefix_segments > 0:
@@ -550,6 +688,26 @@ class ContinuousEngine:
         self._temps = np.zeros(num_slots, dtype=np.float32)
         self._top_ps = np.ones(num_slots, dtype=np.float32)
         self._top_ks = np.zeros(num_slots, dtype=np.int32)
+        #: chunked-admission queue (prefill_budget > 0): [req, slot,
+        #: prompt, next_offset] entries whose slot is RESERVED
+        #: (self._slots[slot] is req) but not yet active — the head makes
+        #: ``prefill_budget`` tokens of progress per dispatch, riding the
+        #: fused step program whenever decode work is live
+        from collections import deque
+
+        self._prefilling: "deque[list]" = deque()
+        #: prompt tokens admitted-but-not-yet-prefilled, kept as a plain
+        #: scheduler-maintained counter: stats() runs on HTTP threads and
+        #: must not iterate a deque the scheduler mutates concurrently
+        self._prefill_tokens_inflight = 0
+        self.prefill_chunks_dispatched = 0
+        #: host-observed ms the scheduler spent dispatching admission
+        #: work while decode-able requests were live.  On async-dispatch
+        #: backends this lower-bounds the true device-side stall (the
+        #: monolithic prefill serializes on the device stream, not the
+        #: host) — scripts/serving_bench.py's chunked-prefill row holds
+        #: the measured device-level truth.
+        self.decode_stall_ms_total = 0.0
         self.step_counter = 0          # decode dispatches so far
         self.tokens_emitted = 0        # useful (delivered) tokens
         #: tokens decoded for requests already EOS-retired — the price of
@@ -662,6 +820,33 @@ class ContinuousEngine:
             return self._decode_programs[attend]
 
         self._decode_for = decode_for
+
+        if self.prefill_budget > 0:
+            budget = self.prefill_budget
+            self._fused_programs: dict[int, Any] = {}
+            self._chunk_programs: dict[int, Any] = {}
+
+            def fused_for(needed: int):
+                attend = next(
+                    (b for b in self.attend_buckets if b >= needed),
+                    cfg.max_seq_len)
+                if attend not in self._fused_programs:
+                    self._fused_programs[attend] = make_fused_step_program(
+                        cfg, attend, chunk, budget, self._batch_axes, mesh)
+                return self._fused_programs[attend]
+
+            def chunk_prefill_for(needed: int):
+                attend = next(
+                    (b for b in self.attend_buckets if b >= needed),
+                    cfg.max_seq_len)
+                if attend not in self._chunk_programs:
+                    self._chunk_programs[attend] = (
+                        make_chunk_prefill_program(
+                            cfg, attend, budget, self._batch_axes, mesh))
+                return self._chunk_programs[attend]
+
+            self._fused_for = fused_for
+            self._chunk_prefill_for = chunk_prefill_for
 
         if self.prefix_segments > 0:
             import dataclasses as _dc
@@ -829,12 +1014,16 @@ class ContinuousEngine:
         warm_attends = set()
         for g, bucket in groups:
             bucket = next(b for b in self.seq_buckets if b >= bucket)
-            row_logits, row_cache = self._prefill_for(bucket)(
-                self.params, np.zeros((g, bucket), np.int32),
-                np.ones(g, np.int32))
-            self._pool_cache, self._pool_logits = self._merge(
-                self._pool_cache, self._pool_logits, row_cache, row_logits,
-                np.full(g, self.num_slots, np.int32))
+            if self.prefill_budget == 0:
+                # the whole-prompt prefill + merge only serve plain
+                # admission; a chunked engine never dispatches them —
+                # compiling them would double warmup for dead programs
+                row_logits, row_cache = self._prefill_for(bucket)(
+                    self.params, np.zeros((g, bucket), np.int32),
+                    np.ones(g, np.int32))
+                self._pool_cache, self._pool_logits = self._merge(
+                    self._pool_cache, self._pool_logits, row_cache,
+                    row_logits, np.full(g, self.num_slots, np.int32))
             warm_attends.add(bucket + self.decode_chunk)
         for needed in sorted(warm_attends):
             self._pool_cache, self._pool_logits, toks = self._decode_for(
@@ -846,6 +1035,36 @@ class ContinuousEngine:
                 np.ones(self.num_slots, np.float32),
                 np.zeros(self.num_slots, np.int32),
                 np.asarray(jax.random.PRNGKey(0)))
+            jax.block_until_ready(toks)
+        if self.prefill_budget > 0 and warm_attends:
+            # chunked admission climbs the attend ladder as the prompt
+            # front advances (off + budget), so warm EVERY rung up to the
+            # windows the warmed buckets imply — a mid-admission compile
+            # is exactly the stall class chunked prefill exists to remove.
+            # All targets are the out-of-range slot / inactive pool, so
+            # every write drops and pool state is untouched.
+            cover = next((a for a in self.attend_buckets
+                          if a >= max(warm_attends)), self.cfg.max_seq_len)
+            ptoks = np.zeros(self.prefill_budget, np.int32)
+            sentinel = np.int32(self.num_slots)
+            for attend in [a for a in self.attend_buckets if a <= cover]:
+                self._pool_cache, self._pool_logits = (
+                    self._chunk_prefill_for(attend)(
+                        self.params, self._pool_cache, self._pool_logits,
+                        sentinel, ptoks, np.int32(0), np.int32(1),
+                        sentinel))
+                self._pool_cache, self._pool_logits, toks = (
+                    self._fused_for(attend)(
+                        self.params, self._pool_cache, self._pool_logits,
+                        sentinel, ptoks, np.int32(0), np.int32(1),
+                        sentinel,
+                        np.full(self.num_slots, self.cfg.max_seq_len,
+                                np.int32),
+                        np.zeros(self.num_slots, bool),
+                        np.zeros(self.num_slots, np.float32),
+                        np.ones(self.num_slots, np.float32),
+                        np.zeros(self.num_slots, np.int32),
+                        np.asarray(jax.random.PRNGKey(0))))
             jax.block_until_ready(toks)
         if self.prefix_segments > 0:
             # warm the SEGMENT path (creation prefill, batched suffix
@@ -959,6 +1178,10 @@ class ContinuousEngine:
             "decode_steps": self.step_counter,
             "tokens_emitted": self.tokens_emitted,
             "tokens_discarded": self.tokens_discarded,
+            "prefill_budget": self.prefill_budget,
+            "prefill_chunks_dispatched": self.prefill_chunks_dispatched,
+            "prefill_tokens_inflight": self._prefill_tokens_inflight,
+            "decode_stall_ms_total": round(self.decode_stall_ms_total, 3),
             "prefix_hits": self.prefix_hits,
             "prefix_tokens_saved": self.prefix_tokens_saved,
             "segments_capacity": self.prefix_segments,
@@ -1036,6 +1259,11 @@ class ContinuousEngine:
         # prefill (src == dst is the conversation-continues case)
         grouped: list[tuple[Request, list[int], int]] = []
         seg_groups: dict[int, list] = {}  # bucket -> [(req, slot, seg, blen, suffix)]
+        # host-observed admission-dispatch time while decode work is live
+        # (the decode_stall_ms_total gauge — see its __init__ note)
+        stall_t0 = time.perf_counter()
+        had_live = bool(self._active.any())
+        dispatched = False
         for req, slot in taken:
             if self.prefix_segments > 0:
                 try:
@@ -1056,11 +1284,20 @@ class ContinuousEngine:
             prompt = req.prompt[-cap:]  # left-truncate, keep the tail
             src, lp = (self._best_prefix(prompt)
                        if self.prefix_cache else (-1, 0))
-            if src < 0 or lp < self.min_prefix:
+            # with chunked admission on, the prefix route is only taken
+            # when its monolithic suffix prefill fits the per-dispatch
+            # budget — a barely-matching long prompt must not sneak an
+            # unbounded prefill past the stall bound (the common chat
+            # continuation resends the whole conversation plus one short
+            # turn, so the reuse that matters survives this guard)
+            if (src < 0 or lp < self.min_prefix
+                    or (self.prefill_budget > 0
+                        and len(prompt) - lp > self.prefill_budget)):
                 grouped.append((req, prompt, slot))
                 continue
             try:
                 self._admit_with_prefix(req, prompt, slot, src, lp)
+                dispatched = True
             except Exception as e:  # noqa: BLE001 — fail this request only
                 req.error = e
                 req.done.set()
@@ -1097,11 +1334,30 @@ class ContinuousEngine:
                 for req, slot, seg, blen, suffix in members:
                     self._occupy(req, req.prompt, slot, plen=blen, seg=seg,
                                  local_len=len(suffix))
+                dispatched = True
             except Exception as e:  # noqa: BLE001 — fail this group only
                 for req, *_ in members:
                     req.error = e
                     req.done.set()
         self._seg_reserved.clear()
+        if self.prefill_budget > 0:
+            # CHUNKED admission (the stall-free path): reserve the slot
+            # now, prefill ``prefill_budget`` tokens per dispatch from the
+            # scheduler loop — fused into the decode dispatch whenever
+            # decode work is live — and activate on the final chunk.  The
+            # prefix-cache and segment routes above still run first: a
+            # matching prefix admits in one cheap suffix dispatch either
+            # way.
+            for req, prompt, slot in grouped:
+                self._slots[slot] = req
+                self._slot_content[slot] = []  # grows as chunks land
+                self._slot_owner[slot] = None  # set by _occupy when live
+                self._prefilling.append([req, slot, list(prompt), 0])
+                self._prefill_tokens_inflight += len(prompt)
+            if had_live and dispatched:
+                self.decode_stall_ms_total += (
+                    time.perf_counter() - stall_t0) * 1e3
+            return
         groups: dict[int, list[tuple[Request, list[int], int]]] = {}
         for req, prompt, slot in grouped:
             bucket = next(b for b in self.seq_buckets if b >= len(prompt))
@@ -1129,10 +1385,14 @@ class ContinuousEngine:
                     row_cache, row_logits, slots)
                 for req, prompt, slot in members:
                     self._occupy(req, prompt, slot)
+                dispatched = True
             except Exception as e:  # noqa: BLE001 — fail this group only
                 for req, _, _ in members:
                     req.error = e
                     req.done.set()
+        if had_live and dispatched:
+            self.decode_stall_ms_total += (
+                time.perf_counter() - stall_t0) * 1e3
 
     def _occupy(self, req: Request, prompt: list[int], slot: int, *,
                 plen: int = 0, seg: int = 0,
@@ -1307,6 +1567,64 @@ class ContinuousEngine:
                     req.done.set()
             self._waiting.clear()
 
+    def _purge_prefilling(self) -> None:
+        """Drop chunked-admission entries whose request resolved out of
+        band (cancel mid-prefill): the out-of-band sweep already freed
+        the slot; the KV written so far stays recorded in
+        ``_slot_content`` so the prefix matcher can reuse the partial
+        prefill (the same retirement-keeps-content rule live slots
+        follow)."""
+        if not self._prefilling:
+            return
+        kept = type(self._prefilling)()
+        for e in self._prefilling:
+            if e[0].done.is_set():
+                self._prefill_tokens_inflight -= len(e[2]) - e[3]
+            else:
+                kept.append(e)
+        self._prefilling = kept
+
+    def _prefill_chunk_args(self):
+        """Host decision for the head of the chunked-admission queue:
+        (entry, toks [budget], take, final, write_slot, attend_needed)."""
+        entry = self._prefilling[0]
+        req, slot, prompt, off = entry
+        take = min(self.prefill_budget, len(prompt) - off)
+        final = (off + take) == len(prompt)
+        toks = np.zeros(self.prefill_budget, np.int32)
+        toks[:take] = prompt[off:off + take]
+        write_slot = slot if final else self.num_slots
+        return entry, toks, take, final, write_slot, off + self.prefill_budget
+
+    def _fail_prefill_head(self, entry, e: Exception) -> None:
+        """Resolve the head admission's request with the dispatch error —
+        and ONLY that request (the legacy path's fail-this-group-only
+        contract).  The slot/entry/token counter are reclaimed by the
+        sweep and purge at the next loop top.  A GangEngine dispatch
+        failure additionally set self._error (the published op may have
+        reached followers); re-raise so the gang goes fatal instead of
+        limping with divergent pools."""
+        entry[0].error = e
+        entry[0].done.set()
+        if self._error is not None:
+            raise e
+
+    def _advance_prefill(self, entry, take: int, final: bool) -> None:
+        """Book one dispatched chunk: the slot's KV now holds
+        prompt[:off+take] (device dispatch order guarantees any later
+        program reads it written), and the final chunk activates the
+        slot — its first token samples from the freshly written logits
+        at the NEXT dispatch, exactly as a merged whole-prompt prefill's
+        would."""
+        req, slot, prompt, off = entry
+        entry[3] = off + take
+        self._slot_content[slot] = prompt[: off + take]
+        self._prefill_tokens_inflight -= take
+        self.prefill_chunks_dispatched += 1
+        if final:
+            self._prefilling.popleft()
+            self._occupy(req, prompt, slot)
+
     def _loop_inner(self) -> None:
         # in-flight chunk dispatches: (device tokens, [(slot, req, take)])
         pending: list[tuple[Any, list[tuple[int, Request, int]]]] = []
@@ -1314,7 +1632,8 @@ class ContinuousEngine:
             self._admit()
             # free slots whose request resolved OUT of band (cancel()):
             # the normal retirements already cleared theirs, so a done-
-            # but-still-active slot can only be a cancellation
+            # but-still-active slot can only be a cancellation (or a
+            # cancel mid-chunked-prefill — reserved but never activated)
             for slot in range(self.num_slots):
                 req = self._slots[slot]
                 if req is not None and req.done.is_set():
@@ -1322,18 +1641,26 @@ class ContinuousEngine:
                     self._active[slot] = False
                     self._remaining[slot] = 0
                     self._release_seg(slot)
-            if not self._active.any():
+            self._purge_prefilling()
+            has_prefill = bool(self._prefilling)
+            if not self._active.any() and not has_prefill:
                 # drain the tail, then wait for work without spinning
                 while pending:
                     self._process(*pending.pop(0))
-                if (self._active.any() or self._waiting
+                if (self._active.any() or self._waiting or self._prefilling
                         or not self._queue.empty()):
                     continue  # _process freed slots or work arrived
                 self._wake.wait(timeout=0.05)
                 self._wake.clear()
                 continue
-            self.step_counter += 1
-            key = jax.random.fold_in(self._base_key, self.step_counter)
+            live = bool(self._active.any())
+            if live:
+                # step_counter counts DECODE dispatches (the decode_steps
+                # gauge, admitted_step ages): prefill-only iterations
+                # must not inflate it — and only decode-carrying
+                # dispatches consume a sampling key
+                self.step_counter += 1
+                key = jax.random.fold_in(self._base_key, self.step_counter)
             snapshot = [
                 (slot, self._slots[slot],
                  int(min(self.decode_chunk, self._remaining[slot])))
@@ -1343,7 +1670,8 @@ class ContinuousEngine:
             # window = smallest attend bucket covering every live position
             # plus this chunk — early turns read KV proportional to the
             # conversation front, not max_seq_len
-            needed = int(self._positions[self._active].max()) + self.decode_chunk
+            needed = ((int(self._positions[self._active].max())
+                       + self.decode_chunk) if live else self.decode_chunk)
             # pass NUMPY COPIES that are never mutated again: the CPU
             # backend zero-copies numpy buffers across the jit boundary,
             # and the schedule advance below mutates self._positions /
@@ -1351,7 +1679,7 @@ class ContinuousEngine:
             # executed yet — an aliased input then reads ADVANCED
             # positions (writes land one slot off, intermittently, under
             # dispatch-ahead pipelining; reproduced 3/10 before this fix)
-            live_seg = (self.prefix_segments > 0
+            live_seg = (live and self.prefix_segments > 0
                         and bool((self._slot_plen[self._active] > 0).any()))
             if live_seg:
                 seg_att = int(self._slot_plen[self._active].max())
@@ -1364,13 +1692,72 @@ class ContinuousEngine:
                         self._slot_seg.astype(np.int32).copy(),
                         self._active.copy(), self._temps.copy(),
                         self._top_ps.copy(), self._top_ks.copy(), key))
-            else:
+            elif live and has_prefill:
+                # the stall-free hot path: one dispatch = one prefill
+                # chunk + the whole pool's decode scan
+                entry, ptoks, take, final, write_slot, p_needed = (
+                    self._prefill_chunk_args())
+                try:
+                    self._pool_cache, self._pool_logits, toks = (
+                        self._fused_for(max(needed, p_needed))(
+                            self.params, self._pool_cache,
+                            self._pool_logits,
+                            np.int32(entry[1]), ptoks, np.int32(entry[3]),
+                            np.int32(take), np.int32(write_slot),
+                            self._positions.copy(), self._active.copy(),
+                            self._temps.copy(), self._top_ps.copy(),
+                            self._top_ks.copy(), key))
+                except Exception as e:  # noqa: BLE001 — fail THIS request
+                    # (the legacy path's per-group isolation): a
+                    # compile/trace failure raises before execution, so
+                    # the donated pool buffers are intact; sweep + purge
+                    # reclaim the slot and entry next iteration.  A gang
+                    # engine's _fatal already recorded the error — there
+                    # the published op may have reached followers and the
+                    # whole gang must restart, not paper over it.
+                    self._fail_prefill_head(entry, e)
+                    continue  # no decode chunk landed this iteration
+                self._advance_prefill(entry, take, final)
+            elif live:
                 self._pool_cache, self._pool_logits, toks = self._decode_for(
                     needed)(
                     self.params, self._pool_cache, self._pool_logits,
                     self._positions.copy(), self._active.copy(),
                     self._temps.copy(), self._top_ps.copy(),
                     self._top_ks.copy(), key)
+            if has_prefill and (not live or live_seg):
+                # no decode dispatch to ride (idle pool), or the pool
+                # decodes through the segment-aware program: run the
+                # chunk standalone, AFTER the decode dispatch — the
+                # decode scan rewrites every slot's logits, so the final
+                # chunk's last-token logits must land after it on the
+                # device stream, and the slot activates only once both
+                # are in flight (the next dispatch samples its first
+                # token from the prefill logits, never a clobbered row)
+                entry, ptoks, take, final, write_slot, p_needed = (
+                    self._prefill_chunk_args())
+                try:
+                    self._pool_cache, self._pool_logits = (
+                        self._chunk_prefill_for(p_needed)(
+                            self.params, self._pool_cache,
+                            self._pool_logits,
+                            np.int32(entry[1]), ptoks, np.int32(entry[3]),
+                            np.int32(take), np.int32(write_slot)))
+                except Exception as e:  # noqa: BLE001 — fail THIS request
+                    self._fail_prefill_head(entry, e)
+                else:
+                    self._advance_prefill(entry, take, final)
+            if not live:
+                # prefill-only iteration: no decode chunk landed, but
+                # earlier dispatches' tokens may be waiting — deliver
+                # them NOW, or a request whose final chunk is already in
+                # flight would not resolve until the whole admission
+                # finishes (its pending entry is only drained by the
+                # depth check below or the idle branch, neither of which
+                # runs while only prefill work exists)
+                while pending:
+                    self._process(*pending.pop(0))
+                continue
             # advance the value-independent schedule NOW so the next chunk
             # can dispatch before this one's tokens are fetched
             for slot, req, take in snapshot:
@@ -1570,6 +1957,10 @@ class TieredEngine:
     def stats(self) -> dict:
         per = [p.stats() for p in self.pools]
         merged = {k: sum(d[k] for d in per) for k in per[0]}
+        # per-pool CONSTANTS must not sum across pools (every pool is
+        # built with the same knob; a summed gauge reports a config
+        # nobody set)
+        merged["prefill_budget"] = per[-1]["prefill_budget"]
         merged["pools"] = per
         merged["short_pool"] = per[0]
         merged["long_pool"] = per[-1]
@@ -1584,6 +1975,7 @@ def engine_kwargs(config: dict, *, default_eos=None,
     return dict(
         num_slots=int(config.get("num_slots", 8)),
         decode_chunk=int(config.get("decode_chunk", 4)),
+        prefill_budget=int(config.get("prefill_budget", 0)),
         temperature=float(config.get("temperature", 0.0)),
         eos_id=config.get("eos_id", default_eos),
         pipeline_depth=int(config.get("pipeline_depth", 2)),

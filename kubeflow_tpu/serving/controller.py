@@ -424,6 +424,21 @@ class InferenceServiceController(Controller):
 
     def _new_revision(self, isvc, dep: _Deployment, fingerprint: str) -> _Revision:
         runtime_cls, cfg = self._resolve(isvc)
+        if isvc.spec.predictor.gang is not None:
+            # validate the engine knobs HERE, inside the reconcile's
+            # Failed-phase guard, where the revision config freezes: a
+            # bad value (prefill_budget: -1, decode_chunk: "x", ...)
+            # otherwise surfaces as N pods crash-looping through JaxJob
+            # restarts; this way it is ONE Failed status with the message
+            from .continuous import engine_kwargs
+
+            bad = {k: v for k, v in engine_kwargs(cfg).items()
+                   if k in ("num_slots", "decode_chunk", "pipeline_depth",
+                            "prefill_budget")
+                   and v < (0 if k == "prefill_budget" else 1)}
+            if bad:
+                raise ValueError(
+                    f"invalid engine knobs for gang predictor: {bad}")
         dep.rev_counter += 1
         return _Revision(
             dep.rev_counter, fingerprint, isvc.spec.model_copy(deep=True),

@@ -519,10 +519,14 @@ class GangEngine(contlib.ContinuousEngine):
     dispatch stream against their shards (see :func:`follow`).
 
     The wrap happens at the program-getter layer — the scheduler, the
-    admission batching, prefix-cache routing and warmup all run
-    UNMODIFIED; only the four dispatch sites gain a publish.  Host args
-    are normalized to numpy on both sides of the wire (a process-local
-    device array cannot feed a global-mesh jit).
+    admission batching, prefix-cache routing, chunked-prefill budgeting
+    and warmup all run UNMODIFIED; only the dispatch sites gain a
+    publish (prefill/merge/decode/prefix, the segment ops, and the
+    chunked-admission ops ``chunk_prefill``/``fused`` when
+    ``prefill_budget`` > 0 — the follower replays the identical chunked
+    schedule, budget boundaries included).  Host args are normalized to
+    numpy on both sides of the wire (a process-local device array cannot
+    feed a global-mesh jit).
     """
 
     def __init__(self, cfg, params, *, channel: GangChannel, **kw) -> None:
@@ -623,6 +627,62 @@ class GangEngine(contlib.ContinuousEngine):
         self._decode_for = decode_for
         self._prefix_admit_for = prefix_admit_for
         self._merge = merge
+
+        if self.prefill_budget > 0:
+            # chunked admission (stall-free continuous batching): the
+            # fused prefill+decode step and the standalone chunk join the
+            # control stream — followers replay the identical chunked
+            # schedule, budget boundaries and all
+            chunk_inner = self._chunk_prefill_for
+            fused_inner = self._fused_for
+
+            def chunk_prefill_for(needed: int):
+                prog = chunk_inner(needed)
+
+                def call(params, cache, logits, slot, toks, start, length,
+                         write_slot):
+                    try:
+                        toks = np.asarray(toks)
+                        ch.publish(("chunk_prefill", int(needed), int(slot),
+                                    toks, int(start), int(length),
+                                    int(write_slot)))
+                        return prog(params, cache, logits, np.int32(slot),
+                                    toks, np.int32(start), np.int32(length),
+                                    np.int32(write_slot))
+                    except Exception as e:  # noqa: BLE001 — see _fatal
+                        raise self._fatal(e)
+
+                return call
+
+            def fused_for(needed: int):
+                prog = fused_inner(needed)
+
+                def call(params, cache, logits, slot, toks, start, length,
+                         write_slot, positions, active, temps, top_ps,
+                         top_ks, key):
+                    try:
+                        toks = np.asarray(toks)
+                        positions = np.asarray(positions)
+                        active = np.asarray(active)
+                        temps = np.asarray(temps)
+                        top_ps = np.asarray(top_ps)
+                        top_ks = np.asarray(top_ks)
+                        key = np.asarray(key)
+                        ch.publish(("fused", int(needed), int(slot), toks,
+                                    int(start), int(length),
+                                    int(write_slot), positions, active,
+                                    temps, top_ps, top_ks, key))
+                        return prog(params, cache, logits, np.int32(slot),
+                                    toks, np.int32(start), np.int32(length),
+                                    np.int32(write_slot), positions, active,
+                                    temps, top_ps, top_ks, key)
+                    except Exception as e:  # noqa: BLE001
+                        raise self._fatal(e)
+
+                return call
+
+            self._chunk_prefill_for = chunk_prefill_for
+            self._fused_for = fused_for
 
         if self.prefix_segments > 0:
             # shared-prefix segment ops join the control stream: segment
@@ -752,6 +812,22 @@ def follow(engine: contlib.ContinuousEngine, channel: GangChannel) -> None:
                 engine._decode_for(needed)(
                     params, engine._pool_cache, engine._pool_logits,
                     positions, active, temps, top_ps, top_ks, key))
+        elif op == "chunk_prefill":
+            _, needed, slot, toks, start, length, write_slot = msg
+            engine._pool_cache, engine._pool_logits = (
+                engine._chunk_prefill_for(needed)(
+                    params, engine._pool_cache, engine._pool_logits,
+                    np.int32(slot), toks, np.int32(start),
+                    np.int32(length), np.int32(write_slot)))
+        elif op == "fused":
+            (_, needed, slot, toks, start, length, write_slot, positions,
+             active, temps, top_ps, top_ks, key) = msg
+            engine._pool_cache, engine._pool_logits, _toks = (
+                engine._fused_for(needed)(
+                    params, engine._pool_cache, engine._pool_logits,
+                    np.int32(slot), toks, np.int32(start),
+                    np.int32(length), np.int32(write_slot), positions,
+                    active, temps, top_ps, top_ks, key))
         elif op == "prefix":
             _, total, sb, src, dst, lp, suffix, slen = msg
             engine._pool_cache, engine._pool_logits = (

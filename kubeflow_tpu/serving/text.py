@@ -186,6 +186,15 @@ class TextGenerator(Model):
 
     self_batching = True
 
+    #: seconds of zero stream progress before an SSE comment line is
+    #: emitted.  TTFT semantics under chunked prefill (``prefill_budget``
+    #: > 0, serving/continuous.py): a long prompt's first token arrives
+    #: only after ceil(len/budget) fused dispatches, so a streaming
+    #: client may legitimately see NOTHING for the whole admission —
+    #: the keep-alive comment (ignored by SSE clients by spec) stops
+    #: proxies/clients from timing the connection out mid-prefill.
+    KEEPALIVE_S = 15.0
+
     def __init__(self, name: str, config: Optional[dict[str, Any]] = None,
                  engine=None):
         super().__init__(name, config)
@@ -276,6 +285,7 @@ class TextGenerator(Model):
         ]
         sent = [""] * len(reqs)
         finished = [False] * len(reqs)
+        last_event = timelib.monotonic()
         model = payload.get("model", self.name)
         stops = self._stop_sequences(payload)
         scanners = ([_StopScanner(self.tokenizer, stops) for _ in reqs]
@@ -325,12 +335,19 @@ class TextGenerator(Model):
                     if delta:
                         sent[i] = sent[i] + delta if not done else full
                         progressed = True
+                        last_event = timelib.monotonic()
                         yield ("data: " + jsonlib.dumps({
                             "object": "text_completion.chunk",
                             "model": model,
                             "choices": [{"index": i, "text": delta}],
                         }) + "\n\n").encode()
                 if not all(finished) and not progressed:
+                    if timelib.monotonic() - last_event > self.KEEPALIVE_S:
+                        # a long chunked prefill produces no tokens for
+                        # its whole admission — prove the stream alive
+                        # (SSE comment; clients ignore it by spec)
+                        last_event = timelib.monotonic()
+                        yield b": keep-alive\n\n"
                     timelib.sleep(0.02)
             yield b"data: [DONE]\n\n"
         finally:

@@ -278,6 +278,97 @@ def bench_shared_prefix(n_requests: int = 6, prefix_len: int = 896,
     }
 
 
+def bench_chunked_prefill_stall(prompt_len: int = 896,
+                                prefill_budget: int = 64,
+                                decode_chunk: int = 4,
+                                cfg=None) -> dict:
+    """ISSUE 2's headline number: decode inter-token latency for a LIVE
+    request WHILE a long prompt admits — legacy whole-prompt admission
+    (one [1, bucket] prefill dispatch freezes the decode stream for the
+    whole prompt) vs Sarathi-style chunked prefill fused into the decode
+    dispatches (stall bounded by ``prefill_budget`` tokens of prefill
+    per dispatch).  A victim request decodes continuously; its token
+    arrivals are timestamped on the host; the long prompt is submitted
+    mid-stream and the ITL distribution over the admission window is
+    reported (p50/p99/max, per token — arrivals land in decode_chunk
+    granularity, so each gap is spread over the tokens it delivered).
+    """
+    from kubeflow_tpu.serving.continuous import ContinuousEngine
+
+    if cfg is None:
+        cfg = _bench_model()
+    model = llamalib.Llama(cfg)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32))["params"]
+    rng = np.random.default_rng(3)
+    long_prompt = rng.integers(1, cfg.vocab_size, size=prompt_len).tolist()
+    victim_prompt = rng.integers(1, cfg.vocab_size, size=32).tolist()
+    victim_new = 256
+
+    def run(budget: int) -> tuple[list[float], float]:
+        """(per-token ITLs in ms over the admission window, stall gauge)."""
+        eng = ContinuousEngine(
+            cfg, params, num_slots=4, decode_chunk=decode_chunk,
+            pipeline_depth=2, prefix_cache=False, prefill_budget=budget)
+        try:
+            eng.warmup([(1, 32), (1, prompt_len)])
+            # prime: first execution pays device-side setup
+            eng.generate(victim_prompt, max_new_tokens=decode_chunk)
+            victim = eng.submit(victim_prompt, max_new_tokens=victim_new)
+            arrivals: list[tuple[float, int]] = []  # (t, tokens so far)
+            seen = 0
+            submitted = None
+            long_req = None
+            while not victim.done.is_set():
+                n = len(victim.tokens)
+                if n > seen:
+                    arrivals.append((time.perf_counter(), n))
+                    seen = n
+                if submitted is None and seen >= 4 * decode_chunk:
+                    long_req = eng.submit(long_prompt, max_new_tokens=4)
+                    submitted = time.perf_counter()
+                time.sleep(0.0005)
+            victim.wait(600)
+            if long_req is not None:
+                long_req.wait(600)
+            window_end = (long_req.first_token_at
+                          or time.perf_counter()) if long_req else None
+            itls = []
+            for (t0, n0), (t1, n1) in zip(arrivals, arrivals[1:]):
+                if submitted is None or t1 < submitted or (
+                        window_end and t0 > window_end):
+                    continue  # outside the admission window
+                itls.extend([(t1 - t0) / (n1 - n0) * 1e3] * (n1 - n0))
+            return itls, eng.stats()["decode_stall_ms_total"]
+        finally:
+            eng.stop()
+
+    def pct(xs, q):
+        if not xs:
+            return 0.0
+        xs = sorted(xs)
+        return xs[min(len(xs) - 1, int(q * len(xs)))]
+
+    legacy, legacy_stall = run(0)
+    chunked, chunked_stall = run(prefill_budget)
+    return {
+        "metric": "decode_itl_during_long_prompt_admission_ms",
+        "model": f"{llamalib.num_params(cfg) / 1e6:.0f}M",
+        "long_prompt": prompt_len,
+        "prefill_budget": prefill_budget, "decode_chunk": decode_chunk,
+        "legacy_p50_ms": round(pct(legacy, 0.5), 2),
+        "legacy_p99_ms": round(pct(legacy, 0.99), 2),
+        "legacy_max_ms": round(max(legacy, default=0.0), 2),
+        "chunked_p50_ms": round(pct(chunked, 0.5), 2),
+        "chunked_p99_ms": round(pct(chunked, 0.99), 2),
+        "chunked_max_ms": round(max(chunked, default=0.0), 2),
+        "p99_speedup": round(
+            pct(legacy, 0.99) / max(pct(chunked, 0.99), 1e-9), 2),
+        "legacy_stall_gauge_ms": round(legacy_stall, 1),
+        "chunked_stall_gauge_ms": round(chunked_stall, 1),
+    }
+
+
 def bench_tiered_window(new_tokens: int = 16) -> dict:
     """r3 weak #4: one LONG conversation must not tax short requests'
     decode window.  A long request (prompt 1024) decodes continuously
@@ -347,6 +438,7 @@ def main() -> None:
     print(json.dumps(bench_prefix_cache(prompt_len=896, new_tokens=4)),
           flush=True)
     print(json.dumps(bench_shared_prefix()), flush=True)
+    print(json.dumps(bench_chunked_prefill_stall()), flush=True)
     print(json.dumps(bench_tiered_window()), flush=True)
     print(json.dumps(bench_bert(batch=8, seq=128)), flush=True)
 

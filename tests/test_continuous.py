@@ -385,6 +385,12 @@ class TestCancellationAndStats:
             assert 'kft_engine_tokens_emitted{model="statgen"} 3' in text
             assert 'kft_engine_slots_capacity{model="statgen"} 2' in text
             assert "# TYPE kft_engine_slots_capacity gauge" in text
+            # chunked-prefill scheduler observability (ISSUE 2) rides the
+            # same stats -> gauge export
+            assert "# TYPE kft_engine_prefill_chunks_dispatched gauge" in text
+            assert 'kft_engine_prefill_tokens_inflight{model="statgen"} 0' \
+                in text
+            assert "kft_engine_decode_stall_ms_total" in text
         finally:
             srv.stop()
 

@@ -274,6 +274,88 @@ class TestServingGang:
         assert np.array_equal(arr, big)
 
 
+class TestGangChunkedPrefill:
+    """ISSUE 2: the chunked-admission schedule (``chunk_prefill`` /
+    ``fused`` ops) crosses the control stream and a follower replays it
+    BIT-IDENTICALLY — same pool cache, same pool logits — with token
+    parity against the single-process chunked engine.  Single process,
+    loopback channel: the real GangEngine publish wrappers and the real
+    ``follow()`` executor, no JaxJob machinery."""
+
+    @pytest.mark.slow
+    def test_follower_replays_chunked_schedule_bit_identically(self):
+        import threading
+
+        import numpy as np
+        from flax import linen as nn
+
+        from kubeflow_tpu.serving.gang import GangChannel, GangEngine, follow
+        from kubeflow_tpu.utils.net import allocate_port
+
+        cfg = llamalib.tiny(num_heads=8, num_kv_heads=8)
+        params = nn.meta.unbox(llamalib.Llama(cfg).init(
+            jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32))["params"])
+        kw = dict(num_slots=3, decode_chunk=2, temperature=0.0,
+                  eos_id=None, seq_buckets=[32], prefix_cache=False,
+                  prefill_budget=8, mesh_axes={"model": 8})
+        prompt = list(range(1, 25))  # 3 chunks at budget 8
+
+        ref = ContinuousEngine(cfg, params, **kw)
+        try:
+            r1 = ref.submit([7, 8, 9], max_new_tokens=12)
+            r2 = ref.submit(prompt, max_new_tokens=5)
+            want = [r1.wait(300), r2.wait(300)]
+        finally:
+            ref.stop()
+
+        port = allocate_port()
+        follower_engine = ContinuousEngine(cfg, params, **kw)
+        ops: list[str] = []
+
+        def run_follower():
+            ch = GangChannel.connect("127.0.0.1", port, rank=1, token="t")
+            orig_next = ch.next
+
+            def tap():
+                m = orig_next()
+                ops.append(m[0])
+                return m
+
+            ch.next = tap
+            try:
+                follow(follower_engine, ch)
+            finally:
+                ch.close()
+
+        t = threading.Thread(target=run_follower, daemon=True)
+        t.start()
+        chan = GangChannel.listen(port, 1, token="t")
+        leader = GangEngine(cfg, params, channel=chan, **kw)
+        try:
+            victim = leader.submit([7, 8, 9], max_new_tokens=12)
+            time.sleep(0.2)  # let the victim enter decode: chunks fuse
+            late = leader.submit(prompt, max_new_tokens=5)
+            got = [victim.wait(300), late.wait(300)]
+        finally:
+            # stop() publishes the terminal op; follow() then drains the
+            # full stream before returning, so after join both pools are
+            # final — no sleep-based synchronization (generous timeout:
+            # the follower compiles its own program set on first replay)
+            leader.stop()
+            t.join(timeout=300)
+        assert not t.is_alive(), "follower did not drain the stream"
+        assert got == want  # chunked gang == chunked single-process
+        assert "chunk_prefill" in ops or "fused" in ops
+        # the replayed pool state is the leader's, bit for bit
+        ll = np.asarray(jax.device_get(leader._pool_logits))
+        fl = np.asarray(jax.device_get(follower_engine._pool_logits))
+        assert np.array_equal(ll, fl)
+        for a, b in zip(jax.tree.leaves(jax.device_get(leader._pool_cache)),
+                        jax.tree.leaves(
+                            jax.device_get(follower_engine._pool_cache))):
+            assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
 class TestGangChannelRecovery:
     """Control-stream self-healing (ISSUE 1), no processes: the dispatch
     replay a follower needs after a socket drop is exactly the replay an
