@@ -1,0 +1,59 @@
+"""Platform analyzer: AST lint rules + runtime dispatch/lock auditors.
+
+The chaos harness (PR 1) found real concurrency bugs at runtime and the
+stall-free batching work (PR 2) made dispatch hygiene the serving hot
+path's whole perf story — both bug classes are *mechanically detectable
+before runtime*.  "Exploring the limits of Concurrency in ML Training on
+Google TPUs" (PAPERS.md) is blunt about why: TPU throughput lives or
+dies on keeping the device queue full — no accidental host syncs, no
+recompiles — and Podracer makes the same argument for the control loop.
+This package enforces those invariants as code:
+
+- :mod:`.astlint` — rule framework: parse every platform module once,
+  run the rule set, compare against the ratchet baseline
+  (``baseline.json``): existing findings are frozen debt, NEW findings
+  fail tier-1 (``tests/test_analysis.py``).
+- :mod:`.rules_dispatch` — ``host-sync-in-dispatch`` (a ``.item()`` /
+  ``device_get`` / ``np.asarray`` reachable from the engine's dispatch
+  loop stalls the device queue) and ``jit-in-loop`` (program
+  construction inside a loop body is a recompile treadmill).
+- :mod:`.rules_locks` — ``lock-order``: the global ``with <lock>:``
+  nesting graph across serving/controlplane/hpo/net; cycles are
+  deadlocks waiting for a chaos schedule, and blocking calls (sleep,
+  socket ops, jax fetches) under a lock are convoy generators.
+- :mod:`.rules_hygiene` — ``swallowed-exception`` (every ``except
+  Exception`` must log, re-raise, or carry a justification),
+  ``unsafe-pickle`` (pickle ingestion outside the post-auth gang replay
+  path), ``nondaemon-thread`` (a non-daemon helper thread wedges
+  interpreter shutdown).
+- :mod:`.runtime` — the *runtime* half: :func:`recompile_guard` counts
+  jit cache misses after warmup (``jit_recompiles_total`` engine gauge,
+  asserted 0 in steady-state decode) and :class:`LockAudit` records
+  real acquisition order under chaos to catch inversions static nesting
+  cannot see.
+
+Intentional violations carry an inline pragma on the offending line (or
+the line above)::
+
+    x = jax.device_get(toks)  # analysis: ok host-sync-in-dispatch
+
+For ``swallowed-exception`` the established justification comment form
+``# noqa: BLE001 — <reason>`` (reason REQUIRED, em- or double-dash) is
+honored too — hpo/controllers.py's db-retry sites are the exemplar.
+
+Run it: ``python -m kubeflow_tpu.analysis`` (or
+``scripts/platform_lint.py``); ``--update-baseline`` re-freezes debt
+after an intentional change; ``--json`` emits machine-readable findings.
+This module deliberately imports no jax — the lint half is pure stdlib
+so the CLI and the tier-1 ratchet test stay fast.
+"""
+
+from .astlint import (  # noqa: F401
+    Finding,
+    LintReport,
+    baseline_path,
+    compare_to_baseline,
+    load_baseline,
+    run_lint,
+    write_baseline,
+)

@@ -1,0 +1,106 @@
+"""``python -m kubeflow_tpu.analysis`` — the platform lint CLI.
+
+Modes:
+  (default)            lint, compare to the baseline, exit 1 on NEW
+                       findings (the ratchet CI/tier-1 runs)
+  --update-baseline    freeze the current findings as the new debt
+  --json               machine-readable findings + summary on stdout
+  --baseline PATH      compare/write a non-default baseline file
+  --rule NAME          run a subset of rules (repeatable)
+  --all                list every finding, not just the new ones
+
+Exit codes: 0 = no findings above baseline; 1 = new findings; 2 = usage.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from .astlint import (
+    baseline_path,
+    compare_to_baseline,
+    load_baseline,
+    rule_names,
+    run_lint,
+    write_baseline,
+)
+
+
+def repo_root() -> str:
+    """The checkout root = two levels above this package."""
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m kubeflow_tpu.analysis",
+        description="Platform analyzer: AST lint with a findings ratchet")
+    ap.add_argument("paths", nargs="*",
+                    help="files to lint (default: the platform dirs)")
+    ap.add_argument("--root", default=None,
+                    help="repo root (default: auto-detected)")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline JSON path (default: "
+                         "kubeflow_tpu/analysis/baseline.json)")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="freeze current findings as the new baseline")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit machine-readable JSON")
+    ap.add_argument("--rule", action="append", default=None,
+                    choices=rule_names(),
+                    help="run only this rule (repeatable)")
+    ap.add_argument("--all", action="store_true",
+                    help="print every finding, not only new ones")
+    args = ap.parse_args(argv)
+
+    root = os.path.abspath(args.root) if args.root else repo_root()
+    bpath = args.baseline or baseline_path(root)
+    paths = [os.path.abspath(p) for p in args.paths] or None
+    if args.update_baseline and (paths or args.rule):
+        # a subset lint would OVERWRITE the baseline with only the
+        # subset's findings, silently erasing every other frozen entry —
+        # the next full run then fails tier-1 on debt nobody added
+        ap.error("--update-baseline requires a full lint "
+                 "(no positional paths, no --rule)")
+    report = run_lint(root, paths=paths, rules=args.rule)
+
+    if args.update_baseline:
+        doc = write_baseline(bpath, report)
+        if args.as_json:
+            print(json.dumps(doc, indent=1))
+        else:
+            print(f"baseline updated: {bpath} "
+                  f"({len(report.findings)} findings frozen: "
+                  f"{doc['by_rule']})")
+        return 0
+
+    baseline = load_baseline(bpath)
+    new = compare_to_baseline(report, baseline)
+
+    if args.as_json:
+        print(json.dumps({
+            "total": len(report.findings),
+            "by_rule": report.by_rule(),
+            "baseline_total": sum(baseline.values()),
+            "new": [vars(f) for f in new],
+        }, indent=1))
+    else:
+        shown = report.findings if args.all else new
+        for f in shown:
+            print(f)
+        print(f"platform_lint: {len(report.findings)} findings "
+              f"({report.by_rule() or 'clean'}), "
+              f"{sum(baseline.values())} baselined, {len(new)} NEW")
+        if new:
+            print("new findings above the ratchet baseline — fix them, "
+                  "pragma them with a reason, or (for reviewed debt) "
+                  "re-freeze with --update-baseline", file=sys.stderr)
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

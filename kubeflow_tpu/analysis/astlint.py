@@ -1,0 +1,263 @@
+"""AST lint framework: parse once, run rules, ratchet against a baseline.
+
+Pure stdlib by design — the tier-1 ratchet test and the CLI must parse
+the whole platform (~21k LoC) in well under a second, so nothing here
+may import jax, numpy, or any platform module.
+
+Key ratchet property: finding identity (:meth:`Finding.key`) is
+LINE-NUMBER-FREE — ``path::rule::scope::message`` — so unrelated edits
+that shift a frozen finding up or down the file do not resurrect it as
+"new".  Two identical findings in one scope collapse to a count, and the
+baseline stores counts: the ratchet fails when the count for any key
+*grows*, shrinking is always allowed (that is the point).
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Callable, Iterable, Optional
+
+#: pragma grammar: ``# analysis: ok <rule>[, <rule>...][ — reason]`` on
+#: the offending line or the line directly above it
+_PRAGMA = re.compile(
+    r"#\s*analysis:\s*ok\s+([a-z0-9-]+(?:\s*,\s*[a-z0-9-]+)*)")
+
+#: the established swallowed-exception justification form (the exemplar
+#: is hpo/controllers.py's db-retry sites): ``# noqa: BLE001`` is only a
+#: justification when a REASON follows the dash — a bare noqa is exactly
+#: the silent swallow the rule exists to surface
+_NOQA_JUSTIFIED = re.compile(r"#\s*noqa:\s*BLE001\s*(?:—|--|-)\s*\S")
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation at a source location."""
+
+    rule: str
+    path: str      # repo-relative, posix separators
+    line: int      # 1-based, for humans; NOT part of the ratchet key
+    scope: str     # enclosing function/class qualname ('' = module level)
+    message: str
+
+    @property
+    def key(self) -> str:
+        return f"{self.path}::{self.rule}::{self.scope}::{self.message}"
+
+    def __str__(self) -> str:
+        where = f"{self.path}:{self.line}"
+        scope = f" [{self.scope}]" if self.scope else ""
+        return f"{where}: {self.rule}{scope}: {self.message}"
+
+
+class ParsedFile:
+    """One module parsed once and shared by every rule."""
+
+    def __init__(self, relpath: str, text: str):
+        self.relpath = relpath.replace(os.sep, "/")
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree = ast.parse(text, filename=relpath)
+        #: line -> set of rule names pragma'd ok on that line
+        self.pragmas: dict[int, set[str]] = {}
+        for i, ln in enumerate(self.lines, start=1):
+            m = _PRAGMA.search(ln)
+            if m:
+                rules = {r.strip() for r in m.group(1).split(",")}
+                self.pragmas.setdefault(i, set()).update(rules)
+        # scope map: line -> innermost function/class qualname
+        self._scopes: list[tuple[int, int, str]] = []
+        self._index_scopes(self.tree, [])
+
+    def _index_scopes(self, node: ast.AST, stack: list[str]) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                qual = ".".join(stack + [child.name])
+                end = getattr(child, "end_lineno", child.lineno)
+                self._scopes.append((child.lineno, end, qual))
+                self._index_scopes(child, stack + [child.name])
+            else:
+                self._index_scopes(child, stack)
+
+    def scope_at(self, line: int) -> str:
+        """Innermost def/class qualname covering ``line``."""
+        best, best_span = "", None
+        for start, end, qual in self._scopes:
+            if start <= line <= end:
+                span = end - start
+                if best_span is None or span <= best_span:
+                    best, best_span = qual, span
+        return best
+
+    def line_text(self, line: int) -> str:
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1]
+        return ""
+
+    def allowed(self, line: int, rule: str) -> bool:
+        """Pragma on the offending line or the line above silences the
+        rule there (the noqa-above convention for long call lines)."""
+        for ln in (line, line - 1):
+            if rule in self.pragmas.get(ln, set()):
+                return True
+        return False
+
+    def has_justified_noqa(self, line: int) -> bool:
+        for ln in (line, line - 1):
+            if _NOQA_JUSTIFIED.search(self.line_text(ln)):
+                return True
+        return False
+
+
+@dataclass
+class LintContext:
+    """Everything the rule set sees: all parsed files, keyed by relpath."""
+
+    root: str
+    files: dict[str, ParsedFile] = field(default_factory=dict)
+
+    def finding(self, pf: ParsedFile, rule: str, node: ast.AST,
+                message: str) -> Optional[Finding]:
+        """Finding at ``node`` unless a pragma silences it."""
+        line = getattr(node, "lineno", 1)
+        if pf.allowed(line, rule):
+            return None
+        return Finding(rule=rule, path=pf.relpath, line=line,
+                       scope=pf.scope_at(line), message=message)
+
+
+#: rule registry: name -> fn(ctx) -> iterable of findings.  Rules are
+#: whole-context (lock-order needs the cross-module graph); per-file
+#: rules just iterate ctx.files.
+RuleFn = Callable[[LintContext], Iterable[Finding]]
+_RULES: dict[str, RuleFn] = {}
+
+
+def rule(name: str) -> Callable[[RuleFn], RuleFn]:
+    def deco(fn: RuleFn) -> RuleFn:
+        _RULES[name] = fn
+        return fn
+    return deco
+
+
+def rule_names() -> list[str]:
+    _ensure_rules_loaded()
+    return sorted(_RULES)
+
+
+def _ensure_rules_loaded() -> None:
+    # rule modules self-register via @rule at import; imported lazily so
+    # `from .astlint import Finding` never recurses
+    from . import rules_dispatch, rules_hygiene, rules_locks  # noqa: F401
+
+
+#: directories under the repo root that hold platform code to lint;
+#: tests/ is deliberately out (fixture snippets there are true positives
+#: on purpose), artifacts/examples hold generated/demo code
+LINT_DIRS = ("kubeflow_tpu", "scripts")
+
+
+def discover(root: str) -> list[str]:
+    out = []
+    for d in LINT_DIRS:
+        base = os.path.join(root, d)
+        for dirpath, dirnames, filenames in os.walk(base):
+            dirnames[:] = [x for x in dirnames if x != "__pycache__"]
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    out.append(os.path.join(dirpath, fn))
+    return sorted(out)
+
+
+@dataclass
+class LintReport:
+    findings: list[Finding]
+
+    def counts(self) -> dict[str, int]:
+        out: dict[str, int] = {}
+        for f in self.findings:
+            out[f.key] = out.get(f.key, 0) + 1
+        return out
+
+    def by_rule(self) -> dict[str, int]:
+        out: dict[str, int] = {}
+        for f in self.findings:
+            out[f.rule] = out.get(f.rule, 0) + 1
+        return out
+
+
+def parse_paths(root: str, paths: Iterable[str]) -> LintContext:
+    ctx = LintContext(root=root)
+    for p in paths:
+        rel = os.path.relpath(p, root)
+        with open(p, "r", encoding="utf-8") as fh:
+            text = fh.read()
+        try:
+            ctx.files[rel.replace(os.sep, "/")] = ParsedFile(rel, text)
+        except SyntaxError:
+            # a file the platform cannot even parse is somebody else's
+            # build break, not a lint finding
+            continue
+    return ctx
+
+
+def run_lint(root: str, paths: Optional[Iterable[str]] = None,
+             rules: Optional[Iterable[str]] = None) -> LintReport:
+    """Parse ``paths`` (default: the platform dirs under ``root``) and
+    run ``rules`` (default: all registered)."""
+    _ensure_rules_loaded()
+    ctx = parse_paths(root, paths if paths is not None else discover(root))
+    wanted = list(rules) if rules is not None else sorted(_RULES)
+    findings: list[Finding] = []
+    for name in wanted:
+        findings.extend(_RULES[name](ctx))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
+    return LintReport(findings)
+
+
+# -- baseline ratchet ------------------------------------------------------
+
+def baseline_path(root: str) -> str:
+    return os.path.join(root, "kubeflow_tpu", "analysis", "baseline.json")
+
+
+def load_baseline(path: str) -> dict[str, int]:
+    if not os.path.exists(path):
+        return {}
+    with open(path, "r", encoding="utf-8") as fh:
+        data = json.load(fh)
+    return {str(k): int(v) for k, v in data.get("findings", {}).items()}
+
+
+def write_baseline(path: str, report: LintReport) -> dict:
+    """Freeze the current findings as the new debt ceiling."""
+    doc = {
+        "comment": (
+            "platform_lint ratchet baseline: frozen findings debt. "
+            "New findings FAIL tier-1; shrink freely, grow never. "
+            "Regenerate with `python -m kubeflow_tpu.analysis "
+            "--update-baseline` only for reviewed, intentional debt."),
+        "by_rule": report.by_rule(),
+        "findings": dict(sorted(report.counts().items())),
+    }
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh, indent=1, sort_keys=False)
+        fh.write("\n")
+    return doc
+
+
+def compare_to_baseline(report: LintReport,
+                        baseline: dict[str, int]) -> list[Finding]:
+    """Findings above the frozen debt: for each key, any occurrences
+    beyond the baselined count (a brand-new key has baseline 0)."""
+    counts: dict[str, int] = {}
+    new: list[Finding] = []
+    for f in report.findings:
+        counts[f.key] = counts.get(f.key, 0) + 1
+        if counts[f.key] > baseline.get(f.key, 0):
+            new.append(f)
+    return new

@@ -1,0 +1,287 @@
+"""Dispatch-hygiene rules: the device queue must never drain.
+
+``host-sync-in-dispatch``: on TPU the engine's throughput is the device
+queue's occupancy (PAPERS.md: "Exploring the limits of Concurrency in ML
+Training on Google TPUs"); one stray ``.item()`` / ``device_get`` /
+``np.asarray`` on a device value inside the scheduler's dispatch path
+serializes host and device and re-introduces the per-token round trip
+the dispatch-ahead pipeline exists to hide.  The rule builds the
+intra-file call graph from every ``*Engine`` class's scheduler roots
+(``_loop``/``_admit``/``_process``...) and flags host-materialization
+calls in anything reachable.  The engine DOES need exactly one fetch
+boundary (delivering sampled tokens) and host-side numpy scheduler math
+is legitimate — those sites carry ``# analysis: ok host-sync-in-dispatch``
+pragmas, which is the point: the boundary is *declared*, so a new
+undeclared one fails tier-1.
+
+``jit-in-loop``: constructing a jit (or a ``make_*_program`` /
+``mesh_jit``) inside a loop body builds a fresh Python callable per
+iteration — each jax.jit object carries its own trace cache, so this is
+a guaranteed recompile treadmill.  Program construction belongs in cached
+getters (the ``_build_programs`` pattern); only *calling* a cached
+program in a loop is fine.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable, Optional
+
+from .astlint import Finding, LintContext, ParsedFile, rule
+
+#: scheduler entry points: methods of any ``*Engine`` class from which
+#: the dispatch-path reachability walk starts
+ROOT_METHODS = ("_loop", "_loop_inner", "_admit", "_process", "step",
+                "_dispatch")
+
+_MAKE_PROGRAM = re.compile(r"^make_\w*_program$")
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for Name/Attribute chains, else None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+class _FileGraph:
+    """Intra-file call graph: function qualname -> callee qualnames.
+
+    Resolution is deliberately simple (and documented as such):
+    ``self.X(...)`` resolves to method ``X`` of the enclosing class (and
+    to an aliased nested function when the file assigns ``self.X = Y``,
+    the ``_build_programs`` getter pattern); bare ``name(...)`` resolves
+    to a module-level function of that name.  Cross-file calls are out
+    of scope — the dispatch loop and its helpers live in one module by
+    design.
+    """
+
+    def __init__(self, pf: ParsedFile):
+        self.pf = pf
+        self.funcs: dict[str, ast.AST] = {}      # qualname -> def node
+        self.by_class: dict[str, dict[str, str]] = {}  # class -> name -> qual
+        self.module_funcs: dict[str, str] = {}   # bare name -> qualname
+        self.aliases: dict[tuple[str, str], str] = {}  # (class, attr) -> qual
+        self.classes: dict[str, ast.ClassDef] = {}
+        self._index(pf.tree, [])
+        self._index_aliases()
+
+    def _index(self, node: ast.AST, stack: list[str]) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                self.classes[child.name] = child
+                self._index(child, stack + [child.name])
+            elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = ".".join(stack + [child.name])
+                self.funcs[qual] = child
+                if not stack:
+                    self.module_funcs[child.name] = qual
+                else:
+                    # owning class = first ClassDef on the stack path
+                    cls = stack[0]
+                    self.by_class.setdefault(cls, {})[child.name] = qual
+                self._index(child, stack + [child.name])
+            else:
+                self._index(child, stack)
+
+    def _index_aliases(self) -> None:
+        # self.X = Y where Y names a function defined in this file: calls
+        # through self.X reach Y (the cached-getter installation pattern)
+        for qual, fn in list(self.funcs.items()):
+            cls = qual.split(".")[0] if "." in qual else None
+            if cls is None:
+                continue
+            for node in ast.walk(fn):
+                if not (isinstance(node, ast.Assign)
+                        and len(node.targets) == 1):
+                    continue
+                t = node.targets[0]
+                if (isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"
+                        and isinstance(node.value, ast.Name)):
+                    target = node.value.id
+                    # innermost visible def: prefer one nested under qual
+                    cand = f"{qual}.{target}"
+                    if cand not in self.funcs:
+                        cand = self.module_funcs.get(target, "")
+                    if cand:
+                        self.aliases[(cls, t.attr)] = cand
+
+    def callees(self, qual: str) -> set[str]:
+        fn = self.funcs.get(qual)
+        if fn is None:
+            return set()
+        cls = qual.split(".")[0] if "." in qual else None
+        out: set[str] = set()
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if isinstance(f, ast.Name):
+                cand = f"{qual}.{f.id}"
+                if cand in self.funcs:
+                    out.add(cand)
+                elif f.id in self.module_funcs:
+                    out.add(self.module_funcs[f.id])
+            elif (isinstance(f, ast.Attribute)
+                  and isinstance(f.value, ast.Name)
+                  and f.value.id == "self" and cls is not None):
+                m = self.by_class.get(cls, {}).get(f.attr)
+                if m:
+                    out.add(m)
+                a = self.aliases.get((cls, f.attr))
+                if a:
+                    out.add(a)
+        return out
+
+    def reachable(self, roots: Iterable[str]) -> set[str]:
+        seen: set[str] = set()
+        todo = [r for r in roots if r in self.funcs]
+        while todo:
+            q = todo.pop()
+            if q in seen:
+                continue
+            seen.add(q)
+            todo.extend(self.callees(q) - seen)
+        return seen
+
+
+#: host-materialization calls: each entry is (label, matcher(Call) -> bool)
+def _is_item(call: ast.Call) -> bool:
+    return (isinstance(call.func, ast.Attribute)
+            and call.func.attr == "item" and not call.args)
+
+
+def _is_tolist(call: ast.Call) -> bool:
+    return (isinstance(call.func, ast.Attribute)
+            and call.func.attr == "tolist" and not call.args)
+
+
+def _is_device_get(call: ast.Call) -> bool:
+    d = _dotted(call.func)
+    return d in ("jax.device_get", "device_get")
+
+
+def _is_block_until_ready(call: ast.Call) -> bool:
+    if isinstance(call.func, ast.Attribute) and (
+            call.func.attr == "block_until_ready"):
+        return True
+    return _dotted(call.func) == "jax.block_until_ready"
+
+
+def _is_np_materialize(call: ast.Call) -> bool:
+    d = _dotted(call.func)
+    if d not in ("np.asarray", "np.array", "numpy.asarray", "numpy.array",
+                 "onp.asarray", "onp.array"):
+        return False
+    if not call.args:
+        return False
+    # materializing an obvious host literal is not a device fetch
+    return not isinstance(call.args[0],
+                          (ast.List, ast.ListComp, ast.Tuple, ast.Constant))
+
+
+_REDUCERS = {"max", "min", "sum", "mean", "any", "all", "argmax", "argmin"}
+
+
+def _is_scalarized_reduction(call: ast.Call) -> bool:
+    """float(x.max()) / int(a[m].sum()): forces the reduced value to a
+    Python scalar — a sync when x is a device array."""
+    if not (isinstance(call.func, ast.Name)
+            and call.func.id in ("float", "int", "bool")
+            and len(call.args) == 1):
+        return False
+    a = call.args[0]
+    return (isinstance(a, ast.Call) and isinstance(a.func, ast.Attribute)
+            and a.func.attr in _REDUCERS)
+
+
+_HOST_SYNCS = (
+    ("`.item()`", _is_item),
+    ("`.tolist()`", _is_tolist),
+    ("`jax.device_get`", _is_device_get),
+    ("`block_until_ready`", _is_block_until_ready),
+    ("numpy materialization (`np.asarray`/`np.array`)", _is_np_materialize),
+    ("scalarized reduction (`int`/`float` of `.max()`-like)",
+     _is_scalarized_reduction),
+)
+
+
+@rule("host-sync-in-dispatch")
+def host_sync_in_dispatch(ctx: LintContext) -> Iterable[Finding]:
+    for pf in ctx.files.values():
+        graph = _FileGraph(pf)
+        roots = [
+            f"{cls}.{m}"
+            for cls in graph.classes if cls.endswith("Engine")
+            for m in ROOT_METHODS
+        ]
+        if not roots:
+            continue
+        for qual in sorted(graph.reachable(roots)):
+            fn = graph.funcs[qual]
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                for label, match in _HOST_SYNCS:
+                    if match(node):
+                        f = ctx.finding(
+                            pf, "host-sync-in-dispatch", node,
+                            f"host sync {label} reachable from the "
+                            "engine dispatch loop")
+                        if f:
+                            yield f
+                        break
+
+
+def _is_program_construction(call: ast.Call) -> bool:
+    f = call.func
+    d = _dotted(f)
+    if d in ("jax.jit", "jax.pmap"):
+        return True
+    name = None
+    if isinstance(f, ast.Name):
+        name = f.id
+    elif isinstance(f, ast.Attribute):
+        name = f.attr
+    if name is None:
+        return False
+    return name == "mesh_jit" or bool(_MAKE_PROGRAM.match(name))
+
+
+def walk_skip_defs(node: ast.AST) -> Iterable[ast.AST]:
+    """ast.walk that does NOT descend into nested function/lambda bodies
+    — a def inside the scanned region runs later (if ever), not here."""
+    for child in ast.iter_child_nodes(node):
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+            continue
+        yield child
+        yield from walk_skip_defs(child)
+
+
+@rule("jit-in-loop")
+def jit_in_loop(ctx: LintContext) -> Iterable[Finding]:
+    for pf in ctx.files.values():
+        for loop in ast.walk(pf.tree):
+            if not isinstance(loop, (ast.For, ast.While, ast.AsyncFor)):
+                continue
+            # scan only this loop's own body (nested defs build programs
+            # lazily when *called* — construction is not per-iteration)
+            for node in walk_skip_defs(loop):
+                if isinstance(node, ast.Call) and _is_program_construction(
+                        node):
+                    f = ctx.finding(
+                        pf, "jit-in-loop", node,
+                        "jit/program construction inside a loop body "
+                        "(recompile treadmill — hoist into a cached "
+                        "getter)")
+                    if f:
+                        yield f

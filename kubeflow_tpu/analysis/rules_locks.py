@@ -1,0 +1,246 @@
+"""``lock-order``: the platform-wide lock nesting graph.
+
+PR 1's chaos harness caught a double restart-bump from two reconciles
+racing one key — a bug class locks *create* as readily as they fix.
+This rule extracts every ``with <lock>:`` nesting across the serving,
+controlplane, hpo, and net layers, builds the global acquisition-order
+graph, and flags:
+
+- **cycles** (``A`` taken under ``B`` somewhere, ``B`` under ``A``
+  elsewhere): a deadlock that needs only the right two-thread schedule —
+  exactly what fault injection eventually finds, so find it at lint
+  time instead;
+- **blocking calls while holding a lock** (``time.sleep``, socket
+  send/recv/connect/accept, thread ``join``, ``urlopen``, jax fetches):
+  every other thread needing that lock now waits on the network/device
+  too — the convoy that turns one slow peer into a platform stall.
+  The gang channel's bounded ``sendall``-under-lock sites are the
+  intentional, documented exception (socket timeouts bound the hold)
+  and carry pragmas.
+
+Lock identity is lexical: ``self._lock`` in class ``Foo`` is
+``Foo._lock``; a module-level ``_lock`` is ``module._lock``.  Two
+*instances* of one class share an identity here — over-approximate for
+cycles (a self-edge via two instances is real ONLY if two objects nest;
+those are skipped), under-approximate across files.  One level of
+interprocedural depth is modeled: a call made under a lock pulls in the
+locks that callee (same file) lexically takes.
+
+Runtime truth — orders that only happen under fault injection — is the
+:class:`~kubeflow_tpu.analysis.runtime.LockAudit` recorder's job; this
+rule is the static floor.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Iterable, Optional
+
+from .astlint import Finding, LintContext, ParsedFile, rule
+from .rules_dispatch import _dotted, walk_skip_defs
+
+#: layers whose locking interacts (the cross-component deadlock surface)
+LOCK_SCOPE_PREFIXES = (
+    "kubeflow_tpu/serving/",
+    "kubeflow_tpu/controlplane/",
+    "kubeflow_tpu/hpo/",
+    "kubeflow_tpu/utils/net.py",
+    "kubeflow_tpu/chaos/",
+    "kubeflow_tpu/native/",
+)
+
+_LOCKISH = ("lock", "gate", "cond", "mutex", "joined")
+
+
+def _lock_name(expr: ast.AST, pf: ParsedFile, cls: str) -> Optional[str]:
+    """Canonical lock id for a with-item context expr, or None if the
+    expr doesn't look like a lock."""
+    d = _dotted(expr)
+    if d is None:
+        return None
+    last = d.rsplit(".", 1)[-1].lower()
+    if not any(k in last for k in _LOCKISH):
+        return None
+    mod = os.path.splitext(os.path.basename(pf.relpath))[0]
+    if d == "self" or d.startswith("self."):
+        owner = cls or mod
+        return f"{owner}.{d[5:]}" if d != "self" else None
+    if "." not in d:
+        return f"{mod}.{d}"
+    return d  # obj._lock style: keep the dotted text as identity
+
+
+class _WithLock:
+    def __init__(self, name: str, node: ast.With, pf: ParsedFile):
+        self.name = name
+        self.node = node
+        self.pf = pf
+
+
+def _enclosing_class(pf: ParsedFile, line: int) -> str:
+    scope = pf.scope_at(line)
+    return scope.split(".")[0] if scope else ""
+
+
+def _iter_with_locks(pf: ParsedFile):
+    """Every (lock-name, With-node) in the file, lexical."""
+    for node in ast.walk(pf.tree):
+        if not isinstance(node, (ast.With, ast.AsyncWith)):
+            continue
+        cls = _enclosing_class(pf, node.lineno)
+        for item in node.items:
+            name = _lock_name(item.context_expr, pf, cls)
+            if name:
+                yield name, node
+
+
+def _locks_in_body(pf: ParsedFile, node: ast.AST) -> list[tuple[str, ast.With]]:
+    """with-lock statements lexically inside ``node``'s body (not
+    descending into nested defs — they run on other threads/later)."""
+    out = []
+    for child in walk_skip_defs(node):
+        if not isinstance(child, (ast.With, ast.AsyncWith)):
+            continue
+        cls = _enclosing_class(pf, child.lineno)
+        for item in child.items:
+            name = _lock_name(item.context_expr, pf, cls)
+            if name:
+                out.append((name, child))
+    return out
+
+
+def _function_index(pf: ParsedFile) -> dict[str, ast.AST]:
+    """(class, name) and bare-name keyed defs for 1-level call lookup."""
+    idx: dict[str, ast.AST] = {}
+
+    def visit(node, stack):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                visit(child, stack + [child.name])
+            elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if stack:
+                    idx[f"{stack[0]}.{child.name}"] = child
+                else:
+                    idx[child.name] = child
+                visit(child, stack)
+            else:
+                visit(child, stack)
+
+    visit(pf.tree, [])
+    return idx
+
+
+_BLOCKING_SOCKET = {"recv", "send", "sendall", "accept", "connect",
+                    "create_connection", "recv_into"}
+
+
+def _blocking_label(call: ast.Call) -> Optional[str]:
+    d = _dotted(call.func)
+    if d in ("time.sleep", "sleep"):
+        return "`time.sleep`"
+    if d in ("jax.device_get", "jax.block_until_ready"):
+        return f"`{d}`"
+    f = call.func
+    if isinstance(f, ast.Attribute):
+        if f.attr in _BLOCKING_SOCKET:
+            return f"socket `.{f.attr}`"
+        if f.attr == "block_until_ready":
+            return "`.block_until_ready`"
+        if f.attr == "urlopen" or (isinstance(f.value, ast.Name)
+                                   and f.attr == "urlopen"):
+            return "`urlopen`"
+        if f.attr == "join" and "thread" in (_dotted(f.value) or "").lower():
+            return "thread `.join`"
+    if isinstance(f, ast.Name) and f.id == "urlopen":
+        return "`urlopen`"
+    return None
+
+
+@rule("lock-order")
+def lock_order(ctx: LintContext) -> Iterable[Finding]:
+    #: global edge set: (outer, inner) -> first (pf, node) that creates it
+    edges: dict[tuple[str, str], tuple[ParsedFile, ast.AST]] = {}
+
+    scoped = [pf for rel, pf in sorted(ctx.files.items())
+              if rel.startswith(LOCK_SCOPE_PREFIXES)]
+
+    # per-file: lexical nesting edges + blocking-under-lock + 1-level
+    # call expansion
+    for pf in scoped:
+        fidx = _function_index(pf)
+        for outer_name, outer_node in _iter_with_locks(pf):
+            body = list(walk_skip_defs(outer_node))
+            # direct lexical nesting
+            for inner_name, inner_node in _locks_in_body(pf, outer_node):
+                if inner_name != outer_name:
+                    edges.setdefault((outer_name, inner_name),
+                                     (pf, inner_node))
+            for child in body:
+                if not isinstance(child, ast.Call):
+                    continue
+                # blocking call while the lock is held
+                label = _blocking_label(child)
+                if label is not None:
+                    f = ctx.finding(
+                        pf, "lock-order", child,
+                        f"blocking call {label} while holding "
+                        f"`{outer_name}`")
+                    if f:
+                        yield f
+                    continue
+                # 1-level interprocedural: locks the callee takes are
+                # taken under this one
+                callee = None
+                fn = child.func
+                if isinstance(fn, ast.Name):
+                    callee = fidx.get(fn.id)
+                elif (isinstance(fn, ast.Attribute)
+                      and isinstance(fn.value, ast.Name)
+                      and fn.value.id == "self"):
+                    cls = _enclosing_class(pf, child.lineno)
+                    callee = fidx.get(f"{cls}.{fn.attr}")
+                if callee is not None:
+                    for inner_name, inner_node in _locks_in_body(pf, callee):
+                        if inner_name != outer_name:
+                            edges.setdefault((outer_name, inner_name),
+                                             (pf, child))
+
+    # cycle detection: edge a->b closes a cycle iff a is reachable back
+    # from b.  BFS with parent links reconstructs one witness path;
+    # each distinct node set reports once, anchored at the edge whose
+    # source node is smallest (stable across runs for the ratchet key).
+    graph: dict[str, set[str]] = {}
+    for a, b in edges:
+        graph.setdefault(a, set()).add(b)
+    reported: set[frozenset] = set()
+    for a, b in sorted(edges):
+        parent: dict[str, str] = {b: b}
+        queue = [b]
+        while queue:
+            node = queue.pop(0)
+            if node == a:
+                break
+            for nxt in sorted(graph.get(node, ())):
+                if nxt not in parent:
+                    parent[nxt] = node
+                    queue.append(nxt)
+        if a not in parent:
+            continue
+        path = [a]  # parent-chain hop-back: a, parent[a], ..., b
+        node = a
+        while node != b:
+            node = parent[node]
+            path.append(node)
+        # forward cycle = a --edge--> b --bfs-walk--> ... --> a
+        cycle = [a] + list(reversed(path))[:-1]
+        nodes = frozenset(cycle)
+        if nodes in reported or min(cycle) != a:
+            continue
+        reported.add(nodes)
+        pf, where = edges[(a, b)]
+        f = ctx.finding(
+            pf, "lock-order", where,
+            "lock-order cycle: " + " -> ".join(cycle + [a]))
+        if f:
+            yield f

@@ -1,0 +1,245 @@
+"""Runtime auditors: the dynamic half of the platform analyzer.
+
+Static rules (:mod:`.astlint`) catch what is *lexically* visible; these
+two catch what only manifests live:
+
+- :class:`RecompileGuard` / :func:`recompile_guard` — wraps an engine's
+  jitted programs and counts jit-cache growth past each program's first
+  compile.  The first compile per program is warmup (expected, paid
+  once); ANY growth after that is a recompile — a shape/dtype/weak-type
+  leak in the host scheduler that stalls every live request for a full
+  trace+compile.  The engine exports the shared counter as its
+  ``jit_recompiles_total`` stat (auto-surfaced as a /metrics gauge),
+  and tier-1 asserts it stays 0 across a chunked-prefill + decode
+  steady-state run.
+
+- :class:`LockAudit` — wraps/instruments ``threading`` locks and records
+  the REAL per-thread acquisition order, including orders that only
+  happen under fault injection (the chaos harness's schedules).  The
+  static ``lock-order`` rule sees lexical nesting; this sees the
+  interleavings chaos actually produced.  ``inversions()`` returns the
+  (A, B) pairs observed in both orders — each one is a deadlock that
+  needs nothing more than worse timing.
+
+No jax import at module load: the lint CLI shares this package and must
+stay stdlib-fast.  ``RecompileGuard`` only touches jax objects it is
+handed.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Iterable, Optional
+
+
+class RecompileCounter:
+    """Shared recompile tally (one per engine; thread-safe via the GIL
+    for the two scalars it carries).
+
+    ``armed`` gates counting: the engine's warmup deliberately compiles
+    a LADDER of shapes per program (group sizes, attend rungs) — growth
+    during that phase is the paid-once warm set, not a recompile.  The
+    engine arms the counter when warmup finishes (or at first traffic
+    when warmup was skipped); from then on, cache growth is a
+    mid-serving stall and counts."""
+
+    __slots__ = ("count", "armed")
+
+    def __init__(self) -> None:
+        self.count = 0
+        self.armed = False
+
+
+class RecompileGuard:
+    """Count jit cache misses past a program's first compile.
+
+    Wraps any callable produced by ``jax.jit`` or
+    ``serving.sharded.mesh_jit`` (which exposes its inner jitted fn as
+    ``_jitted``).  After each call the underlying trace-cache size is
+    read (``_cache_size``, present on jax's PjitFunction); the first
+    observed size is the warm set, growth beyond it increments the
+    shared counter.  Programs without a readable cache (AOT-compiled
+    executables, plain functions) pass through uncounted rather than
+    guessing.
+    """
+
+    def __init__(self, program: Callable, counter: RecompileCounter):
+        self._program = program
+        self._inner = getattr(program, "_jitted", program)
+        self._counter = counter
+        self._warm: Optional[int] = None
+
+    def _cache_size(self) -> Optional[int]:
+        probe = getattr(self._inner, "_cache_size", None)
+        if probe is None:
+            return None
+        try:
+            return int(probe())
+        except Exception:  # noqa: BLE001 — jax internals shifted: the
+            # guard degrades to uncounted, never breaks dispatch
+            return None
+
+    def __call__(self, *args, **kwargs):
+        out = self._program(*args, **kwargs)
+        size = self._cache_size()
+        if size is not None:
+            if self._warm is None:
+                # first compile of this program = its warm entry, never
+                # a recompile (programs may be built lazily post-warmup:
+                # a new attend rung's first compile is a cache miss by
+                # design, re-tracing an EXISTING entry is the bug)
+                self._warm = size
+            elif size > self._warm:
+                if self._counter.armed:
+                    self._counter.count += size - self._warm
+                self._warm = size
+        return out
+
+    def lower(self, *args, **kwargs):
+        """AOT lowering passthrough (scripts/aot_7b_serving.py path)."""
+        return self._program.lower(*args, **kwargs)
+
+    @property
+    def cache_entries(self) -> Optional[int]:
+        """Current trace-cache size of the wrapped program (None when
+        unreadable) — per-guard introspection for tests/debugging; the
+        shared counter aggregates recompiles across guards."""
+        return self._cache_size()
+
+
+def recompile_guard(program: Callable,
+                    counter: RecompileCounter) -> RecompileGuard:
+    """Wrap ``program`` so cache growth past its first compile counts
+    into ``counter`` (idempotent: re-wrapping a guard is a no-op)."""
+    if isinstance(program, RecompileGuard):
+        return program
+    return RecompileGuard(program, counter)
+
+
+class _AuditedLock:
+    """Context-manager/acquire-release proxy recording into a LockAudit."""
+
+    def __init__(self, audit: "LockAudit", lock: Any, name: str):
+        self._audit = audit
+        self._lock = lock
+        self.name = name
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        got = self._lock.acquire(blocking, timeout)
+        if got:
+            self._audit._acquired(self.name)
+        return got
+
+    def release(self) -> None:
+        self._lock.release()
+        self._audit._released(self.name)
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def locked(self) -> bool:
+        return self._lock.locked()
+
+    def __getattr__(self, name: str):
+        # passthrough for wrapped-lock extras (RLock internals etc.);
+        # only acquire/release order is audited
+        return getattr(self._lock, name)
+
+
+class LockAudit:
+    """Record real lock-acquisition order across threads.
+
+    Usage (chaos tests)::
+
+        audit = LockAudit()
+        audit.instrument(channel, "_lock")      # wrap an object's lock
+        gate = audit.wrap(threading.Lock(), "gate")   # or wrap directly
+        ... run the faulted scenario ...
+        assert audit.inversions() == []
+
+    Every acquisition while other audited locks are held by the SAME
+    thread records ordered edges ``held -> acquired``.  An *inversion*
+    is a pair observed in both orders — the textbook two-lock deadlock,
+    needing only two threads to hit the two sites concurrently.  The
+    recorder itself takes one private lock only to mutate the edge map
+    (never while a wrapped lock is being waited on), so it cannot
+    introduce the orderings it reports.
+    """
+
+    def __init__(self) -> None:
+        self._tls = threading.local()
+        self._mu = threading.Lock()
+        #: (outer, inner) -> occurrences observed
+        self._edges: dict[tuple[str, str], int] = {}
+        #: names ever acquired (for reporting)
+        self._seen: set[str] = set()
+
+    def wrap(self, lock: Any, name: str) -> _AuditedLock:
+        return _AuditedLock(self, lock, name)
+
+    def instrument(self, obj: Any, attr: str,
+                   name: Optional[str] = None) -> _AuditedLock:
+        """Replace ``obj.attr`` with an audited proxy in place."""
+        wrapped = self.wrap(getattr(obj, attr),
+                            name or f"{type(obj).__name__}.{attr}")
+        setattr(obj, attr, wrapped)
+        return wrapped
+
+    def _held(self) -> list:
+        held = getattr(self._tls, "held", None)
+        if held is None:
+            held = self._tls.held = []
+        return held
+
+    def _acquired(self, name: str) -> None:
+        held = self._held()
+        with self._mu:
+            self._seen.add(name)
+            for outer in held:
+                if outer != name:
+                    key = (outer, name)
+                    self._edges[key] = self._edges.get(key, 0) + 1
+        held.append(name)
+
+    def _released(self, name: str) -> None:
+        held = self._held()
+        # remove the most recent occurrence (locks release LIFO in with-
+        # blocks, but hand-rolled release orders must not corrupt state)
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] == name:
+                del held[i]
+                return
+
+    def edges(self) -> dict[tuple[str, str], int]:
+        with self._mu:
+            return dict(self._edges)
+
+    def inversions(self) -> list[tuple[str, str]]:
+        """(A, B) pairs acquired in BOTH orders, A < B; empty = clean."""
+        with self._mu:
+            out = sorted(
+                (a, b) for (a, b) in self._edges
+                if a < b and (b, a) in self._edges)
+        return out
+
+    def report(self) -> dict:
+        """JSON-ready summary (chaos harness artifacts)."""
+        inversions = self.inversions()  # takes _mu itself: compute FIRST
+        with self._mu:
+            return {
+                "locks": sorted(self._seen),
+                "edges": {f"{a} -> {b}": n
+                          for (a, b), n in sorted(self._edges.items())},
+                "inversions": [f"{a} <-> {b}" for a, b in inversions],
+            }
+
+
+def audit_many(audit: LockAudit,
+               targets: Iterable[tuple[Any, str]]) -> None:
+    """Instrument a batch of (obj, attr) lock sites in one call."""
+    for obj, attr in targets:
+        audit.instrument(obj, attr)

@@ -65,7 +65,8 @@ def _request(method: str, url: str, body: Optional[dict] = None) -> Any:
             msg = payload.get("error", str(e))
             reason = payload.get("reason", "")
             cursor = payload.get("cursor")
-        except Exception:  # noqa: BLE001
+        except Exception:  # noqa: BLE001 — unparseable error body:
+            # fall back to the raw HTTPError text
             msg = str(e)
         raise CliError(f"{method} {url}: {msg}", reason=reason,
                        cursor=cursor) from None

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import heapq
 import logging
+import queue
 import threading
 import time
 from dataclasses import dataclass, field
@@ -237,7 +238,7 @@ class Controller:
         while not self._stop.is_set():
             try:
                 ev = self._watch.q.get(timeout=0.2)
-            except Exception:  # queue.Empty
+            except queue.Empty:
                 continue
             if ev.obj.kind == self.kind:
                 self.queue.add(ev.obj.key)

@@ -26,6 +26,7 @@ kubelet's loop (detection-latency faults) and fire cluster-level faults
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from dataclasses import dataclass, field
@@ -33,6 +34,8 @@ from typing import Callable, Optional
 
 from .objects import KIND_POD, Pod, PodPhase
 from .store import NotFound, Store
+
+log = logging.getLogger("kubeflow_tpu.fake-kubelet")
 
 
 @dataclass
@@ -105,8 +108,8 @@ class FakeKubelet:
                     self._stop.wait(self.interval)
                     continue
                 self.step()
-            except Exception:  # noqa: BLE001
-                pass
+            except Exception:  # noqa: BLE001 — the kubelet loop must survive
+                log.debug("fake-kubelet step failed", exc_info=True)
             self._stop.wait(self.interval)
 
     def step(self) -> None:

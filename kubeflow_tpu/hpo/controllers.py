@@ -19,6 +19,7 @@ suggestion,trial}]:
 from __future__ import annotations
 
 import json
+import logging
 import os
 import re
 import time
@@ -54,6 +55,8 @@ from . import algorithms
 from .db import DbManagerClient
 from .early_stopping import Asha
 from .service import SuggestionClient, SuggestionServer
+
+log = logging.getLogger("kubeflow_tpu.hpo")
 
 _METRIC_LINE_RE = re.compile(r"^([A-Za-z0-9_.\-]+)=([-+0-9.eE]+)\s*$")
 
@@ -631,8 +634,10 @@ class TrialController(Controller):
                     namespace=namespace,
                     phase="EarlyStopped",
                 )
-            except Exception:  # noqa: BLE001
-                pass
+            except Exception:  # noqa: BLE001 — db unavailable: the stop
+                # decision stands, only the durable record is lost
+                log.debug("early-stop observation report for %s failed",
+                          name, exc_info=True)
         self.emit_event(
             trial, "TrialEarlyStopped",
             f"ASHA rung {rung} (step {step}): "

@@ -248,7 +248,7 @@ class _Handler(grpc.GenericRpcHandler):
             obs = self._db.observations(
                 request["experiment"], request.get("namespace", "default"))
             return {"observations": obs}
-        except Exception as e:  # noqa: BLE001
+        except Exception as e:  # noqa: BLE001 — surface as RPC error
             context.abort(grpc.StatusCode.INTERNAL, f"{type(e).__name__}: {e}")
 
     def _log(self, request: dict, context) -> dict:
@@ -259,7 +259,7 @@ class _Handler(grpc.GenericRpcHandler):
                     namespace=request.get("namespace", "default"),
                 )
             }
-        except Exception as e:  # noqa: BLE001
+        except Exception as e:  # noqa: BLE001 — surface as RPC error
             context.abort(grpc.StatusCode.INTERNAL, f"{type(e).__name__}: {e}")
 
 

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import logging
 import os
+import queue
 import shutil
 import signal
 import subprocess
@@ -106,7 +107,7 @@ class LocalKubelet:
         while True:
             try:
                 ev: WatchEvent = self._watch.q.get_nowait()
-            except Exception:  # queue.Empty
+            except queue.Empty:
                 return
             if ev.type == DELETED and ev.obj.kind == KIND_POD:
                 self._kill(ev.obj.key)

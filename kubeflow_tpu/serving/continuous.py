@@ -48,6 +48,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..analysis.runtime import RecompileCounter, recompile_guard
 from ..models import llama as llamalib
 from . import sharded as shardedlib
 from .model import Model
@@ -107,6 +108,7 @@ def _lcp(content: list[int], prompt_arr: np.ndarray, cap: int) -> int:
     n = min(len(content), cap)
     if n <= 0:
         return 0
+    # analysis: ok host-sync-in-dispatch — host token list, no device value
     c = np.asarray(content[:n], np.int64)
     neq = np.nonzero(c != prompt_arr[:n])[0]
     return int(neq[0]) if neq.size else n
@@ -696,6 +698,14 @@ class ContinuousEngine:
         from collections import deque
 
         self._prefilling: "deque[list]" = deque()
+        #: (group_size, bucket) admission shapes known compiled —
+        #: _pad_group pads bursts UP to one of these instead of
+        #: compiling a fresh power-of-two shape mid-serving (a pool
+        #: stall the jit_recompiles_total guard would count); padded
+        #: rows target the dropped slot, so the waste is bounded
+        #: prefill FLOPs, never correctness
+        self._warm_plain: set = set()
+        self._warm_seg: set = set()
         #: prompt tokens admitted-but-not-yet-prefilled, kept as a plain
         #: scheduler-maintained counter: stats() runs on HTTP threads and
         #: must not iterate a deque the scheduler mutates concurrently
@@ -718,7 +728,16 @@ class ContinuousEngine:
         self._stop = threading.Event()
         self._gate = threading.Lock()
         self._wake = threading.Event()
-        self._base_key = jax.random.PRNGKey(int.from_bytes(os.urandom(4), "little"))
+        #: per-step sampling keys are derived ON THE HOST as raw numpy
+        #: uint32[2] key data ([seed, step] — distinct per step, exactly
+        #: the structure PRNGKey builds).  The previous
+        #: jax.random.fold_in per dispatch (a) put a device computation
+        #: + implicit transfer on the hot path and (b) fed the decode
+        #: programs a DEVICE key where warmup fed numpy, whose differing
+        #: arg committedness re-traced decode+fused on the first live
+        #: dispatch — the exact stall class the recompile guard exists
+        #: to catch (it did, at 2 recompiles/engine).
+        self._base_seed = int.from_bytes(os.urandom(4), "little")
         # The scheduler thread starts LAZILY on first submit(), not here:
         # warmup() mutates and donates the pool buffers, and an already-
         # running scheduler could race it over the same donated buffers
@@ -730,6 +749,10 @@ class ContinuousEngine:
         """Start the scheduler thread once (idempotent, called by submit
         under the gate)."""
         if self._thread is None:
+            # traffic starts: from here every jit-cache growth past a
+            # program's first compile is a mid-serving stall — count it
+            # (warmup() also arms, for engines probed before traffic)
+            self._recompiles.armed = True
             self._thread = threading.Thread(
                 target=self._loop, name="continuous-engine", daemon=True)
             self._thread.start()
@@ -741,6 +764,14 @@ class ContinuousEngine:
         chunk = self.decode_chunk
         slots = self.num_slots
         mesh = self.mesh
+
+        #: dispatch-hygiene auditor (analysis/runtime.py): every cached
+        #: program is wrapped so jit-cache growth past its first compile
+        #: counts here — a recompile in steady-state decode stalls every
+        #: live request for a trace+compile, so the gauge must stay 0
+        #: (tier-1 asserts it; /metrics exports jit_recompiles_total)
+        self._recompiles = RecompileCounter()
+        guard = lambda p: recompile_guard(p, self._recompiles)  # noqa: E731
 
         #: decode-attention window buckets: each decode dispatch attends
         #: only over cache slots below the smallest bucket covering every
@@ -783,8 +814,8 @@ class ContinuousEngine:
         def prefill_for(bucket: int):
             attend = next(b for b in self.attend_buckets if b >= bucket)
             if attend not in self._prefill_programs:
-                self._prefill_programs[attend] = make_prefill_program(
-                    cfg, attend, mesh)
+                self._prefill_programs[attend] = guard(make_prefill_program(
+                    cfg, attend, mesh))
             return self._prefill_programs[attend]
 
         self._prefill_for = prefill_for
@@ -815,8 +846,8 @@ class ContinuousEngine:
                 (b for b in self.attend_buckets if b >= needed),
                 cfg.max_seq_len)
             if attend not in self._decode_programs:
-                self._decode_programs[attend] = make_decode_program(
-                    cfg, attend, chunk, mesh)
+                self._decode_programs[attend] = guard(make_decode_program(
+                    cfg, attend, chunk, mesh))
             return self._decode_programs[attend]
 
         self._decode_for = decode_for
@@ -831,8 +862,9 @@ class ContinuousEngine:
                     (b for b in self.attend_buckets if b >= needed),
                     cfg.max_seq_len)
                 if attend not in self._fused_programs:
-                    self._fused_programs[attend] = make_fused_step_program(
-                        cfg, attend, chunk, budget, self._batch_axes, mesh)
+                    self._fused_programs[attend] = guard(make_fused_step_program(
+                        cfg, attend, chunk, budget, self._batch_axes,
+                        mesh))
                 return self._fused_programs[attend]
 
             def chunk_prefill_for(needed: int):
@@ -840,7 +872,7 @@ class ContinuousEngine:
                     (b for b in self.attend_buckets if b >= needed),
                     cfg.max_seq_len)
                 if attend not in self._chunk_programs:
-                    self._chunk_programs[attend] = (
+                    self._chunk_programs[attend] = guard(
                         make_chunk_prefill_program(
                             cfg, attend, budget, self._batch_axes, mesh))
                 return self._chunk_programs[attend]
@@ -871,8 +903,8 @@ class ContinuousEngine:
             def seg_prefill_for(bucket: int):
                 a = next(x for x in self._seg_attends if x >= bucket)
                 if a not in self._seg_prefill_programs:
-                    self._seg_prefill_programs[a] = make_prefill_program(
-                        self._seg_cfg, a, mesh)
+                    self._seg_prefill_programs[a] = guard(make_prefill_program(
+                        self._seg_cfg, a, mesh))
                 return self._seg_prefill_programs[a]
 
             self._seg_prefill_for = seg_prefill_for
@@ -890,8 +922,8 @@ class ContinuousEngine:
                     jax.tree.map(leaf, seg_cache, row_cache, seg_axes),
                     mesh)
 
-            self._seg_merge = shardedlib.mesh_jit(
-                mesh, seg_merge, donate_argnums=(0,))
+            self._seg_merge = guard(shardedlib.mesh_jit(
+                mesh, seg_merge, donate_argnums=(0,)))
 
             self._suffix_admit_programs: dict[tuple, Any] = {}
 
@@ -902,7 +934,7 @@ class ContinuousEngine:
                 sa = next(x for x in self._seg_attends if x >= seg_att)
                 k = (a, sa, bucket)
                 if k not in self._suffix_admit_programs:
-                    self._suffix_admit_programs[k] = (
+                    self._suffix_admit_programs[k] = guard(
                         make_suffix_admit_program(cfg, a, sa, bucket, mesh))
                 return self._suffix_admit_programs[k]
 
@@ -917,7 +949,7 @@ class ContinuousEngine:
                 sa = next(x for x in self._seg_attends if x >= seg_att)
                 k = (a, sa)
                 if k not in self._prefix_decode_programs:
-                    self._prefix_decode_programs[k] = (
+                    self._prefix_decode_programs[k] = guard(
                         make_prefix_decode_program(cfg, a, sa, chunk, mesh))
                 return self._prefix_decode_programs[k]
 
@@ -931,9 +963,9 @@ class ContinuousEngine:
                 cfg.max_seq_len)
             key = (attend, suffix_bucket)
             if key not in self._prefix_programs:
-                self._prefix_programs[key] = make_prefix_admit_program(
+                self._prefix_programs[key] = guard(make_prefix_admit_program(
                     cfg, attend, suffix_bucket, self._batch_axes, mesh,
-                    seq_axes=self._seq_axes)
+                    seq_axes=self._seq_axes))
             return self._prefix_programs[key]
 
         self._prefix_admit_for = prefix_admit_for
@@ -949,7 +981,8 @@ class ContinuousEngine:
 
         # donate pool buffers: the pool cache must exist in HBM once, not
         # once per in-flight dispatch
-        self._merge = shardedlib.mesh_jit(mesh, merge, donate_argnums=(0, 1))
+        self._merge = guard(
+            shardedlib.mesh_jit(mesh, merge, donate_argnums=(0, 1)))
 
     def _init_pool(self) -> None:
         mesh = self.mesh
@@ -984,7 +1017,12 @@ class ContinuousEngine:
         state is untouched for real traffic.
 
         ``groups``: list of (group_size, seq_bucket); default = group
-        sizes 1 and num_slots at the smallest bucket.  ``attend_buckets``
+        sizes 1 and num_slots at the smallest bucket.  Admission groups
+        PAD UP to the nearest warmed group size (``_pad_group``) before
+        falling back to a fresh power-of-two compile, so the default
+        warm set already guarantees compile-free admission at the
+        smallest bucket — warm more rungs to trade the padded rows'
+        prefill FLOPs for load-time compiles.  ``attend_buckets``
         (optional): decode-window buckets to precompile; default = the
         windows the warmed prompt buckets will first decode in.
 
@@ -1002,6 +1040,9 @@ class ContinuousEngine:
                     "scheduler thread owns the donated pool buffers once "
                     "traffic starts")
             self._warmup_locked(groups)
+            # warmup's shape ladder is the paid-once warm set; growth
+            # past it is a mid-serving recompile — start counting
+            self._recompiles.armed = True
 
     def _warmup_locked(self, groups) -> None:
         if groups is None:
@@ -1024,6 +1065,7 @@ class ContinuousEngine:
                 self._pool_cache, self._pool_logits = self._merge(
                     self._pool_cache, self._pool_logits, row_cache,
                     row_logits, np.full(g, self.num_slots, np.int32))
+                self._warm_plain.add((g, bucket))
             warm_attends.add(bucket + self.decode_chunk)
         for needed in sorted(warm_attends):
             self._pool_cache, self._pool_logits, toks = self._decode_for(
@@ -1086,14 +1128,23 @@ class ContinuousEngine:
                         self.params, np.zeros((1, sa), np.int32),
                         np.ones(1, np.int32))[1],
                     np.full(1, self.prefix_segments, np.int32))
-                row_logits, row_cache = self._suffix_admit_for(sb, sa, sb)(
-                    self.params, self._seg_cache,
-                    np.zeros((1, sb), np.int32),
-                    np.zeros(1, np.int32), np.full(1, sa, np.int32),
-                    np.ones(1, np.int32))
-                self._pool_cache, self._pool_logits = self._merge(
-                    self._pool_cache, self._pool_logits, row_cache,
-                    row_logits, np.full(1, self.num_slots, np.int32))
+                # warm the suffix admit + merge at group sizes 1 AND
+                # num_slots: seg bursts pad to a warmed group shape
+                # (_pad_group), so both ends of the pad ladder must be
+                # compiled or a same-prefix burst freezes the pool on a
+                # mid-serving [g, sb] compile (the recompile guard
+                # counts exactly that)
+                for g in sorted({1, self.num_slots}):
+                    row_logits, row_cache = self._suffix_admit_for(
+                        sb, sa, sb)(
+                        self.params, self._seg_cache,
+                        np.zeros((g, sb), np.int32),
+                        np.zeros(g, np.int32), np.full(g, sa, np.int32),
+                        np.ones(g, np.int32))
+                    self._pool_cache, self._pool_logits = self._merge(
+                        self._pool_cache, self._pool_logits, row_cache,
+                        row_logits, np.full(g, self.num_slots, np.int32))
+                    self._warm_seg.add((g, sb))
                 self._pool_cache, self._pool_logits, toks = (
                     self._prefix_decode_for(sb + self.decode_chunk, sa)(
                         self.params, self._pool_cache, self._pool_logits,
@@ -1182,6 +1233,10 @@ class ContinuousEngine:
             "prefill_chunks_dispatched": self.prefill_chunks_dispatched,
             "prefill_tokens_inflight": self._prefill_tokens_inflight,
             "decode_stall_ms_total": round(self.decode_stall_ms_total, 3),
+            # dispatch hygiene (analysis/runtime.py recompile_guard):
+            # jit-cache growth past each program's first compile; MUST
+            # stay 0 in steady state — a recompile stalls the whole pool
+            "jit_recompiles_total": int(self._recompiles.count),
             "prefix_hits": self.prefix_hits,
             "prefix_tokens_saved": self.prefix_tokens_saved,
             "segments_capacity": self.prefix_segments,
@@ -1262,6 +1317,7 @@ class ContinuousEngine:
         # host-observed admission-dispatch time while decode work is live
         # (the decode_stall_ms_total gauge — see its __init__ note)
         stall_t0 = time.perf_counter()
+        # analysis: ok host-sync-in-dispatch — host numpy scheduler state
         had_live = bool(self._active.any())
         dispatched = False
         for req, slot in taken:
@@ -1306,10 +1362,7 @@ class ContinuousEngine:
         # for the segment path too); pad rows carry plen 0 / slot
         # num_slots, which the masks and the merge scatter drop
         for bucket, members in seg_groups.items():
-            g = 1
-            while g < len(members):
-                g *= 2
-            g = min(g, self.num_slots)
+            g = self._pad_group(len(members), bucket, self._warm_seg)
             try:
                 toks = np.zeros((g, bucket), np.int32)
                 seg_ids = np.zeros(g, np.int32)
@@ -1363,13 +1416,7 @@ class ContinuousEngine:
             bucket = next(b for b in self.seq_buckets if b >= len(prompt))
             groups.setdefault(bucket, []).append((req, prompt, slot))
         for bucket, members in groups.items():
-            # pad the group size up to a power of two (bounded compile
-            # count); pad rows target the out-of-range slot, which the
-            # merge scatter drops
-            g = 1
-            while g < len(members):
-                g *= 2
-            g = min(g, self.num_slots)
+            g = self._pad_group(len(members), bucket, self._warm_plain)
             try:
                 toks = np.zeros((g, bucket), np.int32)
                 lengths = np.ones(g, np.int32)
@@ -1393,6 +1440,27 @@ class ContinuousEngine:
         if had_live and dispatched:
             self.decode_stall_ms_total += (
                 time.perf_counter() - stall_t0) * 1e3
+
+    def _pad_group(self, need: int, bucket: int, warmed: set) -> int:
+        """Admission group size for ``need`` members at ``bucket``.
+
+        Prefer padding UP to a group shape already compiled (warmup's
+        defaults, or any shape a previous burst compiled): the padded
+        rows' prefill runs against the dropped slot, costing bounded
+        FLOPs, whereas a fresh power-of-two compile freezes the whole
+        pool for the trace+compile — the stall class the recompile
+        guard (jit_recompiles_total) exists to surface.  With nothing
+        warm at this bucket, fall back to the classic power-of-two pad
+        and record it (compiled once = warm from now on)."""
+        cands = [g for (g, b) in warmed if b == bucket and g >= need]
+        if cands:
+            return min(cands)
+        g = 1
+        while g < need:
+            g *= 2
+        g = min(g, self.num_slots)
+        warmed.add((g, bucket))
+        return g
 
     def _occupy(self, req: Request, prompt: list[int], slot: int, *,
                 plen: int = 0, seg: int = 0,
@@ -1471,6 +1539,7 @@ class ContinuousEngine:
         # those tokens may attend that much of the segment — one segment
         # serves every variation on a system prompt
         best, blen = -1, 0
+        # analysis: ok host-sync-in-dispatch — host token list, no device value
         p_arr = np.asarray(prompt, np.int64)
         for i, content in enumerate(self._seg_content):
             if min(len(content), cap) <= blen:
@@ -1518,6 +1587,7 @@ class ContinuousEngine:
         would cost the same order as the admission saving itself."""
         best_slot, best_lp = -1, 0
         cap = len(prompt) - 1
+        # analysis: ok host-sync-in-dispatch — host token list, no device value
         p = np.asarray(prompt, np.int64)
         for s, content in enumerate(self._slot_content):
             if min(len(content), cap) <= best_lp:
@@ -1653,6 +1723,7 @@ class ContinuousEngine:
                 self._wake.wait(timeout=0.05)
                 self._wake.clear()
                 continue
+            # analysis: ok host-sync-in-dispatch — host numpy scheduler state
             live = bool(self._active.any())
             if live:
                 # step_counter counts DECODE dispatches (the decode_steps
@@ -1660,7 +1731,9 @@ class ContinuousEngine:
                 # must not inflate it — and only decode-carrying
                 # dispatches consume a sampling key
                 self.step_counter += 1
-                key = jax.random.fold_in(self._base_key, self.step_counter)
+                key = np.array(
+                    [self._base_seed, self.step_counter & 0xFFFFFFFF],
+                    np.uint32)
             snapshot = [
                 (slot, self._slots[slot],
                  int(min(self.decode_chunk, self._remaining[slot])))
@@ -1670,6 +1743,7 @@ class ContinuousEngine:
             # window = smallest attend bucket covering every live position
             # plus this chunk — early turns read KV proportional to the
             # conversation front, not max_seq_len
+            # analysis: ok host-sync-in-dispatch — host numpy scheduler state
             needed = ((int(self._positions[self._active].max())
                        + self.decode_chunk) if live else self.decode_chunk)
             # pass NUMPY COPIES that are never mutated again: the CPU
@@ -1680,8 +1754,10 @@ class ContinuousEngine:
             # positions (writes land one slot off, intermittently, under
             # dispatch-ahead pipelining; reproduced 3/10 before this fix)
             live_seg = (live and self.prefix_segments > 0
+                        # analysis: ok host-sync-in-dispatch — host numpy
                         and bool((self._slot_plen[self._active] > 0).any()))
             if live_seg:
+                # analysis: ok host-sync-in-dispatch — host numpy scheduler state
                 seg_att = int(self._slot_plen[self._active].max())
                 plens = np.where(
                     self._active, self._slot_plen, 0).astype(np.int32)
@@ -1777,6 +1853,9 @@ class ContinuousEngine:
 
     def _process(self, toks_dev, snapshot) -> None:
         """Fetch one chunk's tokens (blocks) and deliver them."""
+        # THE declared fetch boundary: sampled tokens leave the device
+        # here, depth-gated by the dispatch-ahead pipeline
+        # analysis: ok host-sync-in-dispatch — the one intended fetch
         toks = np.asarray(jax.device_get(toks_dev))  # [slots, chunk]
         now = time.perf_counter()
         for slot, req, take in snapshot:
@@ -1785,6 +1864,7 @@ class ContinuousEngine:
                 # tokens were decoded for nobody — count the waste
                 self.tokens_discarded += take
                 continue
+            # analysis: ok host-sync-in-dispatch — numpy after the fetch
             emitted = toks[slot, :take].tolist()
             if self._slot_owner[slot] is req:
                 # extend the slot's KV-content record (prefix matcher

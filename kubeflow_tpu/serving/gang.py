@@ -263,6 +263,8 @@ class GangChannel:
                     # no longer be resynced at the channel layer — tell it
                     # to die so the JaxJob gang restart takes over
                     try:
+                        # send bounded by dead_peer_timeout by design
+                        # analysis: ok lock-order — bounded send
                         c.sendall(self._frame(
                             (self._GONE, "replay log exhausted")))
                     except OSError:
@@ -274,6 +276,7 @@ class GangChannel:
                     return
                 for s, fb in list(self._log):
                     if s > last_seq:
+                        # analysis: ok lock-order — bounded by dead_peer_timeout
                         c.sendall(fb)  # OSError -> caller drops the conn
             old = self._followers.pop(rank, None)
             self._followers[rank] = c
@@ -339,6 +342,7 @@ class GangChannel:
                         self._evict_locked(rank)
                         continue
                     try:
+                        # analysis: ok lock-order — bounded by dead_peer_timeout
                         c.sendall(frame)
                     except OSError:
                         self._evict_locked(rank)
@@ -432,6 +436,9 @@ class GangChannel:
             self._log.append((self._seq, frame))
             for rank, c in list(self._followers.items()):
                 try:
+                    # bounded sends: the conn carries dead_peer_timeout,
+                    # a wedged follower stalls publish at most that long
+                    # analysis: ok lock-order — bounded send, then evict
                     c.sendall(frame)
                 except OSError:
                     self._evict_locked(rank)

@@ -207,7 +207,9 @@ class InferenceLogger:
                 })
                 with _rq.urlopen(req, timeout=2.0):
                     pass
-            except Exception:  # noqa: BLE001
+            except Exception as e:  # noqa: BLE001 — delivery is best-effort
+                log.debug("inference log delivery to %s failed: %s",
+                          self.url, e)
                 self.dropped += 1
 
     def stop(self) -> None:
@@ -493,7 +495,8 @@ class ModelServer:
             except BrokenPipeError:
                 # client hung up mid-stream: not a server error
                 self.metrics.observe(name, time.perf_counter() - t0, error=False)
-            except Exception as e:  # noqa: BLE001
+            except Exception as e:  # noqa: BLE001 — surfaced as 500/SSE error
+                log.debug("generate %s failed: %s", name, e)
                 self.metrics.observe(name, time.perf_counter() - t0, error=True)
                 if streaming:
                     # headers are on the wire: a second status line would
@@ -576,7 +579,8 @@ class ModelServer:
         except KeyError as e:
             self.metrics.observe(name, time.perf_counter() - t0, error=True)
             h._send(404 if str(e).strip("'") == name else 400, {"error": str(e)})
-        except Exception as e:  # noqa: BLE001
+        except Exception as e:  # noqa: BLE001 — surfaced to the client as 500
+            log.debug("predict %s failed: %s", name, e)
             self.metrics.observe(name, time.perf_counter() - t0, error=True)
             h._send(500, {"error": f"{type(e).__name__}: {e}"})
 
@@ -601,7 +605,8 @@ class ModelServer:
         except KeyError as e:
             self.metrics.observe(name, time.perf_counter() - t0, error=True)
             h._send(404 if str(e).strip("'") == name else 400, {"error": str(e)})
-        except Exception as e:  # noqa: BLE001
+        except Exception as e:  # noqa: BLE001 — surfaced to the client as 500
+            log.debug("explain %s failed: %s", name, e)
             self.metrics.observe(name, time.perf_counter() - t0, error=True)
             h._send(500, {"error": f"{type(e).__name__}: {e}"})
 
@@ -646,6 +651,7 @@ class ModelServer:
         except KeyError as e:
             self.metrics.observe(name, time.perf_counter() - t0, error=True)
             h._send(404 if str(e).strip("'") == name else 400, {"error": str(e)})
-        except Exception as e:  # noqa: BLE001
+        except Exception as e:  # noqa: BLE001 — surfaced to the client as 500
+            log.debug("predict(v2) %s failed: %s", name, e)
             self.metrics.observe(name, time.perf_counter() - t0, error=True)
             h._send(500, {"error": f"{type(e).__name__}: {e}"})

@@ -190,6 +190,9 @@ def mesh_jit(mesh: Optional[Mesh], fn, **jit_kwargs):
 
     # expose AOT lowering for the serving AOT artifact path
     call.lower = lambda *a, **k: _lowered(mesh, jitted, *a, **k)
+    # expose the inner jitted fn so the analysis recompile guard can
+    # read its trace-cache size (analysis/runtime.py RecompileGuard)
+    call._jitted = jitted
     return call
 
 

@@ -126,7 +126,7 @@ class Dashboard:
                                    "application/json")
                     else:
                         self._send(404, b"not found", "text/plain")
-                except Exception as e:  # noqa: BLE001
+                except Exception as e:  # noqa: BLE001 — surfaced as 500
                     self._send(500, json.dumps({"error": str(e)}).encode(),
                                "application/json")
 

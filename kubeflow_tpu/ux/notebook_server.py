@@ -75,7 +75,7 @@ def main(ctx) -> None:
                         result = None
                 self._send(200, {"result": repr(result) if result is not None else None,
                                  "stdout": out.getvalue()})
-            except Exception as e:  # noqa: BLE001
+            except Exception as e:  # noqa: BLE001 — surfaced as 400
                 self._send(400, {"error": f"{type(e).__name__}: {e}"})
 
     httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)

@@ -1,0 +1,29 @@
+#!/usr/bin/env python
+"""Platform lint entry point — the findings ratchet, as a script.
+
+Thin wrapper over ``python -m kubeflow_tpu.analysis`` (one code path;
+this file exists so CI configs and operators have a stable script name
+next to the other scripts/):
+
+    python scripts/platform_lint.py                  # ratchet check
+    python scripts/platform_lint.py --update-baseline
+    python scripts/platform_lint.py --json
+    python scripts/platform_lint.py --all            # list frozen debt too
+
+Exit 0: no findings above kubeflow_tpu/analysis/baseline.json.
+Exit 1: NEW findings — fix, pragma (``# analysis: ok <rule> — why``),
+or re-freeze reviewed debt with --update-baseline.
+
+The same check runs as tier-1 (tests/test_analysis.py::TestRatchet), so
+every PR inherits it; this script is the fast pre-commit form.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from kubeflow_tpu.analysis.__main__ import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

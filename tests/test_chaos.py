@@ -652,3 +652,55 @@ class TestStopScannerIncremental:
 
         tg = TextGenerator.__new__(TextGenerator)
         assert tg._wait_with_stops(_Req(), []) == [1, 2, 3]
+
+
+class TestLockAuditUnderChaos:
+    """analysis/runtime.py LockAudit as the chaos harness's lock-order
+    recorder: the static lock-order rule sees lexical nesting; this
+    sees the acquisition orders a FAULTED schedule actually produced —
+    reconnect storms drive the leader through admit/evict/replay paths
+    a clean run never takes."""
+
+    CHAN = dict(hb_interval=0.05, dead_peer_timeout=0.5,
+                reattach_timeout=5.0, reconnect_timeout=5.0)
+
+    def test_no_inversions_across_reconnect_storm(self):
+        from kubeflow_tpu.analysis.runtime import LockAudit
+
+        port = allocate_port()
+        plan = FaultPlan(seed=3).socket_drop(role="follower",
+                                             after_calls=25)
+        audit = LockAudit()
+        audit.instrument(plan, "_lock", "FaultPlan._lock")
+        out = {}
+
+        def follower():
+            try:
+                ch = GangChannel.connect(
+                    "127.0.0.1", port, rank=1, token="t",
+                    sock_wrap=plan.socket_wrapper("follower"),
+                    **self.CHAN)
+                while True:
+                    if ch.next() == ("stop",):
+                        break
+                ch.close()
+            except Exception as e:  # noqa: BLE001
+                out["error"] = e
+
+        t = threading.Thread(target=follower, daemon=True)
+        t.start()
+        leader = GangChannel.listen(port, 1, token="t", **self.CHAN)
+        # audit the leader's channel lock through the faulted run: the
+        # hb loop, publish fan-out, evict, and re-admit replay all take
+        # it from different threads while the drop forces reconnects
+        audit.instrument(leader, "_lock", "GangChannel._lock")
+        for i in range(40):
+            leader.publish(("n", i))
+            time.sleep(0.005)
+        leader.publish(("stop",))
+        t.join(timeout=20)
+        leader.close()
+        assert "error" not in out, out.get("error")
+        rep = audit.report()
+        assert "GangChannel._lock" in rep["locks"]
+        assert audit.inversions() == [], rep

@@ -625,3 +625,49 @@ class TestSamplingFilters:
                     == greedy["choices"][0]["text"])
         finally:
             m.stop()
+
+
+class TestDispatchHygiene:
+    """jit_recompiles_total (analysis/runtime.py recompile_guard): the
+    engine must reach steady state — chunked prefill riding decode
+    dispatches, admissions, retirement, slot reuse — without ever
+    re-tracing a compiled program.  A recompile mid-serving freezes the
+    whole pool for a trace+compile; the guard wraps every cached program
+    and this assertion is the platform's proof the dispatch path stays
+    shape-stable (ISSUE 3 acceptance)."""
+
+    def test_zero_steady_state_recompiles_chunked(self, tiny_llama):
+        eng = make_engine(tiny_llama, decode_chunk=2, prefill_budget=4)
+        try:
+            eng.warmup()
+            # wave 1: concurrent chunked admissions fused into decode
+            reqs = [eng.submit([1, 2, 3, 4, 5, 6, 7], max_new_tokens=6)
+                    for _ in range(3)]
+            for r in reqs:
+                r.wait(300)
+            # wave 2: slot reuse + prefix-cache route after retirement
+            reqs = [eng.submit([1, 2, 3, 4, 5, 6, 7, 8, 9], max_new_tokens=4)
+                    for _ in range(2)]
+            for r in reqs:
+                r.wait(300)
+            stats = eng.stats()
+            assert stats["prefill_chunks_dispatched"] > 0  # chunked ran
+            assert stats["jit_recompiles_total"] == 0, stats
+        finally:
+            eng.stop()
+
+    def test_zero_recompiles_legacy_burst_padding(self, tiny_llama):
+        """A 3-request burst into a {1, num_slots}-warmed legacy pool
+        must pad up to the warmed group shape (_pad_group), not compile
+        a fresh [2, bucket] prefill mid-serving — the exact stall the
+        recompile guard caught when the gauge landed."""
+        eng = make_engine(tiny_llama, decode_chunk=2, prefill_budget=0)
+        try:
+            eng.warmup()
+            reqs = [eng.submit([i + 1, i + 2, i + 3], max_new_tokens=5)
+                    for i in range(3)]
+            for r in reqs:
+                r.wait(300)
+            assert eng.stats()["jit_recompiles_total"] == 0
+        finally:
+            eng.stop()
