@@ -422,23 +422,27 @@ class InferenceServiceController(Controller):
         d.pop("canary_traffic_percent", None)
         return json.dumps(d, sort_keys=True)
 
+    #: engine knobs validated at conf-freeze (value below floor -> Failed)
+    _ENGINE_KNOBS = ("num_slots", "decode_chunk", "pipeline_depth",
+                     "prefill_budget", "spec_k", "spec_ngram")
+
     def _new_revision(self, isvc, dep: _Deployment, fingerprint: str) -> _Revision:
         runtime_cls, cfg = self._resolve(isvc)
-        if isvc.spec.predictor.gang is not None:
+        if (isvc.spec.predictor.gang is not None
+                or any(k in cfg for k in self._ENGINE_KNOBS)):
             # validate the engine knobs HERE, inside the reconcile's
             # Failed-phase guard, where the revision config freezes: a
-            # bad value (prefill_budget: -1, decode_chunk: "x", ...)
-            # otherwise surfaces as N pods crash-looping through JaxJob
-            # restarts; this way it is ONE Failed status with the message
+            # bad value (prefill_budget: -1, spec_k: -2, ...) otherwise
+            # surfaces as N pods crash-looping through JaxJob restarts
+            # (gang) or an in-process replica stuck Loading forever;
+            # this way it is ONE Failed status with the message
             from .continuous import engine_kwargs
 
             bad = {k: v for k, v in engine_kwargs(cfg).items()
-                   if k in ("num_slots", "decode_chunk", "pipeline_depth",
-                            "prefill_budget")
-                   and v < (0 if k == "prefill_budget" else 1)}
+                   if k in self._ENGINE_KNOBS
+                   and v < (0 if k in ("prefill_budget", "spec_k") else 1)}
             if bad:
-                raise ValueError(
-                    f"invalid engine knobs for gang predictor: {bad}")
+                raise ValueError(f"invalid engine knobs: {bad}")
         dep.rev_counter += 1
         return _Revision(
             dep.rev_counter, fingerprint, isvc.spec.model_copy(deep=True),

@@ -691,6 +691,79 @@ class GangEngine(contlib.ContinuousEngine):
             self._chunk_prefill_for = chunk_prefill_for
             self._fused_for = fused_for
 
+        if self.spec_k > 0:
+            # speculative decoding (ISSUE 4): the verify dispatch joins
+            # the control stream carrying the proposals (drafts) and the
+            # residual bans — acceptance is computed ON DEVICE by the
+            # same deterministic program, so replaying the identical
+            # inputs leaves follower pool state bit-identical without
+            # accept lengths ever crossing the wire
+            verify_inner = self._verify_for
+
+            def verify_for(needed: int):
+                prog = verify_inner(needed)
+
+                def call(params, cache, logits, drafts, banned, positions,
+                         active, temps, top_ps, top_ks, key):
+                    try:
+                        drafts = np.asarray(drafts)
+                        banned = np.asarray(banned)
+                        positions = np.asarray(positions)
+                        active = np.asarray(active)
+                        temps = np.asarray(temps)
+                        top_ps = np.asarray(top_ps)
+                        top_ks = np.asarray(top_ks)
+                        key = np.asarray(key)
+                        ch.publish(("verify", int(needed), drafts, banned,
+                                    positions, active, temps, top_ps,
+                                    top_ks, key))
+                        return prog(params, cache, logits, drafts, banned,
+                                    positions, active, temps, top_ps,
+                                    top_ks, key)
+                    except Exception as e:  # noqa: BLE001 — see _fatal
+                        raise self._fatal(e)
+
+                return call
+
+            self._verify_for = verify_for
+
+            if self.prefill_budget > 0:
+                fverify_inner = self._fused_verify_for
+
+                def fused_verify_for(needed: int):
+                    prog = fverify_inner(needed)
+
+                    def call(params, cache, logits, slot, toks, start,
+                             length, write_slot, drafts, banned, positions,
+                             active, temps, top_ps, top_ks, key):
+                        try:
+                            toks = np.asarray(toks)
+                            drafts = np.asarray(drafts)
+                            banned = np.asarray(banned)
+                            positions = np.asarray(positions)
+                            active = np.asarray(active)
+                            temps = np.asarray(temps)
+                            top_ps = np.asarray(top_ps)
+                            top_ks = np.asarray(top_ks)
+                            key = np.asarray(key)
+                            ch.publish(("fused_verify", int(needed),
+                                        int(slot), toks, int(start),
+                                        int(length), int(write_slot),
+                                        drafts, banned, positions, active,
+                                        temps, top_ps, top_ks, key))
+                            return prog(params, cache, logits,
+                                        np.int32(slot), toks,
+                                        np.int32(start), np.int32(length),
+                                        np.int32(write_slot), drafts,
+                                        banned, positions, active, temps,
+                                        top_ps, top_ks, key)
+                        except Exception as e:  # noqa: BLE001
+                            raise self._fatal(e)
+
+                    return call
+
+                self._fused_verify_for = fused_verify_for
+
         if self.prefix_segments > 0:
             # shared-prefix segment ops join the control stream: segment
             # creation (prefill + merge into the segment pool), batched
@@ -835,6 +908,24 @@ def follow(engine: contlib.ContinuousEngine, channel: GangChannel) -> None:
                     np.int32(slot), toks, np.int32(start),
                     np.int32(length), np.int32(write_slot), positions,
                     active, temps, top_ps, top_ks, key))
+        elif op == "verify":
+            (_, needed, drafts, banned, positions, active, temps, top_ps,
+             top_ks, key) = msg
+            engine._pool_cache, engine._pool_logits, _toks, _acc = (
+                engine._verify_for(needed)(
+                    params, engine._pool_cache, engine._pool_logits,
+                    drafts, banned, positions, active, temps, top_ps,
+                    top_ks, key))
+        elif op == "fused_verify":
+            (_, needed, slot, toks, start, length, write_slot, drafts,
+             banned, positions, active, temps, top_ps, top_ks, key) = msg
+            engine._pool_cache, engine._pool_logits, _toks, _acc = (
+                engine._fused_verify_for(needed)(
+                    params, engine._pool_cache, engine._pool_logits,
+                    np.int32(slot), toks, np.int32(start),
+                    np.int32(length), np.int32(write_slot), drafts,
+                    banned, positions, active, temps, top_ps, top_ks,
+                    key))
         elif op == "prefix":
             _, total, sb, src, dst, lp, suffix, slen = msg
             engine._pool_cache, engine._pool_logits = (

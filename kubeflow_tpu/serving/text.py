@@ -144,12 +144,70 @@ class _StopScanner:
             self.text = self._tok.decode(ids)
         start = max(0, self._scanned - self._overlap)
         cut = None
+        end = None
         for ss in self._stops:
             i = self.text.find(ss, start)
             if i >= 0 and (cut is None or i < cut):
-                cut = i
+                cut, end = i, i + len(ss)
         self._scanned = len(self.text)
+        #: char index just past the matched stop (valid when a scan
+        #: returned a hit) — the exact-token retirement point for
+        #: multi-token bursts (speculative decoding delivers up to
+        #: spec_k+1 tokens per dispatch, so a stop routinely COMPLETES
+        #: mid-burst and the tail tokens after it must be dropped)
+        self.last_hit_end = end
         return cut
+
+
+def _ids_covering(tokenizer, ids, end_char: int) -> list:
+    """Smallest prefix of ``ids`` whose decoded text reaches
+    ``end_char`` — the EXACT token at which a stop sequence completed.
+
+    With multi-token bursts (speculative decoding retires up to
+    spec_k+1 tokens from one dispatch) a stop routinely completes in
+    the middle of a burst; the tokens after it were decoded but never
+    belonged to the completion, so token accounting (OpenAI ``usage``)
+    and downstream id consumers must not see them.  Uses the
+    tokenizer's incremental decoder when it has one (O(len) once per
+    hit); falls back to prefix re-decodes otherwise (HF path — one-off
+    at the hit, not per poll)."""
+    mk = getattr(tokenizer, "incremental_decoder", None)
+    if callable(mk):
+        dec = mk()
+        total = 0
+        for i, t in enumerate(ids):
+            total += len(dec.decode([t]))
+            if total >= end_char:
+                return list(ids[: i + 1])
+        return list(ids)
+    # HF prefix decodes are NOT prefix-stable: a trailing incomplete
+    # multi-byte char decodes to U+FFFD, and cleanup passes (HF's
+    # clean_up_tokenization_spaces collapses " ," -> ",") shift char
+    # counts — a length-only test can cut a token EARLY and drop the
+    # stop's tail.  A prefix covers only when its decode actually
+    # begins with the scanner's text up to end_char (the scanner's
+    # offsets live in the FULL decode's coordinates); when no prefix
+    # ever agrees, fall through to all ids — the safe pre-burst answer.
+    full = tokenizer.decode(list(ids))
+    lo, hi = 0, len(ids)
+    while lo < hi:  # first index whose prefix length reaches end_char
+        mid = (lo + hi) // 2
+        if len(tokenizer.decode(list(ids[: mid + 1]))) < end_char:
+            lo = mid + 1
+        else:
+            hi = mid
+    # cleanup can move the boundary by a joiner or two around the
+    # binary-searched index, so scan a CONSTANT window around it (the
+    # stop's covering token sits within a few tokens of the length
+    # boundary; an extended disagreement falls through to all ids, the
+    # safe over-count) — O(log n) decodes + a constant tail instead of
+    # re-decoding every prefix from 0 on the API worker thread
+    for i in range(max(0, lo - 4), min(len(ids), lo + 16)):
+        txt = tokenizer.decode(list(ids[: i + 1]))
+        if (not txt.endswith("�") and len(txt) >= end_char
+                and txt.startswith(full[:end_char])):
+            return list(ids[: i + 1])
+    return list(ids)
 
 
 def resolve_tokenizer(config: dict):
@@ -474,8 +532,13 @@ class TextGenerator(Model):
             done = r.done.is_set()
             ids = list(r.tokens)
             if scanner.scan(ids) is not None:
+                # retire at the EXACT token where the stop completed: a
+                # burst of accepted speculative tokens may carry the
+                # stop mid-burst, and the tokens after it are not part
+                # of this completion
                 r.cancel()
-                return ids
+                return _ids_covering(self.tokenizer, ids,
+                                     scanner.last_hit_end)
             if done:
                 if r.error is not None:
                     raise r.error

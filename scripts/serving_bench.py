@@ -369,6 +369,138 @@ def bench_chunked_prefill_stall(prompt_len: int = 896,
     }
 
 
+def _spec_stand_in(vocab_size: int = 8192) -> "llamalib.LlamaConfig":
+    """~34M-param stand-in for the speculative rows: big enough that a
+    (k+1)-wide verify forward costs real compute relative to dispatch
+    overhead, small enough that 256-token greedy completions finish in
+    seconds on the CPU backend.  Measured on this box: a spec_k=8
+    verify dispatch costs 1.11x a single-token decode dispatch — the
+    forward is weight-stream/overhead bound, the same width-independent
+    cost structure as the TPU's HBM byte bill."""
+    return llamalib.LlamaConfig(
+        vocab_size=vocab_size, hidden_size=512, intermediate_size=1408,
+        num_layers=8, num_heads=8, num_kv_heads=8, head_dim=64,
+        max_seq_len=1024, remat=False, scan_layers=True,
+        dtype=jnp.float32)
+
+
+def _spec_repetitive_params(model, seed: int = 6):
+    """Stand-in weights for the REPETITIVE row: random init with the
+    attention/MLP block-output projections (wo, w_down) zeroed, so the
+    residual stream is exactly the token embedding and greedy decode is
+    a position-free token-level Markov map.  A random map on 512 states
+    falls into a short cycle fast (seed 6: every orbit reaches a
+    period-10 or period-17 cycle within ~30 tokens) — the token-stream
+    shape of highly templated/repetitive output, constructed explicitly
+    rather than smuggled in via a lucky weight seed.  The forward pass
+    keeps the FULL stand-in cost: every GEMM still executes (the zeros
+    are dense f32 buffers XLA cannot see through), so the off/on ratio
+    measures engine dispatch economics, not a smaller model."""
+    params = model.init(
+        jax.random.PRNGKey(seed), jnp.ones((1, 8), jnp.int32))["params"]
+
+    def f(path, leaf):
+        ks = jax.tree_util.keystr(path)
+        return leaf * 0.0 if ("'wo'" in ks or "'w_down'" in ks) else leaf
+
+    return jax.tree_util.tree_map_with_path(f, params)
+
+
+def bench_speculative(spec_k: int = 6, spec_ngram: int = 3,
+                      num_slots: int = 4, n_requests: int = 8,
+                      new_tokens: int = 256) -> dict:
+    """ISSUE 4's headline row: decode tok/s with speculation on vs off.
+
+    REPETITIVE row: long greedy completions whose continuations repeat
+    — the regime n-gram / prompt-lookup drafts exist for (code,
+    templated output, quoting context back).  The stand-in makes that
+    regime explicit (`_spec_repetitive_params`: greedy decode is a
+    Markov map that falls into short cycles), so the proposer's drafts
+    verify against genuinely accepted runs through the full engine.
+    Requests outnumber slots (backlog) as in real serving — a slot that
+    retires its request early admits the next one instead of idling on
+    the pool's slowest stream.  ADVERSARIAL row: short completions on a
+    full-vocab random-weight stand-in whose trajectories never revisit
+    an n-gram — the proposer's guesses all reject, and the engine must
+    ride its zero-accept backoff + plain-decode fallback at (near) full
+    speed.
+
+    Honest scope notes: the RATIO is the claim, absolute ms are the CPU
+    backend (on TPU the verify's win is the amortized weight+KV HBM
+    stream; here it is the amortized dispatch + a verify forward that
+    measures 1.11x a decode dispatch at spec_k=8).  Acceptance is
+    workload-dependent — the repetitive row is the favorable regime
+    (acceptance ~1 by construction), the adversarial row the
+    unfavorable one; real traffic sits between, and the acceptance rate
+    is reported so the regime is visible, not assumed.
+    """
+    from kubeflow_tpu.serving.continuous import ContinuousEngine
+
+    rep_cfg = _spec_stand_in(vocab_size=512)
+    rep_params = _spec_repetitive_params(llamalib.Llama(rep_cfg))
+    adv_cfg = _spec_stand_in()
+    adv_params = llamalib.Llama(adv_cfg).init(
+        jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32))["params"]
+    rng = np.random.default_rng(7)
+    rep_prompts = [rng.integers(1, rep_cfg.vocab_size, size=6).tolist()
+                   for _ in range(n_requests)]
+    adv_prompts = [rng.integers(1, adv_cfg.vocab_size, size=64).tolist()
+                   for _ in range(n_requests)]
+
+    def run(cfg, params, k: int, prompts, toks_per: int):
+        eng = ContinuousEngine(
+            cfg, params, num_slots=num_slots, decode_chunk=1,
+            prefix_cache=False, spec_k=k, spec_ngram=spec_ngram)
+        try:
+            # warm every attend rung the timed run will CLIMB: positions
+            # reach prompt + toks_per (+ the verify span), and a group
+            # entry at seq bucket A//2 puts attend bucket A in the warm
+            # set — otherwise both rows pay compile stalls inside the
+            # timed window, and the spec-on row pays ~2x as many (verify
+            # rungs on top of decode), skewing the reported ratio
+            final = max(map(len, prompts)) + toks_per + k + 1
+            groups = [(1, 64), (num_slots, 64)]
+            groups += [(num_slots, a // 2) for a in eng.attend_buckets
+                       if 64 < a // 2 <= final]
+            eng.warmup(groups)
+            # prime: first execution pays device-side setup, and the
+            # speculative engine's verify program joins steady state
+            eng.submit(prompts[0], max_new_tokens=8).wait(600)
+            t0 = time.perf_counter()
+            reqs = [eng.submit(p, max_new_tokens=toks_per)
+                    for p in prompts]
+            outs = [r.wait(1200) for r in reqs]
+            dt = time.perf_counter() - t0
+            assert all(len(o) == toks_per for o in outs)
+            return len(prompts) * toks_per / dt, eng.stats()
+        finally:
+            eng.stop()
+
+    rep_off, _ = run(rep_cfg, rep_params, 0, rep_prompts, new_tokens)
+    rep_on, rep_stats = run(rep_cfg, rep_params, spec_k, rep_prompts,
+                            new_tokens)
+    adv_off, _ = run(adv_cfg, adv_params, 0, adv_prompts, 32)
+    adv_on, adv_stats = run(adv_cfg, adv_params, spec_k, adv_prompts, 32)
+    return {
+        "metric": "speculative_decode_tokens_per_sec",
+        "model": f"{llamalib.num_params(adv_cfg) / 1e6:.0f}M",
+        "spec_k": spec_k, "spec_ngram": spec_ngram,
+        "decode_chunk": 1, "slots": num_slots, "requests": n_requests,
+        "repetitive_new_tokens": new_tokens,
+        "repetitive_off_tok_s": round(rep_off, 1),
+        "repetitive_on_tok_s": round(rep_on, 1),
+        "repetitive_speedup": round(rep_on / rep_off, 2),
+        "repetitive_acceptance_rate": rep_stats["spec_acceptance_rate"],
+        "repetitive_verify_dispatches": rep_stats["spec_dispatches_total"],
+        "adversarial_off_tok_s": round(adv_off, 1),
+        "adversarial_on_tok_s": round(adv_on, 1),
+        "adversarial_ratio": round(adv_on / adv_off, 3),
+        "adversarial_acceptance_rate": adv_stats["spec_acceptance_rate"],
+        "adversarial_verify_dispatches":
+            adv_stats["spec_dispatches_total"],
+    }
+
+
 def bench_tiered_window(new_tokens: int = 16) -> dict:
     """r3 weak #4: one LONG conversation must not tax short requests'
     decode window.  A long request (prompt 1024) decodes continuously
@@ -439,6 +571,7 @@ def main() -> None:
           flush=True)
     print(json.dumps(bench_shared_prefix()), flush=True)
     print(json.dumps(bench_chunked_prefill_stall()), flush=True)
+    print(json.dumps(bench_speculative()), flush=True)
     print(json.dumps(bench_tiered_window()), flush=True)
     print(json.dumps(bench_bert(batch=8, seq=128)), flush=True)
 

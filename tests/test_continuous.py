@@ -391,6 +391,15 @@ class TestCancellationAndStats:
             assert 'kft_engine_prefill_tokens_inflight{model="statgen"} 0' \
                 in text
             assert "kft_engine_decode_stall_ms_total" in text
+            # speculative-decoding observability (ISSUE 4) rides the
+            # same stats -> gauge export (spec off here: counters 0)
+            assert 'kft_engine_spec_tokens_proposed_total{model="statgen"}' \
+                " 0" in text
+            assert 'kft_engine_spec_tokens_accepted_total{model="statgen"}' \
+                " 0" in text
+            assert 'kft_engine_spec_dispatches_total{model="statgen"} 0' \
+                in text
+            assert "# TYPE kft_engine_spec_acceptance_rate gauge" in text
         finally:
             srv.stop()
 

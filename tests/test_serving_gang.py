@@ -356,6 +356,79 @@ class TestGangChunkedPrefill:
             assert np.array_equal(np.asarray(a), np.asarray(b))
 
 
+class TestGangSpeculative:
+    """ISSUE 4: the speculative schedule (``verify`` ops carrying
+    drafts + residual bans) crosses the control stream and a follower
+    replays it BIT-IDENTICALLY — acceptance is recomputed on-device by
+    the same deterministic program, so leader and follower pool state
+    match without accept lengths ever crossing the wire.  Single
+    process, loopback channel, like TestGangChunkedPrefill."""
+
+    @pytest.mark.slow
+    def test_follower_replays_verify_stream_bit_identically(self):
+        import threading
+
+        import numpy as np
+        from flax import linen as nn
+
+        from kubeflow_tpu.serving.gang import GangChannel, GangEngine, follow
+        from kubeflow_tpu.utils.net import allocate_port
+
+        cfg = llamalib.tiny(num_heads=8, num_kv_heads=8)
+        params = nn.meta.unbox(llamalib.Llama(cfg).init(
+            jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32))["params"])
+        kw = dict(num_slots=3, decode_chunk=1, temperature=0.0,
+                  eos_id=None, seq_buckets=[32], prefix_cache=False,
+                  spec_k=4, mesh_axes={"model": 8})
+        prompt = np.random.default_rng(7).integers(1, 200, size=5).tolist()
+
+        ref = ContinuousEngine(cfg, params, **kw)
+        try:
+            want = ref.generate(prompt, max_new_tokens=40, timeout=300)
+            assert ref.spec_dispatches_total > 0  # the run speculated
+        finally:
+            ref.stop()
+
+        port = allocate_port()
+        follower_engine = ContinuousEngine(cfg, params, **kw)
+        ops: list[str] = []
+
+        def run_follower():
+            ch = GangChannel.connect("127.0.0.1", port, rank=1, token="t")
+            orig_next = ch.next
+
+            def tap():
+                m = orig_next()
+                ops.append(m[0])
+                return m
+
+            ch.next = tap
+            try:
+                follow(follower_engine, ch)
+            finally:
+                ch.close()
+
+        t = threading.Thread(target=run_follower, daemon=True)
+        t.start()
+        chan = GangChannel.listen(port, 1, token="t")
+        leader = GangEngine(cfg, params, channel=chan, **kw)
+        try:
+            got = leader.generate(prompt, max_new_tokens=40, timeout=300)
+        finally:
+            leader.stop()
+            t.join(timeout=300)
+        assert not t.is_alive(), "follower did not drain the stream"
+        assert got == want  # speculative gang == speculative single-proc
+        assert "verify" in ops
+        ll = np.asarray(jax.device_get(leader._pool_logits))
+        fl = np.asarray(jax.device_get(follower_engine._pool_logits))
+        assert np.array_equal(ll, fl)
+        for a, b in zip(jax.tree.leaves(jax.device_get(leader._pool_cache)),
+                        jax.tree.leaves(
+                            jax.device_get(follower_engine._pool_cache))):
+            assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
 class TestGangChannelRecovery:
     """Control-stream self-healing (ISSUE 1), no processes: the dispatch
     replay a follower needs after a socket drop is exactly the replay an

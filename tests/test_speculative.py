@@ -1,0 +1,576 @@
+"""Speculative decoding (ISSUE 4, serving/continuous.py).
+
+n-gram (prompt-lookup) drafts verified k-at-a-time in ONE dispatch:
+these tests pin the contract the feature ships under — greedy tokens
+BIT-IDENTICAL to non-speculative decode (plain, prefix-cache, chunked-
+prefill and tiered variants), exact mid-burst EOS/stop retirement,
+engine observability counters, and zero steady-state recompiles across
+warmup -> spec decode -> accept/reject waves -> retirement -> slot
+reuse.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubeflow_tpu.models import llama as llamalib
+from kubeflow_tpu.serving.continuous import (
+    ContinuousEngine,
+    NgramProposer,
+    TieredEngine,
+)
+
+#: a prompt whose greedy continuation on the tiny model develops the
+#: repetitive structure prompt-lookup exists for (verified: acceptance
+#: rate > 0.5 over 60+ tokens) — the engine-level tests only need
+#: SOME accepted and SOME rejected drafts, which any trajectory gives
+LOOPY = np.random.default_rng(7).integers(1, 256, size=5).tolist()
+
+
+@pytest.fixture(scope="module")
+def tiny_llama():
+    cfg = llamalib.tiny()
+    model = llamalib.Llama(cfg)
+    params = model.init(jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32))
+    return cfg, params["params"]
+
+
+def make_engine(tiny_llama, **kw):
+    cfg, params = tiny_llama
+    kw.setdefault("num_slots", 4)
+    kw.setdefault("decode_chunk", 2)
+    kw.setdefault("prefix_cache", False)
+    return ContinuousEngine(cfg, params, **kw)
+
+
+@pytest.fixture(scope="module")
+def plain_tokens(tiny_llama):
+    """Greedy oracle: the non-speculative engine."""
+    eng = make_engine(tiny_llama)
+    try:
+        return {
+            "loopy": eng.generate(LOOPY, max_new_tokens=60, timeout=300),
+            "short": eng.generate([7, 8, 9], max_new_tokens=8),
+            "victim": eng.generate([7, 8, 9], max_new_tokens=40),
+        }
+    finally:
+        eng.stop()
+
+
+class TestNgramProposer:
+    def test_matches_most_recent_occurrence(self):
+        p = NgramProposer(2)
+        #           match here --v        v-- tail
+        hist = [1, 2, 9, 9, 5, 1, 2, 3, 4, 1, 2]
+        # the match's own next token (3) is t1's position — the verify
+        # emits the true token there for free (DraftProposer alignment
+        # contract), so drafts start one past it
+        assert p.propose(hist, 3) == [4, 1, 2]
+
+    def test_no_match_returns_empty(self):
+        assert NgramProposer(3).propose([1, 2, 3, 4, 5], 4) == []
+
+    def test_short_history_returns_empty(self):
+        assert NgramProposer(3).propose([1, 2], 4) == []
+
+    def test_proposal_capped_at_k_extends_past_history_end(self):
+        p = NgramProposer(2)
+        hist = [5, 6, 7, 8, 5, 6]
+        # the match's continuation runs off the end of history after
+        # [7, 8, 5, 6]; copy-and-continue keeps drafting the period
+        assert p.propose(hist, 8) == [8, 5, 6, 7, 8, 5, 6, 7]
+        assert p.propose(hist, 2) == [8, 5]
+
+    def test_constant_run_still_proposes(self):
+        # a period-1 tail (constant run) abuts its own match — the
+        # extension must keep proposing the constant, not go silent
+        assert NgramProposer(3).propose([7] * 6, 4) == [7, 7, 7, 7]
+
+    def test_cycle_alignment_accepts_whole_window(self):
+        """On a perfect cycle the shifted drafts line up exactly with
+        the verify layout [t1, g_1..g_k]: g_i predicts position
+        front+i.  Walk the cycle host-side the way the engine does —
+        t1 is the true next token, drafts must equal the k tokens
+        after it.  (A period-1 cycle cannot see a misalignment; this
+        period-5 one fails for any off-by-one.)"""
+        cycle = [11, 22, 33, 44, 55]
+        hist = (cycle * 4)[:18]  # ends mid-cycle: ..., 44, 55, 11, 22, 33
+        p = NgramProposer(3)
+        t1 = cycle[(hist[-1] // 11) % 5]  # true next after 33 is 44
+        want_after_t1 = [55, 11, 22, 33]
+        assert p.propose(hist, 4) == want_after_t1
+        assert p.propose(hist, 4)[0] != t1  # not t1's position
+
+    def test_bad_ngram_rejected(self):
+        with pytest.raises(ValueError, match=">= 1"):
+            NgramProposer(0)
+        with pytest.raises(ValueError, match="window"):
+            NgramProposer(2, window=0)
+
+    def test_window_caps_scan(self):
+        """The lookup runs between dispatches on the depth-1 critical
+        path — it scans at most the trailing ``window`` tokens, so a
+        match strictly older than the window is forgone (bounded host
+        work per proposal instead of O(history))."""
+        hist = [1, 2, 3] + [0] * 7 + [1, 2]
+        assert NgramProposer(2).propose(hist, 2) == [0, 0]
+        assert NgramProposer(2, window=8).propose(hist, 2) == []
+
+
+class TestResidualBanWarpOrder:
+    """The residual re-draw after a rejected draft must come from the
+    residual of the WARPED distribution: _sample_step bans the token
+    AFTER temperature/top-k/top-p, never before — masking first would
+    shift the kept set and let spec-on emit tokens spec-off sampling
+    assigns zero probability."""
+
+    def test_ban_applies_after_topk_warp(self):
+        from kubeflow_tpu.serving.continuous import _sample_step
+        logits = jnp.asarray([[0.0, 3.0, 2.0, 1.0]])  # argmax = 1
+        temps = jnp.asarray([1.0], jnp.float32)
+        ones = jnp.asarray([1.0], jnp.float32)
+        top2 = jnp.asarray([2], jnp.int32)  # warped kept set = {1, 2}
+        ban_top = jnp.asarray([1], jnp.int32)
+        for s in range(16):
+            t = _sample_step(logits, temps, ones, top2,
+                             jax.random.PRNGKey(s), banned=ban_top)
+            # residual of top-2 minus the banned top token is a point
+            # mass on token 2 — token 3 (which pre-warp masking would
+            # admit into the kept set) must never appear
+            assert int(t[0]) == 2
+
+    def test_no_ban_and_greedy_unaffected(self):
+        from kubeflow_tpu.serving.continuous import _sample_step
+        logits = jnp.asarray([[0.0, 3.0, 2.0, 1.0]])
+        ones = jnp.asarray([1.0], jnp.float32)
+        off = jnp.asarray([0], jnp.int32)
+        none = jnp.asarray([-1], jnp.int32)
+        key = jax.random.PRNGKey(0)
+        base = _sample_step(logits, ones, ones, off, key)
+        assert int(_sample_step(logits, ones, ones, off, key,
+                                banned=none)[0]) == int(base[0])
+        # greedy slots ignore the ban entirely (argmax != ban is
+        # already proven by the rejection that armed it)
+        zero_t = jnp.asarray([0.0], jnp.float32)
+        got = _sample_step(logits, zero_t, ones, off, key,
+                           banned=jnp.asarray([2], jnp.int32))
+        assert int(got[0]) == 1
+
+
+class TestSpeculativeParity:
+    def test_greedy_parity_and_drafts_actually_accepted(
+            self, tiny_llama, plain_tokens):
+        """Spec-on output is bit-identical to spec-off, AND the run
+        genuinely speculated: drafts were proposed, some accepted
+        (fewer decode dispatches than tokens) and some rejected (the
+        rollback path ran)."""
+        eng = make_engine(tiny_llama, decode_chunk=1, spec_k=4)
+        try:
+            got = eng.generate(LOOPY, max_new_tokens=60, timeout=300)
+            st = eng.stats()
+        finally:
+            eng.stop()
+        assert got == plain_tokens["loopy"]
+        assert st["spec_dispatches_total"] > 0
+        assert st["spec_tokens_accepted_total"] > 0
+        assert st["spec_tokens_accepted_total"] < \
+            st["spec_tokens_proposed_total"]  # rejections happened too
+        assert st["decode_steps"] < 60  # accepted runs amortized
+
+    def test_greedy_parity_concurrent_slots(self, tiny_llama, plain_tokens):
+        """Speculating and non-matching requests share verify
+        dispatches; every slot's stream stays bit-exact."""
+        eng = make_engine(tiny_llama, decode_chunk=1, spec_k=4)
+        try:
+            a = eng.submit(LOOPY, max_new_tokens=60)
+            b = eng.submit([7, 8, 9], max_new_tokens=8)
+            got_a, got_b = a.wait(300), b.wait(300)
+        finally:
+            eng.stop()
+        assert got_a == plain_tokens["loopy"]
+        assert got_b == plain_tokens["short"]
+
+    def test_misbehaving_proposer_degrades_not_kills(
+            self, tiny_llama, plain_tokens):
+        """The DraftProposer seam takes UNTRUSTED guesses: a custom
+        proposer that raises, or returns more than the planned budget,
+        must degrade to "no draft / clamped draft" for that slot — not
+        blow up the scheduler thread and fail every in-flight request.
+        Output stays bit-identical either way (drafts never change
+        tokens, only dispatch count)."""
+        calls = {"n": 0}
+
+        class Evil:
+            def propose(self, history, k):
+                calls["n"] += 1
+                if calls["n"] % 3 == 0:
+                    raise RuntimeError("proposer bug")
+                # overlong: violates the "up to k" contract
+                return NgramProposer(3).propose(history, k) + [1, 2, 3]
+
+        eng = make_engine(tiny_llama, decode_chunk=1, spec_k=4,
+                          draft_proposer=Evil())
+        try:
+            got = eng.generate(LOOPY, max_new_tokens=60, timeout=300)
+            st = eng.stats()
+        finally:
+            eng.stop()
+        assert calls["n"] > 3  # both behaviors exercised
+        assert got == plain_tokens["loopy"]
+        # clamping held: never more than spec_k proposals per slot-plan
+        assert st["spec_tokens_proposed_total"] <= 4 * calls["n"]
+
+    def test_parity_with_prefix_cache(self, tiny_llama):
+        """The prefix-cache admission route composes with speculative
+        decode: repeats admit via the on-device copy and still emit
+        identical tokens."""
+        cold = make_engine(tiny_llama, spec_k=0)
+        try:
+            want = cold.generate(list(range(1, 49)), max_new_tokens=12)
+        finally:
+            cold.stop()
+        eng = make_engine(tiny_llama, spec_k=4, prefix_cache=True,
+                          min_prefix=8)
+        try:
+            a = eng.generate(list(range(1, 49)), max_new_tokens=12)
+            b = eng.generate(list(range(1, 49)), max_new_tokens=12)
+            assert eng.prefix_hits == 1
+        finally:
+            eng.stop()
+        assert a == want and b == want
+
+    def test_parity_with_chunked_prefill_fused_verify(
+            self, tiny_llama, plain_tokens):
+        """prefill_budget + spec_k: the admitting prompt's chunks fuse
+        into VERIFY dispatches (make_fused_verify_program) while a
+        victim decodes speculatively — both bit-identical to solo."""
+        eng = make_engine(tiny_llama, decode_chunk=1, spec_k=4,
+                          prefill_budget=8)
+        try:
+            victim = eng.submit(LOOPY, max_new_tokens=60)
+            while eng.step_counter < 5:
+                time.sleep(0.005)
+            late = eng.submit([7, 8, 9], max_new_tokens=8)
+            got_late = late.wait(300)
+            got_victim = victim.wait(300)
+            st = eng.stats()
+            assert st["prefill_chunks_dispatched"] >= 1
+            assert st["spec_dispatches_total"] > 0
+        finally:
+            eng.stop()
+        assert got_victim == plain_tokens["loopy"]
+        assert got_late == plain_tokens["short"]
+
+    @pytest.mark.slow
+    def test_parity_tiered(self, tiny_llama, plain_tokens):
+        """spec knobs flow into every tier's pool; routing + tokens
+        match the untiered oracle."""
+        cfg, params = tiny_llama
+        eng = TieredEngine(cfg, params, short_len=32, num_slots=4,
+                           decode_chunk=2, prefix_cache=False, spec_k=4)
+        try:
+            assert all(p.spec_k == 4 for p in eng.pools)
+            got_short = eng.generate([7, 8, 9], max_new_tokens=8)
+            got_long = eng.generate(LOOPY, max_new_tokens=60, timeout=300)
+            st = eng.stats()
+            assert st["spec_acceptance_rate"] <= 1.0
+        finally:
+            eng.stop()
+        assert got_short == plain_tokens["short"]
+        assert got_long == plain_tokens["loopy"]
+
+    def test_eos_mid_burst_truncates_at_exact_token(
+            self, tiny_llama, plain_tokens):
+        """EOS landing inside a burst of accepted tokens retires the
+        request AT the EOS token, not at the burst end."""
+        want = plain_tokens["loopy"]
+        # the token whose FIRST occurrence is deepest: the stream loops,
+        # so most tokens recur early — EOS must not fire before
+        # speculation is in swing
+        first: dict[int, int] = {}
+        for i, t in enumerate(want):
+            first.setdefault(t, i)
+        eos, idx = max(first.items(), key=lambda kv: kv[1])
+        eng = make_engine(tiny_llama, decode_chunk=1, spec_k=4, eos_id=eos)
+        try:
+            got = eng.generate(LOOPY, max_new_tokens=60, timeout=300)
+            assert eng.spec_dispatches_total > 0
+        finally:
+            eng.stop()
+        assert got == want[: idx + 1]
+
+    def test_slot_reuse_after_speculation(self, tiny_llama, plain_tokens):
+        """Stale draft KV from a retired speculating occupant never
+        leaks into the slot's next occupant (the rollback is a pointer,
+        the pool relies on masking)."""
+        eng = make_engine(tiny_llama, num_slots=1, decode_chunk=1,
+                          spec_k=4)
+        try:
+            first = eng.generate(LOOPY, max_new_tokens=60, timeout=300)
+            second = eng.generate([7, 8, 9], max_new_tokens=8)
+        finally:
+            eng.stop()
+        assert first == plain_tokens["loopy"]
+        assert second == plain_tokens["short"]
+
+    def test_greedy_neighbor_unaffected_by_sampling_slot(
+            self, tiny_llama, plain_tokens):
+        """A temperature=0 request stays bit-exact while a sampling
+        request shares its verify dispatches (per-slot rejection
+        sampling is independent)."""
+        eng = make_engine(tiny_llama, decode_chunk=1, spec_k=4)
+        try:
+            hot = eng.submit(LOOPY, max_new_tokens=30, temperature=2.0)
+            cold = eng.submit(LOOPY, max_new_tokens=60)
+            got = cold.wait(300)
+            hot_out = hot.wait(300)
+        finally:
+            eng.stop()
+        assert got == plain_tokens["loopy"]
+        assert len(hot_out) == 30
+        assert all(0 <= t < 256 for t in hot_out)
+
+    def test_stochastic_spec_supports_match_greedy_degenerates(
+            self, tiny_llama, plain_tokens):
+        """temperature > 0 with top_k=1 collapses rejection sampling to
+        the greedy accept rule — output must equal plain greedy even
+        through accept/reject/residual-ban waves."""
+        eng = make_engine(tiny_llama, decode_chunk=1, spec_k=4)
+        try:
+            got = eng.generate(LOOPY, max_new_tokens=60, timeout=300,
+                               temperature=0.8, top_k=1)
+        finally:
+            eng.stop()
+        assert got == plain_tokens["loopy"]
+
+
+class TestSpeculativeStats:
+    def test_counters_and_rate(self, tiny_llama):
+        eng = make_engine(tiny_llama, decode_chunk=1, spec_k=4)
+        try:
+            eng.generate(LOOPY, max_new_tokens=60, timeout=300)
+            st = eng.stats()
+        finally:
+            eng.stop()
+        for k in ("spec_tokens_proposed_total", "spec_tokens_accepted_total",
+                  "spec_dispatches_total", "spec_acceptance_rate"):
+            assert k in st
+        assert st["spec_acceptance_rate"] == round(
+            st["spec_tokens_accepted_total"]
+            / max(st["spec_tokens_proposed_total"], 1), 4)
+        assert 0.0 <= st["spec_acceptance_rate"] <= 1.0
+
+    def test_spec_off_counters_stay_zero(self, tiny_llama):
+        eng = make_engine(tiny_llama)
+        try:
+            eng.generate([1, 2, 3], max_new_tokens=4)
+            st = eng.stats()
+        finally:
+            eng.stop()
+        assert st["spec_dispatches_total"] == 0
+        assert st["spec_tokens_proposed_total"] == 0
+
+    def test_bad_knobs_rejected(self, tiny_llama):
+        with pytest.raises(ValueError, match="spec_k"):
+            make_engine(tiny_llama, spec_k=-1)
+        with pytest.raises(ValueError, match="spec_ngram"):
+            make_engine(tiny_llama, spec_k=2, spec_ngram=0)
+
+    def test_bad_knobs_fail_isvc_at_conf_freeze(self):
+        """Satellite: a bad spec knob on an ISvc (gang or not) is ONE
+        Failed status with the knob named — caught at conf-freeze in
+        the controller, before any engine/pod ever constructs (no
+        params are even fetched, so this test needs no model)."""
+        from kubeflow_tpu.api.common import ObjectMeta
+        from kubeflow_tpu.api.inference import (
+            ComponentSpec, InferenceService, InferenceServicePhase,
+            InferenceServiceSpec, ModelFormat,
+        )
+        from kubeflow_tpu.controlplane.cluster import Cluster
+
+        with Cluster() as cluster:
+            cluster.add_tpu_slice("slice-0", 1, 4)
+            cluster.enable_serving()
+            cluster.store.create(InferenceService(
+                metadata=ObjectMeta(name="bad-spec"),
+                spec=InferenceServiceSpec(predictor=ComponentSpec(
+                    model_format=ModelFormat(name="llama-continuous"),
+                    config={"params_ref": "mem://never-fetched",
+                            "spec_k": -2}))))
+            deadline = time.time() + 20
+            isvc = None
+            while time.time() < deadline:
+                isvc = cluster.store.try_get("InferenceService", "bad-spec")
+                if (isvc is not None
+                        and isvc.status.phase == InferenceServicePhase.FAILED):
+                    break
+                time.sleep(0.05)
+            assert isvc is not None
+            assert isvc.status.phase == InferenceServicePhase.FAILED, \
+                isvc.status
+            assert "spec_k" in (isvc.status.message or "")
+
+
+class TestSpeculativeDispatchHygiene:
+    """ISSUE 4 acceptance: jit_recompiles_total == 0 across warmup ->
+    spec decode -> accept/reject waves -> retirement -> slot reuse."""
+
+    def test_zero_steady_state_recompiles_spec(self, tiny_llama):
+        eng = make_engine(tiny_llama, decode_chunk=2, spec_k=4)
+        try:
+            eng.warmup()
+            # wave 1: speculating + draft-free requests share the pool
+            # (60 tokens: the trajectory's repetitive tail is where the
+            # n-gram proposer starts firing)
+            reqs = [eng.submit(LOOPY, max_new_tokens=60),
+                    eng.submit([7, 8, 9], max_new_tokens=6)]
+            for r in reqs:
+                r.wait(300)
+            # wave 2: slot reuse after retirement, speculation resumes
+            reqs = [eng.submit(LOOPY, max_new_tokens=60)
+                    for _ in range(2)]
+            for r in reqs:
+                r.wait(300)
+            st = eng.stats()
+            assert st["spec_dispatches_total"] > 0  # speculation ran
+            assert st["jit_recompiles_total"] == 0, st
+        finally:
+            eng.stop()
+
+    def test_zero_recompiles_spec_with_chunked_prefill(self, tiny_llama):
+        eng = make_engine(tiny_llama, decode_chunk=2, spec_k=4,
+                          prefill_budget=4)
+        try:
+            eng.warmup()
+            victim = eng.submit(LOOPY, max_new_tokens=30)
+            while eng.step_counter < 3:
+                time.sleep(0.005)
+            late = eng.submit(list(range(1, 20)), max_new_tokens=6)
+            late.wait(300)
+            victim.wait(300)
+            st = eng.stats()
+            assert st["prefill_chunks_dispatched"] > 0
+            assert st["jit_recompiles_total"] == 0, st
+        finally:
+            eng.stop()
+
+
+class TestStopSequenceBursts:
+    """Satellite: serving/text.py must retire a stop that completes
+    mid-burst at the EXACT token — a verify dispatch delivers up to
+    spec_k+1 tokens at once, so the stop routinely lands inside one."""
+
+    def _text_model(self, tiny_llama, **extra):
+        from kubeflow_tpu.serving.storage import register_mem
+        from kubeflow_tpu.serving.text import TextGenerator
+
+        cfg, params = tiny_llama
+        ref = register_mem(f"spec-text-{extra.get('spec_k', 0)}",
+                           (cfg, params))
+        m = TextGenerator("tg", {
+            "params_ref": ref, "max_new_tokens": 96, "num_slots": 2,
+            "decode_chunk": 1, "warmup_groups": [], "prefix_cache": False,
+            "eos_id": None, **extra})
+        m.start()
+        return m
+
+    def test_stop_spanning_accept_boundary(self, tiny_llama):
+        """A stop string that spans burst boundaries truncates the text
+        before the stop and retires at the EXACT covering token: the
+        spec run (tokens arriving in bursts of up to spec_k+1) must
+        land on the same retirement token as the token-by-token
+        reference run."""
+        from kubeflow_tpu.serving.text import ByteTokenizer, _ids_covering
+
+        tok = ByteTokenizer()
+        ref = self._text_model(tiny_llama, spec_k=0)
+        try:
+            ref_ids = ref.engine.generate(tok.encode("ab"),
+                                          max_new_tokens=96, timeout=300)
+        finally:
+            ref.stop()
+        full = tok.decode(ref_ids)
+        # the 3-char stop with the DEEPEST first occurrence: the stream
+        # loops, so a late-position substring usually also occurs early
+        # — the deepest one guarantees speculation is in swing when the
+        # stop completes, and a 3-char stop regularly straddles an
+        # accept boundary at spec_k=4
+        stop, cut = max(
+            ((full[j: j + 3], full.find(full[j: j + 3]))
+             for j in range(len(full) - 3)), key=lambda sc: sc[1])
+        expect_tokens = len(_ids_covering(tok, ref_ids, cut + len(stop)))
+        m = self._text_model(tiny_llama, spec_k=4)
+        try:
+            out = m.openai_completions(
+                {"prompt": "ab", "max_tokens": 96, "stop": stop})
+            assert m.engine.spec_tokens_accepted_total > 0  # bursts ran
+        finally:
+            m.stop()
+        choice = out["choices"][0]
+        assert choice["text"] == full[:cut]
+        assert choice["finish_reason"] == "stop"
+        # exact-token retirement: usage counts the ids whose decode
+        # covers the stop — NOT the burst tail the dispatch delivered
+        assert out["usage"]["completion_tokens"] == expect_tokens
+
+    def test_stop_scanner_hit_end_across_feeds(self):
+        """_StopScanner reports the hit END even when the stop's bytes
+        arrive split across two scans (the accept-boundary shape)."""
+        from kubeflow_tpu.serving.text import ByteTokenizer, _StopScanner
+
+        tok = ByteTokenizer()
+        s = _StopScanner(tok, ["XYZ"])
+        ids = tok.encode("aaXY") + tok.encode("Zbb")
+        assert s.scan(ids[:4]) is None  # stop only half-arrived
+        cut = s.scan(ids)
+        assert cut == 2
+        assert s.last_hit_end == 5
+
+    def test_ids_covering_exact_token(self):
+        from kubeflow_tpu.serving.text import ByteTokenizer, _ids_covering
+
+        tok = ByteTokenizer()
+        ids = tok.encode("hello world")
+        assert _ids_covering(tok, ids, 5) == tok.encode("hello")
+        assert _ids_covering(tok, ids, len("hello world") + 9) == ids
+
+    def test_ids_covering_multibyte_prefix_not_cut_early(self):
+        """The prefix-re-decode fallback (HF path: no
+        incremental_decoder) must not cut a token early when a prefix
+        decode ends in an INCOMPLETE multi-byte char: the trailing
+        U+FFFD inflates the char count, so "aé" split as
+        [a, é-byte-1, é-byte-2] already measures 2 chars at 2 ids —
+        but that boundary is dirty and cutting there drops the stop's
+        final character."""
+        from kubeflow_tpu.serving.text import _ids_covering
+
+        class ByteLevel:  # decode-only tokenizer, not prefix-stable
+            def decode(self, ids):
+                return bytes(ids).decode("utf-8", errors="replace")
+
+        ids = [0x61, 0xC3, 0xA9]  # "aé", é split across two ids
+        got = _ids_covering(ByteLevel(), ids, 2)  # stop ends at char 2
+        assert got == ids
+        assert ByteLevel().decode(got) == "aé"
+
+    def test_ids_covering_non_additive_cleanup(self):
+        """HF decode is not additive: clean_up_tokenization_spaces
+        collapses ' ,' to ',', so the prefix ['Hello', ' '] measures
+        the same 6 chars as the full decode 'Hello,' — a length-only
+        cut would drop the ',' that completed the stop."""
+        from kubeflow_tpu.serving.text import _ids_covering
+
+        class Cleanup:
+            vocab = {0: "Hello", 1: " ", 2: ","}
+
+            def decode(self, ids):
+                return "".join(
+                    self.vocab[i] for i in ids).replace(" ,", ",")
+
+        ids = [0, 1, 2]  # full decode "Hello," — stop "," ends at 6
+        got = _ids_covering(Cleanup(), ids, 6)
+        assert got == ids  # not cut at ['Hello', ' '] (also 6 chars)
+        assert Cleanup().decode(got) == "Hello,"
