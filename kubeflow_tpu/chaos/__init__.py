@@ -2,9 +2,11 @@
 
 ``FaultPlan`` is the one entry point: build a seeded plan, then hand it
 to ``FakeKubelet(..., chaos=plan)`` (pod crashes, kubelet stalls, node
-drains) and/or to ``GangChannel`` via ``plan.socket_wrapper(role)``
-(control-stream drops/delays).  See chaos/plan.py for the fault model
-and tests/test_chaos.py for the recovery paths it exercises.
+drains), to ``GangChannel`` via ``plan.socket_wrapper(role)``
+(control-stream drops/delays), and/or to a durable ``Cluster`` via
+``Cluster(data_dir=..., wal_crashpoint=plan.wal_crashpoint())`` (kill -9
+the control plane at a seeded WAL offset).  See chaos/plan.py for the
+fault model and tests/test_chaos.py for the recovery paths it exercises.
 """
 
 from .net import ChaosSocket

@@ -49,6 +49,7 @@ class FaultKind(str, enum.Enum):
     NODE_DRAIN = "node_drain"        # node vanishes; its pods are preempted
     SOCKET_DROP = "socket_drop"      # gang control socket dies mid-stream
     SOCKET_DELAY = "socket_delay"    # gang control sends are delayed
+    CONTROL_PLANE_CRASH = "control_plane_crash"  # kill -9 at a WAL offset
 
 
 @dataclass
@@ -72,6 +73,9 @@ class Fault:
     #: drop (None = drop on connect)
     after_calls: Optional[int] = None
     delay: float = 0.0
+    #: CONTROL_PLANE_CRASH: bytes of the in-flight WAL record that reach
+    #: disk before the machine dies (a torn tail for recovery to chew on)
+    torn_bytes: int = 0
     #: bookkeeping: consumed count (pod faults), fired flag (cluster)
     fired: int = field(default=0, compare=False)
 
@@ -87,6 +91,8 @@ class FaultPlan:
         self._t0: Optional[float] = None
         #: pod-name -> incarnations seen (a new uid = a new life)
         self._lives: dict[str, set[str]] = defaultdict(set)
+        #: memoized WalCrashPoint (wal_crashpoint())
+        self._crashpoint = None
 
     # -- builders (chainable) ---------------------------------------------
 
@@ -162,6 +168,46 @@ class FaultPlan:
         self.faults.append(Fault(FaultKind.SOCKET_DROP, role=role,
                                  after_calls=after_calls, times=times))
         return self
+
+    def control_plane_crash(self, after_records: Optional[int] = None,
+                            max_records: int = 64,
+                            torn_bytes: Optional[int] = None) -> "FaultPlan":
+        """kill -9 the control plane once its WAL has appended
+        ``after_records`` records (None = seeded random offset in
+        ``[0, max_records)``), with ``torn_bytes`` of the record
+        in flight at death reaching disk (None = seeded draw between a
+        clean cut and a mid-record tear) — the one fault PR 1 could not
+        reach.  Nothing later persists; the surviving kubelets/pods keep
+        running unadopted until a restarted Cluster (same ``data_dir``)
+        replays the log and re-adopts them.  Consume via
+        ``Cluster(data_dir=..., wal_crashpoint=plan.wal_crashpoint())``;
+        ``plan.wal_crashpoint().fired`` is the death notification."""
+        if after_records is None:
+            after_records = self.rng.randrange(max_records)
+        if torn_bytes is None:
+            torn_bytes = self.rng.choice((0, 0, 5, 11, 23))
+        self.faults.append(Fault(FaultKind.CONTROL_PLANE_CRASH,
+                                 after_calls=after_records,
+                                 torn_bytes=torn_bytes))
+        return self
+
+    def wal_crashpoint(self):
+        """The :class:`~kubeflow_tpu.controlplane.wal.WalCrashPoint` for
+        this plan's CONTROL_PLANE_CRASH fault (built once, so tests can
+        both hand it to the Cluster and wait on ``.fired``); None when
+        the plan has no control-plane fault."""
+        from ..controlplane.wal import WalCrashPoint
+
+        with self._lock:
+            if getattr(self, "_crashpoint", None) is None:
+                f = next((f for f in self.faults
+                          if f.kind == FaultKind.CONTROL_PLANE_CRASH), None)
+                if f is None:
+                    return None
+                self._crashpoint = WalCrashPoint(
+                    after_records=f.after_calls or 0,
+                    torn_bytes=f.torn_bytes)
+            return self._crashpoint
 
     def socket_delay(self, role: str = "leader", delay: float = 0.01,
                      times: int = 1) -> "FaultPlan":

@@ -54,7 +54,17 @@ import yaml
 from ..api.yaml_io import KIND_REGISTRY, from_dict, to_dict
 from ..utils.net import allocate_port
 from .controller import events_for
-from .store import AlreadyExists, Conflict, NotFound, Rejected, Store
+from .store import TOO_OLD, AlreadyExists, Conflict, NotFound, Rejected, Store
+
+#: largest request body accepted on writes — the server must not allocate
+#: whatever a client's Content-Length header claims (413 past this)
+MAX_BODY_BYTES = 4 * 1024 * 1024
+
+
+class BodyTooLarge(Exception):
+    def __init__(self, n: int) -> None:
+        super().__init__(
+            f"request body {n} bytes exceeds limit {MAX_BODY_BYTES}")
 
 #: case-insensitive kind aliases (kubectl-style shortnames + plurals)
 KIND_ALIASES = {
@@ -181,12 +191,28 @@ def restore_redacted_on_write(kind: str, obj, cur) -> None:
 class ApiServer:
     """HTTP facade over a Store (one per cluster)."""
 
-    def __init__(self, store: Store, port: Optional[int] = None,
+    def __init__(self, store: Optional[Store] = None,
+                 port: Optional[int] = None,
                  log_path_for: Optional[Callable[[str, str], str]] = None,
                  token: Optional[str] = None,
-                 profile_tokens: Optional[dict[str, str]] = None):
+                 profile_tokens: Optional[dict[str, str]] = None,
+                 data_dir: Optional[str] = None):
         import os
 
+        if store is None:
+            if data_dir is None:
+                raise ValueError("ApiServer needs a store or a data_dir")
+            # standalone durable mode: the server owns (and closes) a
+            # WAL-backed store recovered from data_dir — with the same
+            # admission webhooks a Cluster registers, or writes through
+            # this surface would persist un-defaulted/unvalidated specs
+            from .cluster import register_default_admission
+
+            store = Store.open(data_dir)
+            register_default_admission(store)
+            self._owns_store = True
+        else:
+            self._owns_store = False
         self.store = store
         self.log_path_for = log_path_for
         self.port = port or allocate_port()
@@ -217,7 +243,12 @@ class ApiServer:
 
             def _body(self) -> dict:
                 n = int(self.headers.get("Content-Length", "0"))
-                raw = self.rfile.read(n) if n else b"{}"
+                if n > MAX_BODY_BYTES:
+                    # reject BEFORE reading: the header is client-
+                    # controlled and must not size an allocation
+                    self.close_connection = True  # unread body poisons keep-alive
+                    raise BodyTooLarge(n)
+                raw = self.rfile.read(n) if n > 0 else b"{}"
                 text = raw.decode()
                 if self.headers.get("Content-Type", "").startswith(
                         "application/yaml") or not text.lstrip().startswith("{"):
@@ -248,6 +279,7 @@ class ApiServer:
         #: — signalled with 410 Gone, kube-apiserver style, instead of
         #: silently skipping the gap
         self._evicted_seq = 0
+        self._stopping = False
         self._store_watch = store.watch(list(KIND_REGISTRY))
         self._pump = threading.Thread(
             target=self._pump_events, name="apiserver-watch-pump", daemon=True)
@@ -265,6 +297,7 @@ class ApiServer:
         return f"http://127.0.0.1:{self.port}"
 
     def stop(self) -> None:
+        self._stopping = True
         self.store.stop_watch(self._store_watch)
         self._httpd.shutdown()
         self._httpd.server_close()
@@ -272,6 +305,8 @@ class ApiServer:
         with self._events_cond:  # release any parked long-polls
             self._events_cond.notify_all()
         self._pump.join(timeout=2)
+        if self._owns_store:
+            self.store.close()
 
     # -- request handling --------------------------------------------------
 
@@ -332,6 +367,8 @@ class ApiServer:
             h._send(409, {"error": str(e), "reason": "Conflict"})
         except Rejected as e:
             h._send(422, {"error": str(e), "reason": "Invalid"})
+        except BodyTooLarge as e:
+            h._send(413, {"error": str(e), "reason": "RequestEntityTooLarge"})
         except KeyError as e:
             h._send(404, {"error": f"unknown kind {e}", "reason": "NotFound"})
         except Exception as e:  # noqa: BLE001 — surface as 400
@@ -347,6 +384,20 @@ class ApiServer:
             except queuelib.Empty:
                 if getattr(self._store_watch, "closed", False):
                     return
+                continue
+            if ev.type == TOO_OLD:
+                if self._stopping:
+                    return
+                # the store-side watch overflowed: re-subscribe, then
+                # expire EVERY outstanding cursor — events were dropped
+                # before they ever got a seq, so any resume would have a
+                # silent hole; clients get 410 and relist
+                self._store_watch = self.store.watch(list(KIND_REGISTRY))
+                with self._events_cond:
+                    self._event_seq += 1
+                    self._evicted_seq = self._event_seq
+                    self._events.clear()
+                    self._events_cond.notify_all()
                 continue
             with self._events_cond:
                 self._event_seq += 1

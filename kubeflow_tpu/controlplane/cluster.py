@@ -29,25 +29,46 @@ from .scheduler import GangScheduler
 from .store import Store
 
 
+def register_default_admission(store: Store) -> None:
+    """The platform's webhook set — defaulting + validation per kind.
+    Every store that accepts user writes (Cluster-owned OR a standalone
+    durable ApiServer's) must register these, or un-defaulted/invalid
+    specs get WAL-persisted and replayed admission-free forever."""
+    store.register_admission(
+        KIND_JAXJOB, mutate=default_jaxjob, validate=validate_jaxjob)
+    store.register_admission(
+        KIND_EXPERIMENT, mutate=default_experiment,
+        validate=validate_experiment)
+    store.register_admission(
+        KIND_INFERENCE_SERVICE,
+        mutate=default_inference_service,
+        validate=validate_inference_service,
+    )
+
+
 class Cluster:
-    def __init__(self) -> None:
-        self.store = Store()
+    def __init__(self, data_dir: Optional[str] = None,
+                 wal_crashpoint=None) -> None:
+        """``data_dir`` turns on control-plane durability: the store is
+        recovered from (and keeps logging to) a WAL + snapshot there, so
+        a crash-restarted Cluster resumes every JaxJob/ISvc/pod where
+        the log left them.  ``wal_crashpoint`` is the chaos harness's
+        kill switch (``FaultPlan.wal_crashpoint()``).
+
+        Crash-restart order matters: re-attach surviving kubelets
+        (``FakeKubelet.attach_store``) BEFORE ``start()`` so controllers
+        adopt the pods that outlived the crash instead of re-creating
+        them — the informer-cache-sync-before-reconcile contract."""
+        self.store = (
+            Store.open(data_dir, crashpoint=wal_crashpoint)
+            if data_dir is not None else Store())
         self._register_admission()
         self.scheduler = GangScheduler(self.store)
         self.controllers: list[Controller] = [JaxJobController(self.store)]
         self._started = False
 
     def _register_admission(self) -> None:
-        s = self.store
-        s.register_admission(KIND_JAXJOB, mutate=default_jaxjob, validate=validate_jaxjob)
-        s.register_admission(
-            KIND_EXPERIMENT, mutate=default_experiment, validate=validate_experiment
-        )
-        s.register_admission(
-            KIND_INFERENCE_SERVICE,
-            mutate=default_inference_service,
-            validate=validate_inference_service,
-        )
+        register_default_admission(self.store)
 
     def add_controller(self, c: Controller) -> None:
         self.controllers.append(c)
@@ -290,6 +311,7 @@ class Cluster:
         if getattr(self, "_db_server", None) is not None:
             self._db_server.stop()
             self._db_server = None
+        self.store.close()  # flush + detach the WAL (no-op in-memory)
         self._started = False
 
     def __enter__(self) -> "Cluster":

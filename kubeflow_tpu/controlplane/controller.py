@@ -21,7 +21,7 @@ from typing import Callable, Iterable, Optional
 
 from ..api.common import TypedObject
 from .objects import Event, KIND_EVENT
-from .store import DELETED, Store, WatchEvent
+from .store import DELETED, TOO_OLD, Store, WatchEvent
 
 log = logging.getLogger("kubeflow_tpu.controlplane")
 
@@ -213,9 +213,7 @@ class Controller:
     def start(self) -> None:
         kinds = (self.kind, *self.owned_kinds)
         self._watch = self.store.watch(kinds)
-        # prime: enqueue existing objects (informer initial list)
-        for obj in self.store.list(self.kind):
-            self.queue.add(obj.key)
+        self._prime()
         t = threading.Thread(target=self._watch_loop, name=f"{self.kind}-watch", daemon=True)
         t.start()
         self._threads.append(t)
@@ -233,6 +231,29 @@ class Controller:
         for t in self._threads:
             t.join(timeout=5)
 
+    def _prime(self) -> None:
+        """Informer initial list: enqueue every existing object of our
+        kind, AND the owner key of every existing owned object — after a
+        control-plane restart an owned pod whose job is gone must still
+        trigger a reconcile (orphan cleanup), and one whose job survived
+        must be adopted, even though neither produces a watch event."""
+        for obj in self.store.list(self.kind):
+            self.queue.add(obj.key)
+        for kind in self.owned_kinds:
+            for obj in self.store.list(kind):
+                key = self.owner_key_for(obj)
+                if key:
+                    self.queue.add(key)
+
+    def _resync(self) -> None:
+        """The watch overflowed (TOO_OLD): events were dropped and the
+        ONLY correct recovery is a fresh watch + full relist — never
+        resuming as if nothing was missed.  New watch FIRST, then list,
+        so nothing lands in the gap between the two."""
+        kinds = (self.kind, *self.owned_kinds)
+        self._watch = self.store.watch(kinds)
+        self._prime()
+
     def _watch_loop(self) -> None:
         assert self._watch is not None
         while not self._stop.is_set():
@@ -240,6 +261,11 @@ class Controller:
                 ev = self._watch.q.get(timeout=0.2)
             except queue.Empty:
                 continue
+            if ev.type == TOO_OLD:
+                log.warning("%s watch fell behind; relisting", self.kind)
+                self._resync()
+                continue
+            assert ev.obj is not None
             if ev.obj.kind == self.kind:
                 self.queue.add(ev.obj.key)
             else:

@@ -26,6 +26,7 @@ kubelet's loop (detection-latency faults) and fire cluster-level faults
 
 from __future__ import annotations
 
+import copy
 import logging
 import threading
 import time
@@ -33,7 +34,7 @@ from dataclasses import dataclass, field
 from typing import Callable, Optional
 
 from .objects import KIND_POD, Pod, PodPhase
-from .store import NotFound, Store
+from .store import AlreadyExists, NotFound, Store
 
 log = logging.getLogger("kubeflow_tpu.fake-kubelet")
 
@@ -88,11 +89,20 @@ class FakeKubelet:
         self.chaos = chaos
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        #: serializes the tick against attach_store's swap+resync — a
+        #: tick landing between the two would sweep crash-lost pods out
+        #: of _last_seen before resync could re-report them
+        self._tick_lock = threading.Lock()
         self._running: dict[str, _Running] = {}
+        #: ns/name -> last pod object this kubelet reported (uid inside):
+        #: the node's own view of its pods, which is what survives a
+        #: control-plane crash and feeds the adoption relist (resync)
+        self._last_seen: dict[str, Pod] = {}
 
     def start(self) -> None:
         if self.chaos is not None:
             self.chaos.activate()
+        self._stop.clear()  # re-startable: the node outlives a control plane
         self._thread = threading.Thread(target=self._loop, name="fake-kubelet", daemon=True)
         self._thread.start()
 
@@ -107,17 +117,111 @@ class FakeKubelet:
                 if self.chaos is not None and self.chaos.kubelet_stalled():
                     self._stop.wait(self.interval)
                     continue
-                self.step()
+                with self._tick_lock:
+                    self.step()
             except Exception:  # noqa: BLE001 — the kubelet loop must survive
                 log.debug("fake-kubelet step failed", exc_info=True)
             self._stop.wait(self.interval)
+
+    # -- control-plane crash-restart (adoption) ---------------------------
+
+    def attach_store(self, store: Store) -> None:
+        """Point this kubelet at a RESTARTED control plane's store and
+        re-report everything the node still knows (``resync``) — the
+        kubelet relist that makes surviving pods adoptable.  Call this
+        BEFORE the new cluster's controllers start, so their initial
+        list already contains the survivors (informer-sync-before-
+        reconcile); creates race-safely no-op on AlreadyExists either
+        way.  Safe while the kubelet loop runs: the swap + resync are
+        one atomic unit w.r.t. ticks."""
+        with self._tick_lock:
+            self.store = store
+            self.resync()
+
+    def resync(self) -> None:
+        """Reconcile the store against this node's view:
+
+        - a pod the node runs (or finished during the outage) that the
+          recovered store LOST (its create/status records sat past the
+          durability horizon) is re-created verbatim — same uid, labels,
+          owner refs — so the controller adopts it by owner-ref match
+          instead of double-creating the gang member;
+        - a pod the store recovered with a STALE status (e.g. RUNNING
+          though it finished while the control plane was down) gets the
+          node's truth replayed onto it.  The kubelet is the sole status
+          writer, so node truth always wins on matching uid."""
+        for nkey, pod in list(self._last_seen.items()):
+            ns, name = nkey.split("/", 1)
+            cur = self.store.try_get(KIND_POD, name, ns)
+            if cur is None:
+                obj = copy.deepcopy(pod)
+                obj.metadata.resource_version = 0
+                try:
+                    self.store.create(obj)
+                except AlreadyExists:
+                    pass  # raced a controller create of the same name
+                except NotFound:
+                    pass  # admission raced an owner lookup; next step heals
+                continue
+            assert isinstance(cur, Pod)
+            if cur.metadata.uid != pod.metadata.uid:
+                continue  # a newer incarnation owns the name now
+            if cur.status == pod.status and cur.spec.node_name:
+                continue
+
+            def mut(o, p=pod):
+                o.status = p.status.model_copy(deep=True)
+                if not o.spec.node_name:  # lost binding: the node knows
+                    o.spec.node_name = p.spec.node_name
+
+            try:
+                self.store.update_with_retry(KIND_POD, name, ns, mut)
+            except NotFound:
+                pass
+        # the inverse direction: a recovered pod that claims to be
+        # RUNNING but that this node does not know (its delete record
+        # was lost, so the store resurrected it) has no process behind
+        # it — report it failed so the controller re-forms the gang
+        # instead of waiting forever on a ghost
+        for pod in self.store.list(KIND_POD):
+            assert isinstance(pod, Pod)
+            nkey = f"{pod.metadata.namespace}/{pod.metadata.name}"
+            if (pod.status.phase != PodPhase.RUNNING
+                    or nkey in self._last_seen):
+                continue
+
+            def lost(o):
+                o.status.phase = PodPhase.FAILED
+                o.status.exit_code = 137
+                o.status.message = "no process on node after restart"
+                o.status.finish_time = time.time()
+
+            try:
+                self.store.update_with_retry(
+                    KIND_POD, pod.metadata.name, pod.metadata.namespace, lost)
+            except NotFound:
+                pass
 
     def step(self) -> None:
         now = time.time()
         if self.chaos is not None:
             self.chaos.apply_cluster_faults(self.store, now)
-        for pod in self.store.list(KIND_POD):
-            assert isinstance(pod, Pod)
+        # ONE store snapshot per tick (list deep-copies under the store
+        # lock): both the deletion sweep and the pod loop work off it
+        pods = [p for p in self.store.list(KIND_POD) if isinstance(p, Pod)]
+        present = {
+            f"{p.metadata.namespace}/{p.metadata.name}/{p.metadata.uid}"
+            for p in pods}
+        for key in list(self._running):
+            if key not in present:
+                # the pod object was deleted while we watched: the
+                # controller killed it — the local "process" dies too
+                self._running.pop(key, None)
+                nkey, _, uid = key.rpartition("/")
+                seen = self._last_seen.get(nkey)
+                if seen is not None and seen.metadata.uid == uid:
+                    self._last_seen.pop(nkey, None)
+        for pod in pods:
             key = f"{pod.metadata.namespace}/{pod.metadata.name}/{pod.metadata.uid}"
             if pod.status.phase == PodPhase.PENDING and pod.spec.node_name:
                 script = self.script(pod)
@@ -182,11 +286,15 @@ class FakeKubelet:
         pod.status.finish_time = now
 
     def _mutate(self, pod: Pod, fn) -> None:
+        nkey = f"{pod.metadata.namespace}/{pod.metadata.name}"
         try:
-            self.store.update_with_retry(
+            out = self.store.update_with_retry(
                 KIND_POD, pod.metadata.name, pod.metadata.namespace, fn
             )
+            assert isinstance(out, Pod)
+            # the node's own record of this pod (store returns a copy):
+            # what resync re-reports after a control-plane crash
+            self._last_seen[nkey] = out
         except NotFound:
-            self._running.pop(
-                f"{pod.metadata.namespace}/{pod.metadata.name}/{pod.metadata.uid}", None
-            )
+            self._running.pop(f"{nkey}/{pod.metadata.uid}", None)
+            self._last_seen.pop(nkey, None)

@@ -81,6 +81,13 @@ class JaxJobController(Controller):
 
     def __init__(self, store: Store) -> None:
         super().__init__(store)
+        # Intentionally NOT durable: in-flight create/delete intent died
+        # with the old process, and a fresh ledger is all-satisfied, so
+        # the first post-restart reconcile trusts the store listing.
+        # That listing already contains the pods that outlived a crash
+        # because kubelet resync runs BEFORE controllers start
+        # (cluster.py crash-restart order) — the HasSynced-before-
+        # reconcile half of the upstream expectations contract.
         self.expectations = Expectations()
 
     # -- expectation accounting (SatisfiedExpectations pattern) ---------------
@@ -232,6 +239,7 @@ class JaxJobController(Controller):
     # -- ensure: pods + headless services -------------------------------------
 
     def _ensure_pods_services(self, job: JaxJob, pods: list[Pod]) -> None:
+        self._adopt_orphans(job, pods)
         existing = {
             (p.metadata.labels.get(LABEL_REPLICA_TYPE), int(p.metadata.labels.get(LABEL_REPLICA_INDEX, -1))): p
             for p in pods
@@ -256,6 +264,32 @@ class JaxJobController(Controller):
             self._ensure_service(job, pod)
         if created:
             self.emit_event(job, "PodsCreated", f"created {created} pods")
+
+    def _adopt_orphans(self, job: JaxJob, pods: list[Pod]) -> None:
+        """Pods matching this job's labels but missing its owner-ref are
+        ADOPTED (owner-ref patched in) rather than shadowed by a
+        recreate: after a control-plane crash a kubelet re-reports the
+        pods that outlived it, and those must re-enter ownership — the
+        ControllerRefManager adoption path [upstream: k8s
+        controller_ref_manager.go], which is what keeps a restart from
+        turning survivors into unadoptable orphans."""
+        for p in pods:
+            if any(r.kind == KIND_JAXJOB and r.name == job.metadata.name
+                   and r.controller for r in p.metadata.owner_references):
+                continue
+
+            def mut(o, ref=self._owner_ref(job)):
+                if not any(r.kind == ref.kind and r.name == ref.name
+                           for r in o.metadata.owner_references):
+                    o.metadata.owner_references.append(ref)
+
+            try:
+                self.store.update_with_retry(
+                    KIND_POD, p.metadata.name, p.metadata.namespace, mut)
+                self.emit_event(job, "PodAdopted",
+                                f"adopted orphaned pod {p.metadata.name}")
+            except NotFound:
+                pass  # raced deletion: nothing to adopt
 
     def _build_pod(self, job: JaxJob, rtype: str, idx: int) -> Pod:
         rspec = job.spec.replica_specs[rtype]

@@ -29,7 +29,7 @@ from dataclasses import dataclass
 from typing import Optional
 
 from ..controlplane.objects import KIND_POD, Pod, PodPhase
-from ..controlplane.store import DELETED, NotFound, Store, WatchEvent
+from ..controlplane.store import DELETED, TOO_OLD, NotFound, Store, WatchEvent
 from . import bootstrap
 
 log = logging.getLogger("kubeflow_tpu.kubelet")
@@ -109,6 +109,16 @@ class LocalKubelet:
                 ev: WatchEvent = self._watch.q.get_nowait()
             except queue.Empty:
                 return
+            if ev.type == TOO_OLD:
+                # the bounded watch overflowed and closed: events were
+                # dropped, so re-subscribe THEN relist — a pod deleted in
+                # the lost window has no store object; its process must
+                # still die, never linger unkilled
+                self._watch = self.store.watch([KIND_POD])
+                live = {p.key for p in self.store.list(KIND_POD)}
+                for key in [k for k in self._procs if k not in live]:
+                    self._kill(key)
+                continue
             if ev.type == DELETED and ev.obj.kind == KIND_POD:
                 self._kill(ev.obj.key)
 

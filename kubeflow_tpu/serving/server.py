@@ -212,9 +212,23 @@ class InferenceLogger:
                           self.url, e)
                 self.dropped += 1
 
-    def stop(self) -> None:
+    def stop(self, drain_timeout: float = 2.0) -> None:
+        """Graceful shutdown: give the pump up to ``drain_timeout``
+        seconds to deliver what is already enqueued BEFORE raising the
+        stop flag — stopping immediately silently discarded everything
+        still queued.  Whatever still could not be flushed is counted in
+        ``dropped``, never silently lost."""
+        deadline = time.monotonic() + max(0.0, drain_timeout)
+        while not self._q.empty() and time.monotonic() < deadline:
+            time.sleep(0.01)
         self._stop.set()
         self._thread.join(timeout=2)
+        while True:
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                break
+            self.dropped += 1
 
 
 class ModelServer:

@@ -21,6 +21,16 @@ this measures CONTROLLER recovery machinery, deterministically;
 gang_startup_bench.py's restart leg measures the full process-runtime
 path on top.
 
+The second row (ISSUE 5) is **cold restart**: kill -9 the control plane
+of a durable cluster holding a ≥200-object store with a gang mid-run,
+then time the restarted plane's
+
+- ``replay_s``      Store.open: snapshot + WAL replay back into memory
+- ``reconverge_s``  controllers start -> every worker Running again
+  (kubelet resync, orphan adoption, expectations rebuild)
+
+``cold_restart_recovery_s`` (the sum) is that row's headline.
+
 Usage: python scripts/recovery_bench.py [trials] [workers] [seed]
 """
 
@@ -162,6 +172,111 @@ def run_trial(i: int, workers: int, seed: int) -> dict:
             kubelet.stop()
 
 
+def run_restart_trial(i: int, workers: int, seed: int,
+                      n_objects: int = 200) -> dict:
+    """One cold-restart cycle: durable cluster, ≥n_objects store, gang
+    running, kill -9 at a seeded WAL offset past the warm state, restart,
+    reconverge."""
+    import shutil
+    import tempfile
+
+    from kubeflow_tpu.api import (
+        Container,
+        JaxJob,
+        ObjectMeta,
+        ReplicaSpec,
+        Resources,
+    )
+    from kubeflow_tpu.api.common import RestartPolicy
+    from kubeflow_tpu.chaos import FaultPlan
+    from kubeflow_tpu.controlplane import Cluster, FakeKubelet, KIND_POD, PodScript
+    from kubeflow_tpu.controlplane.objects import PodPhase, Service
+
+    name = f"cold-{i}"
+    data_dir = tempfile.mkdtemp(prefix="kft-recovery-bench-")
+    plan = FaultPlan(seed=seed + i).control_plane_crash(
+        after_records=10 ** 9, torn_bytes=13)
+    cp = plan.wal_crashpoint()
+    c = Cluster(data_dir=data_dir, wal_crashpoint=cp)
+    c.add_tpu_slice("s0", num_hosts=workers, chips_per_host=4)
+    kubelet = FakeKubelet(
+        c.store, lambda pod: PodScript(run_seconds=120.0), chaos=plan)
+    try:
+        c.start()
+        kubelet.start()
+        # the object-count ballast the replay has to chew through
+        for j in range(n_objects):
+            c.store.create(Service(metadata=ObjectMeta(name=f"ballast-{j}")))
+        c.store.create(JaxJob(
+            metadata=ObjectMeta(name=name),
+            spec={
+                "replica_specs": {
+                    "worker": ReplicaSpec(
+                        replicas=workers,
+                        restart_policy=RestartPolicy.ON_FAILURE,
+                        template=Container(
+                            resources=Resources(cpu=1, memory_gb=1, tpu=4)),
+                    )
+                },
+                "run_policy": {"backoff_limit": 3,
+                               "restart_backoff_seconds": 0.05},
+            },
+        ))
+
+        def all_running():
+            return sum(
+                p.status.phase == PodPhase.RUNNING
+                for p in c.store.list(KIND_POD)
+                if p.metadata.name.startswith(name + "-")) == workers
+
+        deadline = time.time() + 60
+        while time.time() < deadline and not all_running():
+            time.sleep(0.02)
+        assert all_running(), f"{name}: gang never warmed up"
+        # kill -9 at the next WAL append (seeded torn tail included)
+        cp.after_records = c.store.wal.appended_records
+        c.store.create(Service(metadata=ObjectMeta(name="the-last-write")))
+        assert cp.fired.wait(10), "crashpoint never fired"
+        kubelet.stop()
+        c.stop()
+
+        # Cluster construction is dominated by Store.open's replay
+        t0 = time.perf_counter()
+        c2 = Cluster(data_dir=data_dir)
+        replay_s = time.perf_counter() - t0
+        recovered = sum(len(c2.store.list(k))
+                        for k in ("JaxJob", "Pod", "Node", "Service"))
+
+        t1 = time.perf_counter()
+        kubelet.attach_store(c2.store)
+        kubelet.start()
+        c2.start()
+        try:
+            def reconverged():
+                return sum(
+                    p.status.phase == PodPhase.RUNNING
+                    for p in c2.store.list(KIND_POD)
+                    if p.metadata.name.startswith(name + "-")) == workers
+
+            deadline = time.time() + 60
+            while time.time() < deadline and not reconverged():
+                time.sleep(0.005)
+            assert reconverged(), f"{name}: never reconverged"
+            reconverge_s = time.perf_counter() - t1
+        finally:
+            kubelet.stop()
+            c2.stop()
+        return {
+            "cold_restart_recovery_s": replay_s + reconverge_s,
+            "replay_s": replay_s,
+            "reconverge_s": reconverge_s,
+            "objects_recovered": recovered,
+        }
+    finally:
+        kubelet.stop()
+        shutil.rmtree(data_dir, ignore_errors=True)
+
+
 def main() -> None:
     trials = int(sys.argv[1]) if len(sys.argv) > 1 else 12
     workers = int(sys.argv[2]) if len(sys.argv) > 2 else 4
@@ -185,6 +300,32 @@ def main() -> None:
                  f"n={trials}, workers={workers}, FakeKubelet cluster)"),
         **_percentiles([r["restart_to_running_s"] for r in rows]),
         "phase_p50": phase_p50,
+    }))
+
+    # cold restart: control-plane kill -9 -> WAL replay -> reconverged
+    n_objects = 200
+    restart_trials = max(3, trials // 3)
+    restart_rows = []
+    for i in range(restart_trials):
+        row = run_restart_trial(i, workers, seed, n_objects=n_objects)
+        restart_rows.append(row)
+        print("# cold-restart trial", i, json.dumps({
+            k: (round(v, 3) if isinstance(v, float) else v)
+            for k, v in row.items()}), file=sys.stderr)
+    restart_p50 = {}
+    for key in ("replay_s", "reconverge_s"):
+        vals = sorted(r[key] for r in restart_rows)
+        restart_p50[key] = round(vals[len(vals) // 2], 3)
+    print(json.dumps({
+        "metric": "cold_restart_recovery_p50_seconds",
+        "unit": (f"s (control-plane kill -9 -> WAL/snapshot replay of "
+                 f">={n_objects}-object store -> all workers Running, "
+                 f"n={restart_trials}, workers={workers}, "
+                 "FakeKubelet cluster)"),
+        **_percentiles(
+            [r["cold_restart_recovery_s"] for r in restart_rows]),
+        "phase_p50": restart_p50,
+        "objects_recovered": restart_rows[0]["objects_recovered"],
     }))
 
 

@@ -1,0 +1,502 @@
+"""Control-plane durability (ISSUE 5): WAL + snapshot recovery edges.
+
+The unit/integration half of the crash story — byte-level WAL edge
+cases (torn tail, mid-log corruption, snapshot+replay equivalence,
+``resourceVersion`` monotonicity), the bounded-watch TOO_OLD relist
+contract at all three layers (store, controller, apiserver), the
+request-body cap, the InferenceLogger drain-on-stop, and
+restart-mid-reconcile producing zero duplicate pods.  The seeded
+chaos kill/restart schedules live in tests/test_chaos.py.
+"""
+
+import json
+import os
+import socket
+import threading
+import time
+
+import pytest
+
+from kubeflow_tpu.api import Container, JaxJob, ObjectMeta, ReplicaSpec, Resources
+from kubeflow_tpu.api.common import RestartPolicy
+from kubeflow_tpu.api.jaxjob import KIND_JAXJOB
+from kubeflow_tpu.api.yaml_io import to_dict
+from kubeflow_tpu.chaos import FaultPlan
+from kubeflow_tpu.controlplane import Cluster, FakeKubelet, KIND_POD, PodScript
+from kubeflow_tpu.controlplane.apiserver import MAX_BODY_BYTES, ApiServer
+from kubeflow_tpu.controlplane.objects import KIND_SERVICE, PodPhase, Service
+from kubeflow_tpu.controlplane.store import TOO_OLD, Store, WatchEvent
+from kubeflow_tpu.controlplane.wal import LOG_NAME, SNAP_NAME, Wal, WalCorrupt
+
+
+def wait_for(fn, timeout=15.0, interval=0.02, desc="condition"):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        v = fn()
+        if v:
+            return v
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {desc}")
+
+
+def _svc(name, **labels):
+    return Service(metadata=ObjectMeta(name=name, labels=labels))
+
+
+def _dump(store):
+    """Canonical object-set image for replay-equivalence comparison."""
+    out = {}
+    for kind in ("Service", "Pod", "Node", "JaxJob"):
+        for o in store.list(kind):
+            out[(o.kind, o.key)] = to_dict(o)
+    return out
+
+
+class TestWalRecovery:
+    def test_replay_equivalence_and_rv_resume(self, tmp_path):
+        """Reopened store == live store, and the rv counter resumes past
+        everything recovered so optimistic concurrency holds."""
+        d = str(tmp_path)
+        s = Store.open(d, fsync_every=4)
+        for i in range(12):
+            s.create(_svc(f"svc-{i}"))
+        s.delete(KIND_SERVICE, "svc-3")
+        s.update_with_retry(
+            KIND_SERVICE, "svc-5", "default",
+            lambda o: o.metadata.labels.update({"touched": "yes"}))
+        live, live_rv = _dump(s), s._last_rv
+        s.close()
+
+        s2 = Store.open(d)
+        assert _dump(s2) == live
+        assert s2._last_rv == live_rv
+        assert s2.try_get(KIND_SERVICE, "svc-3") is None  # delete replayed
+        assert s2.get(KIND_SERVICE, "svc-5").metadata.labels["touched"] == "yes"
+        # a post-restart write wins any rv conflict against recovered state
+        created = s2.create(_svc("after"))
+        assert created.metadata.resource_version > live_rv
+        s2.close()
+
+    def test_rv_strictly_monotonic_across_many_restarts(self, tmp_path):
+        d = str(tmp_path)
+        seen = []
+        for gen in range(4):
+            s = Store.open(d)
+            obj = s.create(_svc(f"gen-{gen}"))
+            seen.append(obj.metadata.resource_version)
+            s.close()
+        assert seen == sorted(set(seen)), seen
+
+    def test_torn_tail_tolerated_and_truncated(self, tmp_path):
+        """A record cut mid-write by the crash is dropped and the file
+        truncated back — that write was never acknowledged durable."""
+        d = str(tmp_path)
+        s = Store.open(d)
+        for i in range(5):
+            s.create(_svc(f"svc-{i}"))
+        s.close()
+        log_path = os.path.join(d, LOG_NAME)
+        good = os.path.getsize(log_path)
+        for torn in (b"d3adb33f {\"rv\": 99",        # no newline
+                     b"00000000 {\"rv\": 99}\n"):    # bad CRC at tail
+            with open(log_path, "ab") as f:
+                f.write(torn)
+            s2 = Store.open(d)
+            assert len(s2.list(KIND_SERVICE)) == 5
+            s2.close()
+            assert os.path.getsize(log_path) == good  # truncated back
+
+    def test_midlog_corruption_fails_loudly(self, tmp_path):
+        """A bad record with committed records AFTER it means the medium
+        lied — replay must raise, never silently skip history."""
+        d = str(tmp_path)
+        s = Store.open(d)
+        for i in range(6):
+            s.create(_svc(f"svc-{i}"))
+        s.close()
+        log_path = os.path.join(d, LOG_NAME)
+        lines = open(log_path, "rb").read().splitlines(keepends=True)
+        assert len(lines) >= 3
+        lines[1] = b"00000000" + lines[1][8:]  # CRC now wrong, not the tail
+        with open(log_path, "wb") as f:
+            f.writelines(lines)
+        with pytest.raises(WalCorrupt):
+            Store.open(d)
+
+    def test_snapshot_compaction_replay(self, tmp_path):
+        """Past ``snapshot_every`` the log compacts into snapshot.json;
+        replay = snapshot + newer records, same object set."""
+        d = str(tmp_path)
+        s = Store.open(d, snapshot_every=8)
+        for i in range(30):
+            s.create(_svc(f"svc-{i}"))
+        s.delete(KIND_SERVICE, "svc-0")
+        live = _dump(s)
+        s.close()
+        assert os.path.exists(os.path.join(d, SNAP_NAME))
+        # compaction kept the log to the post-snapshot suffix
+        raw = open(os.path.join(d, LOG_NAME), "rb").read()
+        assert 0 < len(raw.splitlines()) <= 8
+        s2 = Store.open(d)
+        assert _dump(s2) == live
+        s2.close()
+
+    def test_stale_records_behind_snapshot_skipped(self, tmp_path):
+        """A crash between snapshot rename and log truncation leaves
+        already-snapshotted records in the log; replay filters them by
+        rv instead of double-applying."""
+        d = str(tmp_path)
+        s = Store.open(d)
+        for i in range(4):
+            s.create(_svc(f"svc-{i}"))
+        live = _dump(s)
+        # snapshot everything, then put the pre-snapshot records BACK
+        # (the crash-between-rename-and-truncate picture)
+        stale = open(os.path.join(d, LOG_NAME), "rb").read()
+        s._wal.write_snapshot(
+            s._last_rv, [to_dict(o) for o in s._objs.values()])
+        s.close()
+        with open(os.path.join(d, LOG_NAME), "ab") as f:
+            f.write(stale)
+        s2 = Store.open(d)
+        assert _dump(s2) == live
+        assert s2._last_rv == 4
+        s2.close()
+
+    def test_crashpoint_drops_later_writes_and_tears_tail(self, tmp_path):
+        """The chaos kill switch: at the seeded offset nothing later
+        persists and at most torn_bytes of the in-flight record do."""
+        d = str(tmp_path)
+        plan = FaultPlan(seed=3).control_plane_crash(after_records=3,
+                                                     torn_bytes=9)
+        cp = plan.wal_crashpoint()
+        assert plan.wal_crashpoint() is cp  # memoized: one shared handle
+        s = Store.open(d, crashpoint=cp)
+        for i in range(10):
+            s.create(_svc(f"svc-{i}"))
+        assert cp.fired.is_set()
+        assert len(s.list(KIND_SERVICE)) == 10  # the dying process's view
+        s.close()
+        s2 = Store.open(d)  # recovery: 3 durable records, tail torn away
+        assert sorted(o.metadata.name for o in s2.list(KIND_SERVICE)) == [
+            "svc-0", "svc-1", "svc-2"]
+        s2.close()
+
+    def test_oversized_torn_bytes_never_persists_whole_record(self, tmp_path):
+        """torn_bytes past the record length clamps below it — the
+        in-flight write died with the machine, it must NOT replay as
+        committed."""
+        d = str(tmp_path)
+        plan = FaultPlan(seed=1).control_plane_crash(after_records=2,
+                                                     torn_bytes=10_000)
+        s = Store.open(d, crashpoint=plan.wal_crashpoint())
+        for i in range(4):
+            s.create(_svc(f"svc-{i}"))
+        s.close()
+        s2 = Store.open(d)
+        assert sorted(o.metadata.name for o in s2.list(KIND_SERVICE)) == [
+            "svc-0", "svc-1"]
+        s2.close()
+
+    def test_compaction_triggers_across_restarts(self, tmp_path):
+        """The reopened log's backlog counts toward snapshot_every: a
+        plane restarted every few writes still compacts instead of
+        growing wal.jsonl forever."""
+        d = str(tmp_path)
+        for gen in range(4):
+            s = Store.open(d, snapshot_every=8)
+            for i in range(3):  # 3 < snapshot_every per incarnation
+                s.create(_svc(f"g{gen}-{i}"))
+            s.close()
+        assert os.path.exists(os.path.join(d, SNAP_NAME))
+        raw = open(os.path.join(d, LOG_NAME), "rb").read()
+        assert len(raw.splitlines()) < 12  # compacted, not 12 records
+        s = Store.open(d)
+        assert len(s.list(KIND_SERVICE)) == 12
+        s.close()
+
+    def test_wal_append_after_close_is_noop(self, tmp_path):
+        w = Wal(str(tmp_path))
+        w.recover()
+        w.append({"rv": 1, "op": "put", "obj": {}})
+        w.close()
+        w.append({"rv": 2, "op": "put", "obj": {}})  # must not raise
+        w2 = Wal(str(tmp_path))
+        _, _, records = w2.recover()
+        assert [r["rv"] for r in records] == [1]
+        w2.close()
+
+
+class TestBoundedWatch:
+    def test_overflow_closes_watch_with_too_old_marker(self):
+        """A slow subscriber's queue hits its bound: the watch closes
+        with a TOO_OLD marker instead of growing memory or silently
+        dropping events."""
+        s = Store()
+        w = s.watch([KIND_SERVICE], maxsize=4)
+        for i in range(8):
+            s.create(_svc(f"svc-{i}"))
+        assert w.closed and w.too_old
+        assert w not in s._watches  # no further fan-out to it
+        events = []
+        while not w.q.empty():
+            events.append(w.q.get_nowait())
+        assert events[-1].type == TOO_OLD and events[-1].obj is None
+        # bounded: never held more than maxsize events
+        assert len(events) <= 4
+
+    def test_healthy_watch_unaffected(self):
+        s = Store()
+        w = s.watch([KIND_SERVICE])
+        s.create(_svc("a"))
+        ev = w.q.get(timeout=1)
+        assert ev.type == "ADDED" and ev.obj.metadata.name == "a"
+        assert not w.too_old
+
+    def test_controller_relists_after_too_old(self):
+        """A controller that sees TOO_OLD re-watches and relists — the
+        overflowed events are recovered by listing, never missed."""
+        from kubeflow_tpu.controlplane.jaxjob_controller import JaxJobController
+
+        c = Cluster()
+        c.add_tpu_slice("s0", num_hosts=2, chips_per_host=4)
+        kubelet = FakeKubelet(
+            c.store, lambda pod: PodScript(run_seconds=30.0))
+        with c:
+            kubelet.start()
+            try:
+                ctrl = next(x for x in c.controllers
+                            if isinstance(x, JaxJobController))
+                c.store.create(JaxJob(
+                    metadata=ObjectMeta(name="j"),
+                    spec={"replica_specs": {"worker": ReplicaSpec(
+                        replicas=2,
+                        template=Container(
+                            resources=Resources(cpu=1, memory_gb=1, tpu=4)),
+                    )}}))
+                wait_for(
+                    lambda: sum(
+                        p.status.phase == PodPhase.RUNNING
+                        for p in c.store.list(KIND_POD)) == 2,
+                    desc="gang running")
+                # simulate the overflow: store closed the watch and left
+                # the marker; then delete a pod THROUGH the store (an
+                # event the dead watch never delivers)
+                old_watch = ctrl._watch
+                c.store.stop_watch(old_watch)
+                victim = c.store.list(KIND_POD)[0]
+                c.store.delete(KIND_POD, victim.metadata.name,
+                               victim.metadata.namespace)
+                old_watch.q.put(WatchEvent(TOO_OLD, None))
+                # the relist must notice the missing gang member and the
+                # controller re-create it
+                wait_for(
+                    lambda: sum(
+                        p.status.phase == PodPhase.RUNNING
+                        for p in c.store.list(KIND_POD)) == 2,
+                    desc="gang re-formed after relist")
+                assert ctrl._watch is not old_watch
+            finally:
+                kubelet.stop()
+
+    def test_local_kubelet_relists_and_kills_on_too_old(self, tmp_path):
+        """The real runtime's deletion watcher: a TOO_OLD marker means
+        deletes were dropped — it must re-subscribe and kill any local
+        process whose pod no longer exists, never leave it unkilled."""
+        from kubeflow_tpu.runtime.launcher import LocalKubelet
+
+        s = Store()
+        k = LocalKubelet(s, root_dir=str(tmp_path))
+        k._watch = s.watch([KIND_POD])
+        killed = []
+        k._kill = killed.append
+        k._procs = {"default/ghost": object()}  # pod deleted in the gap
+        old_watch = k._watch
+        old_watch.q.put(WatchEvent(TOO_OLD, None))
+        k._drain_deletions()
+        assert killed == ["default/ghost"]
+        assert k._watch is not old_watch  # fresh subscription
+
+    def test_apiserver_pump_resubscribes_and_410s_cursors(self):
+        """The apiserver's store watch overflowing expires EVERY client
+        cursor (410 Gone) — events dropped before they got a seq can
+        never be resumed over."""
+        import urllib.error
+        import urllib.request
+
+        s = Store()
+        api = ApiServer(s)
+        try:
+            s.create(_svc("first"))
+            # a client cursor established before the overflow
+            with urllib.request.urlopen(
+                    f"{api.url}/apis/Service?watch=1&cursor=0&timeout=5",
+                    timeout=10) as r:
+                cursor = json.load(r)["cursor"]
+            assert cursor >= 1
+            old_watch = api._store_watch
+            old_watch.q.put(WatchEvent(TOO_OLD, None))
+            wait_for(lambda: api._store_watch is not old_watch,
+                     desc="pump resubscribe")
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    f"{api.url}/apis/Service?watch=1&cursor={cursor}"
+                    "&timeout=5", timeout=10)
+            assert ei.value.code == 410
+            # the new subscription still delivers future events
+            s.create(_svc("second"))
+            resync = json.loads(ei.value.read())["cursor"]
+            with urllib.request.urlopen(
+                    f"{api.url}/apis/Service?watch=1&cursor={resync}"
+                    "&timeout=5", timeout=10) as r:
+                items = json.load(r)["items"]
+            assert any(i["object"]["metadata"]["name"] == "second"
+                       for i in items)
+        finally:
+            api.stop()
+
+
+class TestBodyCap:
+    def test_oversized_content_length_rejected_413(self):
+        """The server must not allocate whatever the client's
+        Content-Length claims — reject before reading."""
+        s = Store()
+        api = ApiServer(s)
+        try:
+            with socket.create_connection(("127.0.0.1", api.port),
+                                          timeout=5) as sock:
+                sock.sendall(
+                    b"POST /apis/Service HTTP/1.1\r\n"
+                    b"Host: x\r\nContent-Type: application/json\r\n"
+                    + f"Content-Length: {MAX_BODY_BYTES + 1}\r\n\r\n".encode())
+                sock.settimeout(5)
+                # the server closes the poisoned connection: read to EOF
+                # (status line and JSON body may arrive in separate
+                # segments)
+                chunks = []
+                while True:
+                    b = sock.recv(4096)
+                    if not b:
+                        break
+                    chunks.append(b)
+                head = b"".join(chunks).decode()
+            assert " 413 " in head.splitlines()[0]
+            assert "RequestEntityTooLarge" in head
+            # the server is still healthy for well-formed requests
+            import urllib.request
+
+            with urllib.request.urlopen(f"{api.url}/healthz", timeout=5) as r:
+                assert json.load(r)["ok"] is True
+        finally:
+            api.stop()
+
+
+class TestRestartMidReconcile:
+    def test_zero_duplicate_pods_after_crash_during_scaleup(self, tmp_path):
+        """kill -9 while the controller is mid-way through creating gang
+        pods: the restarted control plane rebuilds Expectations from
+        observed pods and adopts kubelet-re-reported survivors — never
+        double-creates a (replica-type, index) slot."""
+        d = str(tmp_path / "data")
+        plan = FaultPlan(seed=11).control_plane_crash(after_records=10,
+                                                      torn_bytes=7)
+        cp = plan.wal_crashpoint()
+        c = Cluster(data_dir=d, wal_crashpoint=cp)
+        c.add_tpu_slice("s0", num_hosts=4, chips_per_host=4)
+        kubelet = FakeKubelet(
+            c.store, lambda pod: PodScript(run_seconds=60.0), chaos=plan)
+        c.start()
+        kubelet.start()
+        try:
+            c.store.create(JaxJob(
+                metadata=ObjectMeta(name="j"),
+                spec={"replica_specs": {"worker": ReplicaSpec(
+                    replicas=4, restart_policy=RestartPolicy.ON_FAILURE,
+                    template=Container(
+                        resources=Resources(cpu=1, memory_gb=1, tpu=4)),
+                )}}))
+            assert cp.fired.wait(20), "crashpoint never fired"
+        finally:
+            c.stop()  # the dead incarnation's threads reaped
+
+        c2 = Cluster(data_dir=d)
+        kubelet.attach_store(c2.store)  # node survived; relist BEFORE start
+        c2.start()
+        try:
+            wait_for(
+                lambda: sum(
+                    p.status.phase == PodPhase.RUNNING
+                    for p in c2.store.list(KIND_POD)
+                    if p.metadata.name.startswith("j-")) == 4,
+                desc="gang running after restart")
+            pods = [p for p in c2.store.list(KIND_POD)
+                    if p.metadata.name.startswith("j-")]
+            slots = [(p.metadata.labels.get("replica-type"),
+                      p.metadata.labels.get("replica-index"))
+                     for p in pods]
+            assert len(pods) == 4
+            assert len(set(slots)) == 4, f"duplicate slots: {slots}"
+            # zero orphans: every pod owned by the recovered job
+            assert all(
+                any(r.kind == KIND_JAXJOB and r.name == "j" and r.controller
+                    for r in p.metadata.owner_references)
+                for p in pods)
+        finally:
+            kubelet.stop()
+            c2.stop()
+
+
+class TestInferenceLoggerDrain:
+    def _sink(self, delay=0.0):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        hits = []
+
+        class H(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                pass
+
+            def do_POST(self):
+                if delay:
+                    time.sleep(delay)
+                n = int(self.headers.get("Content-Length", 0))
+                hits.append(json.loads(self.rfile.read(n)))
+                self.send_response(200)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+
+        httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        httpd.daemon_threads = True
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        return httpd, f"http://127.0.0.1:{httpd.server_address[1]}", hits
+
+    def test_stop_drains_queued_events(self):
+        """Events enqueued before stop() are delivered, not silently
+        dropped with the pump's exit."""
+        from kubeflow_tpu.serving.server import InferenceLogger
+
+        httpd, url, hits = self._sink(delay=0.02)
+        try:
+            logger = InferenceLogger(url, service="svc")
+            for i in range(10):
+                logger.log("request", "m", f"r{i}", {"i": i})
+            logger.stop(drain_timeout=10.0)
+            assert len(hits) + logger.dropped == 10
+            assert len(hits) == 10, f"dropped {logger.dropped} on shutdown"
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+
+    def test_undeliverable_remainder_counted_dropped(self):
+        """A dead sink under a tight deadline: what could not be flushed
+        lands in ``dropped`` instead of vanishing."""
+        from kubeflow_tpu.serving.server import InferenceLogger
+
+        # nothing listens on this port (connect fails fast)
+        logger = InferenceLogger("http://127.0.0.1:9/", service="svc")
+        logger._stop.set()  # park the pump path: nothing will drain
+        logger._thread.join(timeout=2)
+        for i in range(5):
+            logger.log("request", "m", f"r{i}", {"i": i})
+        logger.stop(drain_timeout=0.1)
+        assert logger.dropped == 5
