@@ -8,7 +8,12 @@ serializes host and device and re-introduces the per-token round trip
 the dispatch-ahead pipeline exists to hide.  The rule builds the
 intra-file call graph from every ``*Engine`` class's scheduler roots
 (``_loop``/``_admit``/``_process``...) and flags host-materialization
-calls in anything reachable.  The engine DOES need exactly one fetch
+calls in anything reachable.  ``*Allocator`` classes (the paged-KV
+block economy, serving/paged.py) sit ON the dispatch path — every
+admission and block-table assembly runs them between dispatches — so
+ALL their methods are roots: block-table math must stay host-side
+numpy, and a ``.item()`` on the free list can never ride along
+undeclared.  The engine DOES need exactly one fetch
 boundary (delivering sampled tokens) and host-side numpy scheduler math
 is legitimate — those sites carry ``# analysis: ok host-sync-in-dispatch``
 pragmas, which is the point: the boundary is *declared*, so a new
@@ -222,6 +227,15 @@ def host_sync_in_dispatch(ctx: LintContext) -> Iterable[Finding]:
             f"{cls}.{m}"
             for cls in graph.classes if cls.endswith("Engine")
             for m in ROOT_METHODS
+        ]
+        # paged-KV allocators run between dispatches on the scheduler
+        # thread: EVERY method is dispatch-path (block-table assembly,
+        # free-list pops, prefix matching) — host numpy only
+        roots += [
+            qual
+            for cls, methods in graph.by_class.items()
+            if cls.endswith("Allocator")
+            for qual in methods.values()
         ]
         if not roots:
             continue

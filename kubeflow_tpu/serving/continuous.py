@@ -9,22 +9,35 @@ token boundaries instead of waiting for the current batch to finish
 one token after a 64-token batch started waits ~64 token-steps for its
 first token.  This module removes that wait.
 
-TPU-first design (vs vLLM's CUDA paged-attention pool):
+TPU-first design (vs vLLM's CUDA paged-attention kernels):
 
-- **Slot pool, not pages.**  A fixed-shape KV cache of ``num_slots`` rows
-  (the per-row-position cache from models/llama.py `_decode_attend`):
-  XLA wants static shapes, so the pool is compiled once and requests map
-  onto *slots*.  A retired slot is reused without clearing — the per-row
-  causal mask makes stale KV past a row's live front invisible, exactly
-  the ragged-batch argument LlamaGenerator already relies on.
-- **Prefill as a batch-1 bucketed program, merged by scatter.**  Prompt
-  prefill runs on a [1, bucket] shape (cost ∝ prompt, not ∝ pool) and a
-  separate jitted merge scatters the row cache into the pool at the slot
-  index.  One compile per bucket, one for the merge.
+- **Block economy, gathered per dispatch (ISSUE 6).**  KV lives in a
+  pool of fixed-size BLOCKS owned by a free-list allocator
+  (serving/paged.py BlockAllocator); each request holds a block table
+  and pays HBM for its actual length, not ``max_seq_len``.  XLA wants
+  static shapes and the model's decode math wants a contiguous per-row
+  cache, so every paged dispatch GATHERS its working view from the
+  block pool (per-slot block tables -> the exact [slots, attend, ...]
+  layout the slot-pool programs consumed), runs the byte-identical
+  decode/prefill/verify math, and scatters the written blocks back.
+  Views are warmed per attend rung, so ``jit_recompiles_total`` stays 0.
+  Prefixes share in block quanta across live AND retired sequences
+  (refcounts; the free list doubles as the prefix cache), a diverging
+  request forks the boundary block with one on-device copy (COW), and a
+  freed block is reused without clearing — the per-row causal mask
+  makes stale KV past a row's live front invisible, exactly the
+  ragged-batch argument LlamaGenerator already relies on.  The legacy
+  contiguous slot pool (``block_size=0``) survives as the parity
+  reference the paged programs are pinned bit-identical against.
+- **Prefill rides the decode dispatch.**  Chunked (Sarathi) admission
+  fuses one prefill chunk into each pool decode scan; in paged mode the
+  chunk writes land in the admitting slot's blocks through the same
+  gathered view (one gather, one scatter per dispatch).  The legacy
+  pool keeps its batch-prefill + scatter-merge admission.
 - **Decode as a chunked scan over the whole pool.**  Each dispatch runs
   ``decode_chunk`` sampling steps for ALL slots in one ``lax.scan``
   program; inactive slots ride along with their cache writes dropped
-  (position pinned past ``max_seq_len``).  Chunking amortizes the
+  (position pinned past the view).  Chunking amortizes the
   host round trip that dominates per-token latency on a remote-dispatch
   backend (PERF.md: 16.8 ms/token floor through the tunnel); admission
   happens between chunks, so ``decode_chunk=1`` gives strict
@@ -53,6 +66,8 @@ from ..analysis.runtime import RecompileCounter, recompile_guard
 from ..models import llama as llamalib
 from . import sharded as shardedlib
 from .model import Model
+from .paged import BlockAllocator, gather_block_view, scatter_block_view
+from .paged import lcp as _lcp  # noqa: F401 — the one LCP implementation
 from .storage import fetch_mem
 
 log = logging.getLogger("kubeflow_tpu.serving")
@@ -102,19 +117,6 @@ class Request:
         if self.first_token_at is None:
             return None
         return self.first_token_at - self.submitted_at
-
-
-def _lcp(content: list[int], prompt_arr: np.ndarray, cap: int) -> int:
-    """Longest common prefix of ``content`` and the prompt (as int64
-    array), capped — vectorized: this runs per segment/slot per
-    admission on the scheduler thread."""
-    n = min(len(content), cap)
-    if n <= 0:
-        return 0
-    # analysis: ok host-sync-in-dispatch — host token list, no device value
-    c = np.asarray(content[:n], np.int64)
-    neq = np.nonzero(c != prompt_arr[:n])[0]
-    return int(neq[0]) if neq.size else n
 
 
 def cache_shapes(cfg: llamalib.LlamaConfig, batch: int):
@@ -534,6 +536,197 @@ def make_decode_program(cfg, attend: int, chunk: int, mesh=None):
     return shardedlib.mesh_jit(mesh, decode, donate_argnums=(1, 2))
 
 
+def _paged_view_len(attend: int, block_size: int) -> int:
+    """Gathered-view length for an attend rung: whole blocks covering it
+    (== the rung whenever block_size divides it; the model still attends
+    only [0, attend), so the math stays bit-identical to the slot pool)."""
+    return -(-attend // block_size) * block_size
+
+
+def make_paged_decode_program(cfg, attend: int, chunk: int, block_size: int,
+                              block_axes, seq_axes, mesh=None):
+    """Paged twin of :func:`make_decode_program`: gather each slot's
+    block table into the contiguous working view, run the identical
+    ``chunk``-step sampling scan, scatter the written blocks back.
+    Signature: (params, pool, logits, bt [slots, nblk], positions,
+    active, temps, top_ps, top_ks, key) -> (pool, logits, toks); pool +
+    logits donated.  The inactive-row sentinel pins to the VIEW length
+    (>= attend), where the per-row scatter's mode="drop" discards the
+    write exactly as max_seq_len does in the slot pool."""
+    wmodel = llamalib.Llama(cfg, decode_attend_len=attend)
+    view_len = _paged_view_len(attend, block_size)
+
+    def decode(params, pool, logits, bt, positions, active, temps,
+               top_ps, top_ks, key):
+        view = shardedlib.constrain_cache(
+            gather_block_view(pool, bt, block_axes, seq_axes), mesh)
+        safe = jnp.where(active, positions, view_len)
+
+        def step(carry, key):
+            cache, logits, pos = carry
+            tok = _sample_step(logits, temps, top_ps, top_ks, key)
+            l, mutated = wmodel.apply(
+                {"params": params, "cache": cache}, tok[:, None],
+                pos[:, None], decode=True, mutable=["cache"])
+            nxt = jnp.where(active, pos + 1, view_len)
+            return (shardedlib.constrain_cache(mutated["cache"], mesh),
+                    shardedlib.constrain_logits(l[:, -1, :], mesh),
+                    nxt), tok
+
+        keys = jax.random.split(key, chunk)
+        (view, logits, _pos), toks = jax.lax.scan(
+            step, (view, logits, safe), keys)
+        pool = shardedlib.constrain_cache(
+            scatter_block_view(pool, view, bt, block_axes, seq_axes), mesh)
+        return pool, logits, shardedlib.constrain_replicated(toks.T, mesh)
+
+    return shardedlib.mesh_jit(mesh, decode, donate_argnums=(1, 2))
+
+
+def make_paged_chunk_prefill_program(cfg, attend: int, budget: int,
+                                     block_size: int, block_axes, seq_axes,
+                                     mesh=None):
+    """One ``budget``-token prefill chunk against the admitting slot's
+    OWN blocks: gather just that slot's table row ([1, nblk]), run the
+    shared chunk body on the single-row view, scatter the blocks back.
+    Signature: (params, pool, logits, bt_row [1, nblk], toks [budget],
+    start, length, write_slot) -> (pool, logits); pool + logits donated.
+    """
+    wmodel = llamalib.Llama(cfg, decode_attend_len=attend)
+    body = _chunk_prefill_body(cfg, wmodel, budget, block_axes, mesh)
+
+    def chunk(params, pool, logits, bt_row, toks, start, length,
+              write_slot):
+        view = gather_block_view(pool, bt_row, block_axes, seq_axes)
+        view, logits = body(params, view, logits, jnp.int32(0), toks,
+                            start, length, write_slot)
+        pool = shardedlib.constrain_cache(
+            scatter_block_view(pool, view, bt_row, block_axes, seq_axes),
+            mesh)
+        return pool, shardedlib.constrain_logits(logits, mesh)
+
+    return shardedlib.mesh_jit(mesh, chunk, donate_argnums=(1, 2))
+
+
+def make_paged_fused_step_program(cfg, attend: int, chunk: int, budget: int,
+                                  block_size: int, block_axes, seq_axes,
+                                  mesh=None):
+    """Paged twin of :func:`make_fused_step_program`: ONE gather serves
+    both halves — the admitting slot's prefill chunk writes into its
+    blocks through the same view the whole-pool decode scan runs on,
+    and one scatter commits everything.  Inactive rows (the admitting
+    one included) KEEP their logits through the scan, exactly the r6
+    fused-step rule."""
+    wmodel = llamalib.Llama(cfg, decode_attend_len=attend)
+    body = _chunk_prefill_body(cfg, wmodel, budget, block_axes, mesh)
+    view_len = _paged_view_len(attend, block_size)
+
+    def fused(params, pool, logits, bt, slot, toks, start, length,
+              write_slot, positions, active, temps, top_ps, top_ks, key):
+        view = shardedlib.constrain_cache(
+            gather_block_view(pool, bt, block_axes, seq_axes), mesh)
+        view, logits = body(params, view, logits, slot, toks, start,
+                            length, write_slot)
+        safe = jnp.where(active, positions, view_len)
+
+        def step(carry, key):
+            cache, logits, pos = carry
+            tok = _sample_step(logits, temps, top_ps, top_ks, key)
+            l, mutated = wmodel.apply(
+                {"params": params, "cache": cache}, tok[:, None],
+                pos[:, None], decode=True, mutable=["cache"])
+            nxt = jnp.where(active, pos + 1, view_len)
+            kept = jnp.where(active[:, None], l[:, -1, :], logits)
+            return (shardedlib.constrain_cache(mutated["cache"], mesh),
+                    shardedlib.constrain_logits(kept, mesh),
+                    nxt), tok
+
+        keys = jax.random.split(key, chunk)
+        (view, logits, _pos), out = jax.lax.scan(
+            step, (view, logits, safe), keys)
+        pool = shardedlib.constrain_cache(
+            scatter_block_view(pool, view, bt, block_axes, seq_axes), mesh)
+        return pool, logits, shardedlib.constrain_replicated(out.T, mesh)
+
+    return shardedlib.mesh_jit(mesh, fused, donate_argnums=(1, 2))
+
+
+def make_paged_verify_program(cfg, attend: int, k: int, block_size: int,
+                              block_axes, seq_axes, mesh=None):
+    """Paged twin of :func:`make_verify_program`: gather, the identical
+    speculative-verify math (:func:`_verify_math` — the inactive-row
+    sentinel retargeted to the view length), scatter."""
+    import dataclasses as _dc
+
+    wmodel = llamalib.Llama(cfg, decode_attend_len=attend)
+    view_len = _paged_view_len(attend, block_size)
+    vmath = _verify_math(
+        _dc.replace(cfg, max_seq_len=view_len), wmodel, k, mesh)
+
+    def verify(params, pool, logits, bt, drafts, banned, positions,
+               active, temps, top_ps, top_ks, key):
+        view = shardedlib.constrain_cache(
+            gather_block_view(pool, bt, block_axes, seq_axes), mesh)
+        view, logits, toks, accept = vmath(
+            params, view, logits, drafts, banned, positions, active,
+            temps, top_ps, top_ks, key)
+        pool = shardedlib.constrain_cache(
+            scatter_block_view(pool, view, bt, block_axes, seq_axes), mesh)
+        return pool, logits, toks, accept
+
+    return shardedlib.mesh_jit(mesh, verify, donate_argnums=(1, 2))
+
+
+def make_paged_fused_verify_program(cfg, attend: int, k: int, budget: int,
+                                    block_size: int, block_axes, seq_axes,
+                                    mesh=None):
+    """Paged twin of :func:`make_fused_verify_program`: one gather, the
+    chunk body, the verify math, one scatter."""
+    import dataclasses as _dc
+
+    wmodel = llamalib.Llama(cfg, decode_attend_len=attend)
+    body = _chunk_prefill_body(cfg, wmodel, budget, block_axes, mesh)
+    view_len = _paged_view_len(attend, block_size)
+    vmath = _verify_math(
+        _dc.replace(cfg, max_seq_len=view_len), wmodel, k, mesh)
+
+    def fused(params, pool, logits, bt, slot, toks, start, length,
+              write_slot, drafts, banned, positions, active, temps,
+              top_ps, top_ks, key):
+        view = shardedlib.constrain_cache(
+            gather_block_view(pool, bt, block_axes, seq_axes), mesh)
+        view, logits = body(params, view, logits, slot, toks, start,
+                            length, write_slot)
+        view, logits, vtoks, accept = vmath(
+            params, view, logits, drafts, banned, positions, active,
+            temps, top_ps, top_ks, key)
+        pool = shardedlib.constrain_cache(
+            scatter_block_view(pool, view, bt, block_axes, seq_axes), mesh)
+        return pool, logits, vtoks, accept
+
+    return shardedlib.mesh_jit(mesh, fused, donate_argnums=(1, 2))
+
+
+def make_block_copy_program(block_axes, mesh=None):
+    """COW fork: copy ONE block's bytes (src -> dst) across every cache
+    leaf — the on-device dispatch that lets a request diverge inside a
+    shared prefix block without touching the source.  dst out of range
+    (the warmup sentinel) drops; src clips.  Pool donated."""
+
+    def copy(pool, src, dst):
+        def leaf(c, a):
+            if a is None:
+                return c
+            row = jnp.take(c, src, axis=a, mode="clip")
+            idx = (slice(None),) * a + (dst,)
+            return c.at[idx].set(row, mode="drop")
+
+        return shardedlib.constrain_cache(
+            jax.tree.map(leaf, pool, block_axes), mesh)
+
+    return shardedlib.mesh_jit(mesh, copy, donate_argnums=(0,))
+
+
 class DraftProposer:
     """Draft-token source for speculative decoding (ISSUE 4).
 
@@ -810,7 +1003,35 @@ class ContinuousEngine:
                     content: admission becomes an on-device prefix copy +
                     suffix-only prefill (make_prefix_admit_program) —
                     repeated system prompts / conversation re-sends skip
-                    their shared prefill entirely.
+                    their shared prefill entirely.  Under the paged pool
+                    the same knob governs BLOCK-granular sharing: full
+                    prefix blocks are shared by refcount (zero copy),
+                    the boundary block forks with one COW dispatch, and
+                    retired sequences stay matchable until their blocks
+                    are actually reused.
+    block_size:     0 = the legacy contiguous slot pool.  > 0 = the
+                    PAGED-KV block pool (ISSUE 6): KV lives in
+                    ``num_blocks`` blocks of ``block_size`` tokens
+                    owned by a free-list allocator; requests hold block
+                    tables and pay HBM for their actual length.  Every
+                    dispatch gathers per-slot block tables into the
+                    contiguous working view the slot-pool programs
+                    consumed (warmed per attend rung — zero steady-state
+                    recompiles), so greedy tokens are BIT-IDENTICAL to
+                    the slot pool.  Admission reserves the request's
+                    full worst-case span (prompt + max_new_tokens) up
+                    front — insufficient free blocks queue the request
+                    (backpressure), never a mid-decode eviction.
+                    Supersedes ``prefix_segments`` (block-granular
+                    sharing subsumes whole-segment LCP); combining them
+                    is a config error.
+    num_blocks:     paged pool size; 0 derives slot-pool capacity parity
+                    (num_slots * ceil(max_seq_len / block_size)).
+    admission_policy: optional host callable(req) -> bool consulted at
+                    admission (scheduler thread); False defers the
+                    request without consuming a slot.  The tier ladder
+                    rides this hook (TieredEngine) instead of owning
+                    per-tier KV pools.
     """
 
     def __init__(
@@ -834,6 +1055,9 @@ class ContinuousEngine:
         spec_k: int = 0,
         spec_ngram: int = 3,
         draft_proposer: Optional[DraftProposer] = None,
+        block_size: int = 0,
+        num_blocks: int = 0,
+        admission_policy=None,
     ):
         if num_slots < 1:
             raise ValueError("num_slots must be >= 1")
@@ -847,6 +1071,19 @@ class ContinuousEngine:
             raise ValueError("spec_k must be >= 0 (0 = off)")
         if spec_ngram < 1:
             raise ValueError("spec_ngram must be >= 1")
+        if block_size < 0:
+            raise ValueError("block_size must be >= 0 (0 = slot pool)")
+        if num_blocks < 0:
+            raise ValueError("num_blocks must be >= 0 (0 = derived)")
+        if block_size > 0 and int(prefix_segments) > 0:
+            raise ValueError(
+                "prefix_segments is superseded by the paged pool: "
+                "block-granular sharing subsumes whole-segment LCP — "
+                "drop prefix_segments or set block_size=0")
+        if 0 < cfg.max_seq_len <= block_size:
+            raise ValueError(
+                f"block_size {block_size} must be < max_seq_len "
+                f"{cfg.max_seq_len}")
         self.cfg = cfg
         self.mesh = (
             shardedlib.build_serving_mesh(mesh_axes) if mesh_axes else None)
@@ -877,6 +1114,25 @@ class ContinuousEngine:
         self.spec_k = int(spec_k)
         self.spec_ngram = int(spec_ngram)
         self._proposer = draft_proposer or NgramProposer(self.spec_ngram)
+        #: paged-KV block economy (ISSUE 6): block_size > 0 switches the
+        #: pool storage to blocks + per-slot tables; the dispatch math is
+        #: unchanged (gathered views), so the slot-pool scheduler state
+        #: below stays authoritative either way
+        self.block_size = int(block_size)
+        self.paged = self.block_size > 0
+        if self.paged and num_blocks == 0:
+            # capacity parity with the slot pool it replaces: the same
+            # HBM hosts the same worst case, and everything shorter
+            # frees blocks for MORE concurrent conversations
+            num_blocks = num_slots * (
+                -(-cfg.max_seq_len // self.block_size))
+        self.num_blocks = int(num_blocks)
+        self._alloc = (BlockAllocator(self.num_blocks, self.block_size)
+                       if self.paged else None)
+        #: per-slot block tables (host ints; the dispatch-side arrays are
+        #: assembled fresh per dispatch in _block_tables)
+        self._slot_blocks: list[list[int]] = [[] for _ in range(num_slots)]
+        self.admission_policy = admission_policy
         self.temperature = float(temperature)
         self.eos_id = eos_id
         self.default_max_new_tokens = default_max_new_tokens
@@ -1276,6 +1532,86 @@ class ContinuousEngine:
 
         self._prefix_admit_for = prefix_admit_for
 
+        def rung(needed: int) -> int:
+            return next((b for b in self.attend_buckets if b >= needed),
+                        cfg.max_seq_len)
+
+        self._rung = rung
+
+        if self.paged:
+            # block pool: the same cache tree, rows = blocks and seq =
+            # block_size; axes probed on it drive both gather and
+            # scatter (k/v keep seq after the row axis, int8-KV scale
+            # buffers keep it LAST — same layout truth as _seq_axes)
+            bs = self.block_size
+            bcfg = _dc.replace(cfg, max_seq_len=bs)
+            self._block_pool_shapes = cache_shapes(bcfg, self.num_blocks)
+            blk_row = cache_shapes(bcfg, 1)
+            blk_probe = cache_shapes(bcfg, 2)
+            self._block_axes = jax.tree.map(batch_axis, blk_probe, blk_row)
+            blk_seqp = cache_shapes(
+                _dc.replace(cfg, max_seq_len=bs + 8), self.num_blocks)
+            self._block_seq_axes = jax.tree.map(
+                batch_axis, blk_seqp, self._block_pool_shapes)
+            paged_args = (bs, self._block_axes, self._block_seq_axes, mesh)
+
+            self._paged_decode_programs: dict[int, Any] = {}
+            self._paged_chunk_programs: dict[tuple, Any] = {}
+            self._paged_fused_programs: dict[int, Any] = {}
+            self._paged_verify_programs: dict[int, Any] = {}
+            self._paged_fused_verify_programs: dict[int, Any] = {}
+
+            def paged_decode_for(needed: int):
+                a = rung(needed)
+                if a not in self._paged_decode_programs:
+                    self._paged_decode_programs[a] = guard(
+                        make_paged_decode_program(cfg, a, chunk,
+                                                  *paged_args))
+                return self._paged_decode_programs[a]
+
+            def paged_chunk_for(needed: int, budget: int):
+                a = rung(needed)
+                k = (a, budget)
+                if k not in self._paged_chunk_programs:
+                    self._paged_chunk_programs[k] = guard(
+                        make_paged_chunk_prefill_program(
+                            cfg, a, budget, *paged_args))
+                return self._paged_chunk_programs[k]
+
+            def paged_fused_for(needed: int):
+                a = rung(needed)
+                if a not in self._paged_fused_programs:
+                    self._paged_fused_programs[a] = guard(
+                        make_paged_fused_step_program(
+                            cfg, a, chunk, self.prefill_budget,
+                            *paged_args))
+                return self._paged_fused_programs[a]
+
+            def paged_verify_for(needed: int):
+                a = rung(needed)
+                if a not in self._paged_verify_programs:
+                    self._paged_verify_programs[a] = guard(
+                        make_paged_verify_program(cfg, a, self.spec_k,
+                                                  *paged_args))
+                return self._paged_verify_programs[a]
+
+            def paged_fused_verify_for(needed: int):
+                a = rung(needed)
+                if a not in self._paged_fused_verify_programs:
+                    self._paged_fused_verify_programs[a] = guard(
+                        make_paged_fused_verify_program(
+                            cfg, a, self.spec_k, self.prefill_budget,
+                            *paged_args))
+                return self._paged_fused_verify_programs[a]
+
+            self._paged_decode_for = paged_decode_for
+            self._paged_chunk_for = paged_chunk_for
+            self._paged_fused_for = paged_fused_for
+            self._paged_verify_for = paged_verify_for
+            self._paged_fused_verify_for = paged_fused_verify_for
+            self._block_copy = guard(
+                make_block_copy_program(self._block_axes, mesh))
+
         # logits dtype follows the model's activation dtype (bf16 on TPU;
         # the pool logits buffer must match or the decode scan carry
         # type-mismatches)
@@ -1292,12 +1628,16 @@ class ContinuousEngine:
 
     def _init_pool(self) -> None:
         mesh = self.mesh
+        # paged engines allocate the BLOCK pool; the slot-shaped working
+        # views are gathered per dispatch, never resident
+        shapes = (self._block_pool_shapes if self.paged
+                  else self._pool_shapes)
         self._pool_cache, self._pool_logits = shardedlib.mesh_jit(
             mesh,
             lambda: (
                 shardedlib.constrain_cache(
                     jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype),
-                                 self._pool_shapes),
+                                 shapes),
                     mesh),
                 shardedlib.constrain_logits(
                     jnp.zeros((self.num_slots, self.cfg.vocab_size),
@@ -1354,6 +1694,9 @@ class ContinuousEngine:
         if groups is None:
             groups = [(1, self.seq_buckets[0]),
                       (self.num_slots, self.seq_buckets[0])]
+        if self.paged:
+            self._warmup_paged(groups)
+            return
         # host args are NUMPY throughout: under a multi-process serving
         # mesh (the gang) a process-local device array cannot feed a
         # global-mesh jit — numpy inputs device_put as replicated on every
@@ -1525,6 +1868,89 @@ class ContinuousEngine:
                     np.int32(self.num_slots), np.int32(self.num_slots),
                     np.int32(1), np.zeros(sb, np.int32), np.int32(1))
 
+    def _warmup_paged(self, groups) -> None:
+        """Paged warm ladder: every attend rung the warmed prompt
+        buckets imply gets its decode (+ fused/chunk/verify siblings)
+        compiled against an all-sentinel block table — gathers clip,
+        scatters drop, every row is inactive, so pool state is
+        untouched.  Prefix-hit suffix admissions at rungs above the cold
+        set compile lazily on first use, which the recompile guard
+        counts as that program's warm entry, not a re-trace."""
+        warm_attends = set()
+        for g, bucket in groups:
+            bucket = next(b for b in self.seq_buckets if b >= bucket)
+            warm_attends.add(bucket + self.decode_chunk)
+        if not warm_attends:
+            return
+        top = max(warm_attends)
+        if self.spec_k > 0:
+            top = max(top, max(warm_attends) - self.decode_chunk
+                      + self.spec_k + 1)
+        cover = self._rung(top)
+        pad = self._alloc.pad_block
+        sent = np.int32(self.num_slots)
+        idle = (np.zeros(self.num_slots, np.int32),
+                np.zeros(self.num_slots, bool),
+                np.zeros(self.num_slots, np.float32),
+                np.ones(self.num_slots, np.float32),
+                np.zeros(self.num_slots, np.int32),
+                np.asarray(jax.random.PRNGKey(0)))
+        no_drafts = np.full((self.num_slots, max(self.spec_k, 1)), -1,
+                            np.int32)
+        no_ban = np.full(self.num_slots, -1, np.int32)
+        toks = None
+        for a in [x for x in self.attend_buckets if x <= cover]:
+            nblk = -(-a // self.block_size)
+            bt = np.full((self.num_slots, nblk), pad, np.int32)
+            row = np.full((1, nblk), pad, np.int32)
+            self._pool_cache, self._pool_logits, toks = (
+                self._paged_decode_for(a)(
+                    self.params, self._pool_cache, self._pool_logits,
+                    bt, *idle))
+            if self.prefill_budget > 0:
+                ptoks = np.zeros(self.prefill_budget, np.int32)
+                self._pool_cache, self._pool_logits = (
+                    self._paged_chunk_for(a, self.prefill_budget)(
+                        self.params, self._pool_cache, self._pool_logits,
+                        row, ptoks, np.int32(0), np.int32(1), sent))
+                self._pool_cache, self._pool_logits, toks = (
+                    self._paged_fused_for(a)(
+                        self.params, self._pool_cache, self._pool_logits,
+                        bt, sent, ptoks, np.int32(0), np.int32(1), sent,
+                        *idle))
+            if self.spec_k > 0:
+                self._pool_cache, self._pool_logits, toks, _acc = (
+                    self._paged_verify_for(a)(
+                        self.params, self._pool_cache, self._pool_logits,
+                        bt, no_drafts, no_ban, *idle))
+                if self.prefill_budget > 0:
+                    ptoks = np.zeros(self.prefill_budget, np.int32)
+                    self._pool_cache, self._pool_logits, toks, _acc = (
+                        self._paged_fused_verify_for(a)(
+                            self.params, self._pool_cache,
+                            self._pool_logits, bt, sent, ptoks,
+                            np.int32(0), np.int32(1), sent, no_drafts,
+                            no_ban, *idle))
+        if self.prefill_budget == 0:
+            # monolithic paged admission: one chunk covers the whole
+            # prompt/suffix, programs keyed (rung, bucket) — warm the
+            # cold-admission pair per bucket
+            for bucket in [b for b in self.seq_buckets if b <= cover]:
+                a = self._rung(bucket)
+                row = np.full((1, -(-a // self.block_size)), pad,
+                              np.int32)
+                self._pool_cache, self._pool_logits = (
+                    self._paged_chunk_for(a, bucket)(
+                        self.params, self._pool_cache, self._pool_logits,
+                        row, np.zeros(bucket, np.int32), np.int32(0),
+                        np.int32(1), sent))
+        if self.prefix_cache:
+            # the COW fork dispatch (dst out of range: dropped)
+            self._pool_cache = self._block_copy(
+                self._pool_cache, np.int32(0), np.int32(pad))
+        if toks is not None:
+            jax.block_until_ready(toks)
+
     def submit(
         self, prompt: list[int], max_new_tokens: Optional[int] = None,
         temperature: Optional[float] = None,
@@ -1564,7 +1990,32 @@ class ContinuousEngine:
     def stats(self) -> dict:
         """Engine observability snapshot (exported as Prometheus gauges
         by the model server's /metrics)."""
+        if self.paged:
+            a = self._alloc
+            allocated = a.num_blocks - a.free_blocks
+            # analysis: ok host-sync-in-dispatch — host token lists
+            live_tokens = sum(
+                len(self._slot_content[s]) for s in range(self.num_slots)
+                if self._slot_blocks[s])
+            paged = {
+                **a.stats(),
+                # reserved-but-unwritten span across live tables: the
+                # block economy's internal fragmentation + upfront
+                # worst-case commitment, as a ratio of allocated bytes
+                "kv_fragmentation_ratio": (
+                    0.0 if allocated == 0 else round(max(
+                        0.0, 1.0 - live_tokens
+                        / (allocated * self.block_size)), 4)),
+            }
+        else:
+            paged = {
+                "kv_block_size": 0, "kv_blocks_total": 0,
+                "kv_blocks_free": 0, "kv_blocks_cow_copies_total": 0,
+                "prefix_block_hits_total": 0,
+                "kv_fragmentation_ratio": 0.0,
+            }
         return {
+            **paged,
             "slots_capacity": self.num_slots,
             "slots_live": int(self._active.sum()),
             "queue_depth": len(self._waiting) + self._queue.qsize(),
@@ -1641,6 +2092,8 @@ class ContinuousEngine:
                          if not r.cancelled.is_set()]
         free = [i for i, r in enumerate(self._slots) if r is None]
         taken: list[tuple[Request, int]] = []  # (req, slot)
+        plans: list[tuple] = []                # paged: parallel to taken
+        deferred: list[Request] = []
         while free and self._waiting:
             req = self._waiting.pop(0)
             # budget the KV cache: prompt + generated tokens must fit
@@ -1653,8 +2106,35 @@ class ContinuousEngine:
                 # empty prompt -> empty continuation (runtimes.py rule)
                 req.done.set()
                 continue
-            taken.append((req, free.pop(0)))
+            if (self.admission_policy is not None
+                    and not self.admission_policy(req)):
+                # policy says not now (e.g. the tier ladder's class
+                # quota is full): defer without consuming a slot —
+                # later waiters of other classes may still admit
+                deferred.append(req)
+                continue
+            if self.paged:
+                plan = self._plan_paged(req)
+                if plan is None:
+                    # pool-exhaustion backpressure: the request WAITS
+                    # for blocks instead of evicting someone mid-decode
+                    # (unless _plan_paged FAILED it outright — a span no
+                    # empty pool could ever host must not re-queue)
+                    if not req.done.is_set():
+                        deferred.append(req)
+                    continue
+                plans.append(plan)
+            slot = free.pop(0)
+            # reserve immediately so admission_policy / later planning
+            # in this same cycle sees the occupancy
+            self._slots[slot] = req
+            taken.append((req, slot))
+        if deferred:
+            self._waiting = deferred + self._waiting
         if not taken:
+            return
+        if self.paged:
+            self._admit_paged(taken, plans)
             return
         # SHARED-SEGMENT routing sees the FULL prompt (legacy truncation
         # below caps it to the slot length — which for a suffix-slot pool
@@ -1930,6 +2410,158 @@ class ContinuousEngine:
             self.segment_tokens_shared += blen
         return best, blen, suffix, created
 
+    def _plan_paged(self, req: Request) -> Optional[tuple]:
+        """Paged admission plan: (prompt, start, table, cow_src,
+        shared_n) with the request's FULL worst-case block span
+        (prompt + max_new_tokens) reserved up front, or None when the
+        free list cannot host it (backpressure — nothing is held).
+
+        Prefix reuse at BLOCK granularity: full blocks of the best
+        matching live/retired sequence are shared by refcount (zero
+        copy, zero prefill); a match ending mid-block forks the
+        boundary block with one COW dispatch so the suffix prefill
+        starts at the exact divergence point."""
+        bs = self.block_size
+        cap = min(self.seq_buckets[-1],
+                  self.cfg.max_seq_len - req.max_new_tokens)
+        prompt = req.prompt[-cap:]  # left-truncate, keep the tail
+        total = len(prompt) + req.max_new_tokens
+        nb_total = -(-total // bs)
+        if nb_total > self._alloc.num_blocks:
+            # structurally impossible: even an EMPTY pool cannot host
+            # this request's worst-case span — fail it now (deferring
+            # would park it forever and busy-spin an idle scheduler)
+            req.error = RuntimeError(
+                f"request needs {nb_total} KV blocks but the pool has "
+                f"{self._alloc.num_blocks} (num_blocks too small for "
+                f"prompt + max_new_tokens = {total} at block_size {bs})")
+            req.done.set()
+            return None
+        start, shared, cow_src = 0, [], None
+        if self.prefix_cache:
+            blocks, lcp = self._paged_match(prompt)
+            lcp = min(lcp, len(prompt) - 1)
+            if lcp >= self.min_prefix:
+                nfull = lcp // bs
+                shared = [int(b) for b in blocks[:nfull]]
+                start = nfull * bs
+                if lcp > start and nfull < len(blocks):
+                    # COW fork: copy the partially-matching boundary
+                    # block into the first fresh block, then prefill
+                    # only from the true divergence point
+                    cow_src = int(blocks[nfull])
+                    start = lcp
+        # pin shared blocks OUT of the free list before allocating —
+        # alloc must never hand a block we are about to share
+        self._alloc.ref(shared)
+        fresh = self._alloc.alloc(nb_total - len(shared))
+        if fresh is None:
+            self._alloc.release(shared)
+            return None
+        if shared:
+            self._alloc.prefix_block_hits_total += len(shared)
+        return prompt, start, shared + fresh, cow_src, len(shared)
+
+    def _paged_match(self, prompt: list[int]) -> tuple[tuple, int]:
+        """(blocks, lcp): the best block-backed prefix source for this
+        prompt — live slots' content records first, then the
+        allocator's retired-sequence registry (freed-but-unreused
+        blocks: the free list doubling as the prefix cache)."""
+        cap = len(prompt) - 1
+        if cap <= 0:
+            return (), 0
+        # analysis: ok host-sync-in-dispatch — host token list, no device value
+        p = np.asarray(prompt, np.int64)
+        best_blocks: tuple = ()
+        best = 0
+        for s in range(self.num_slots):
+            content, blocks = self._slot_content[s], self._slot_blocks[s]
+            if not blocks or min(len(content), cap) <= best:
+                continue
+            lcp = _lcp(content, p, cap)
+            if lcp > best:
+                best_blocks, best = tuple(blocks), lcp
+        reg_blocks, reg_lcp = self._alloc.match(p, cap)
+        if reg_lcp > best:
+            best_blocks, best = reg_blocks, reg_lcp
+        return best_blocks, best
+
+    def _admit_paged(self, taken, plans) -> None:
+        """Install the planned admissions: blocks are reserved; fork COW
+        boundaries on-device; enqueue the chunked prefill.  Paged
+        admission is ALWAYS chunk-driven — with ``prefill_budget == 0``
+        a single chunk covers the whole remainder (the monolithic
+        admission bound, unchanged from the legacy path)."""
+        stall_t0 = time.perf_counter()
+        # analysis: ok host-sync-in-dispatch — host numpy scheduler state
+        had_live = bool(self._active.any())
+        dispatched = False
+        for (req, slot), plan in zip(taken, plans):
+            prompt, start, table, cow_src, shared_n = plan
+            if cow_src is not None:
+                try:
+                    self._pool_cache = self._block_copy(
+                        self._pool_cache, np.int32(cow_src),
+                        np.int32(table[shared_n]))
+                    self._alloc.cow_copies_total += 1
+                    dispatched = True
+                except Exception as e:  # noqa: BLE001 — fail THIS
+                    # request only (the legacy fail-this-group contract);
+                    # a GangEngine publish failure set _error: re-raise
+                    # so the gang goes fatal instead of diverging
+                    req.error = e
+                    req.done.set()
+                    self._slots[slot] = None
+                    self._alloc.release(table)
+                    if self._error is not None:
+                        raise
+                    continue
+            self._slot_blocks[slot] = table
+            # the shared prefix IS real KV content at [0, start) — the
+            # prefix matcher's ground truth from the first chunk on
+            self._slot_content[slot] = list(prompt[:start])
+            self._slot_owner[slot] = None
+            self._prefilling.append([req, slot, list(prompt), start])
+            self._prefill_tokens_inflight += len(prompt) - start
+            if start > 0:
+                self.prefix_hits += 1
+                self.prefix_tokens_saved += start
+        if had_live and dispatched:
+            self.decode_stall_ms_total += (
+                time.perf_counter() - stall_t0) * 1e3
+
+    def _block_tables(self, attend: int) -> np.ndarray:
+        """[num_slots, nblk] dispatch block tables for an attend rung —
+        host numpy assembled fresh per dispatch (never mutated after),
+        padded with the allocator's out-of-range sentinel (gathers
+        clip, scatters drop)."""
+        nblk = -(-attend // self.block_size)
+        bt = np.full((self.num_slots, nblk), self._alloc.pad_block,
+                     np.int32)
+        for s, blocks in enumerate(self._slot_blocks):
+            if blocks:
+                m = min(len(blocks), nblk)
+                bt[s, :m] = blocks[:m]
+        return bt
+
+    def _retire_slot(self, slot: int) -> None:
+        """Free a slot for reuse: scheduler state, the segment ref and —
+        paged — the block table.  Refcount-zero blocks join the free
+        list UNCLEARED with the sequence registered, so a future prompt
+        sharing this conversation's prefix resurrects them instead of
+        re-prefilling (reuse costs a dict pop, never a clearing
+        dispatch)."""
+        self._slots[slot] = None
+        self._active[slot] = False
+        self._remaining[slot] = 0
+        self._release_seg(slot)
+        if self.paged and self._slot_blocks[slot]:
+            blocks = self._slot_blocks[slot]
+            if self.prefix_cache:
+                self._alloc.register(self._slot_content[slot], blocks)
+            self._alloc.release(blocks)
+            self._slot_blocks[slot] = []
+
     def _best_prefix(self, prompt: list[int]) -> tuple[int, int]:
         """(src_slot, lp): the longest usable prefix of ``prompt`` already
         present in some slot's KV.  Caps at len(prompt)-1 — at least one
@@ -2009,15 +2641,21 @@ class ContinuousEngine:
 
     def _prefill_chunk_args(self):
         """Host decision for the head of the chunked-admission queue:
-        (entry, toks [budget], take, final, write_slot, attend_needed)."""
+        (entry, toks [budget], take, final, write_slot, attend_needed).
+        With ``prefill_budget == 0`` (paged monolithic admission) the
+        one chunk covers the whole remainder, bucketed like a legacy
+        prefill."""
         entry = self._prefilling[0]
         req, slot, prompt, off = entry
-        take = min(self.prefill_budget, len(prompt) - off)
+        rem = len(prompt) - off
+        budget = self.prefill_budget or next(
+            b for b in self.seq_buckets if b >= rem)
+        take = min(budget, rem)
         final = (off + take) == len(prompt)
-        toks = np.zeros(self.prefill_budget, np.int32)
+        toks = np.zeros(budget, np.int32)
         toks[:take] = prompt[off:off + take]
         write_slot = slot if final else self.num_slots
-        return entry, toks, take, final, write_slot, off + self.prefill_budget
+        return entry, toks, take, final, write_slot, off + budget
 
     def _fail_prefill_head(self, entry, e: Exception) -> None:
         """Resolve the head admission's request with the dispatch error —
@@ -2060,12 +2698,17 @@ class ContinuousEngine:
             for slot in range(self.num_slots):
                 req = self._slots[slot]
                 if req is not None and req.done.is_set():
-                    self._slots[slot] = None
-                    self._active[slot] = False
-                    self._remaining[slot] = 0
-                    self._release_seg(slot)
+                    # cancel-mid-prefill included: blocks return to the
+                    # free list while the partial KV stays matchable
+                    self._retire_slot(slot)
             self._purge_prefilling()
             has_prefill = bool(self._prefilling)
+            #: chunked admission can ride a decode dispatch only when a
+            #: fused program exists (prefill_budget > 0); the paged
+            #: monolithic path (budget 0) dispatches its single
+            #: whole-remainder chunk standalone AFTER the decode —
+            #: exactly the legacy admission bound, block-table backed
+            can_fuse = has_prefill and self.prefill_budget > 0
             if not self._active.any() and not has_prefill:
                 # drain the tail, then wait for work without spinning
                 while pending:
@@ -2139,7 +2782,7 @@ class ContinuousEngine:
                         self._slot_seg.astype(np.int32).copy(),
                         self._active.copy(), self._temps.copy(),
                         self._top_ps.copy(), self._top_ks.copy(), key))
-            elif live and has_prefill:
+            elif live and has_prefill and can_fuse:
                 # the stall-free hot path: one dispatch = one prefill
                 # chunk + the whole pool's decode scan
                 entry, ptoks, take, final, write_slot, p_needed = (
@@ -2149,18 +2792,48 @@ class ContinuousEngine:
                         # chunked prefill fuses into the VERIFY dispatch
                         # exactly as it fuses into plain decode — turning
                         # speculation on never reopens the ISSUE 2 stall
-                        (self._pool_cache, self._pool_logits, vtoks,
-                         vacc) = self._fused_verify_for(
-                            max(needed, p_needed))(
-                            self.params, self._pool_cache,
-                            self._pool_logits,
-                            np.int32(entry[1]), ptoks, np.int32(entry[3]),
-                            np.int32(take), np.int32(write_slot),
-                            drafts, self._spec_ban.copy(),
-                            self._positions.copy(), self._active.copy(),
-                            self._temps.copy(), self._top_ps.copy(),
-                            self._top_ks.copy(), key)
+                        a = max(needed, p_needed)
+                        if self.paged:
+                            a = self._rung(a)
+                            (self._pool_cache, self._pool_logits, vtoks,
+                             vacc) = self._paged_fused_verify_for(a)(
+                                self.params, self._pool_cache,
+                                self._pool_logits, self._block_tables(a),
+                                np.int32(entry[1]), ptoks,
+                                np.int32(entry[3]), np.int32(take),
+                                np.int32(write_slot),
+                                drafts, self._spec_ban.copy(),
+                                self._positions.copy(),
+                                self._active.copy(), self._temps.copy(),
+                                self._top_ps.copy(),
+                                self._top_ks.copy(), key)
+                        else:
+                            (self._pool_cache, self._pool_logits, vtoks,
+                             vacc) = self._fused_verify_for(a)(
+                                self.params, self._pool_cache,
+                                self._pool_logits,
+                                np.int32(entry[1]), ptoks,
+                                np.int32(entry[3]),
+                                np.int32(take), np.int32(write_slot),
+                                drafts, self._spec_ban.copy(),
+                                self._positions.copy(),
+                                self._active.copy(),
+                                self._temps.copy(), self._top_ps.copy(),
+                                self._top_ks.copy(), key)
                         spec_out = (vtoks, vacc)
+                    elif self.paged:
+                        a = self._rung(max(needed, p_needed))
+                        self._pool_cache, self._pool_logits, toks = (
+                            self._paged_fused_for(a)(
+                                self.params, self._pool_cache,
+                                self._pool_logits, self._block_tables(a),
+                                np.int32(entry[1]), ptoks,
+                                np.int32(entry[3]),
+                                np.int32(take), np.int32(write_slot),
+                                self._positions.copy(),
+                                self._active.copy(),
+                                self._temps.copy(), self._top_ps.copy(),
+                                self._top_ks.copy(), key))
                     else:
                         self._pool_cache, self._pool_logits, toks = (
                             self._fused_for(max(needed, p_needed))(
@@ -2185,22 +2858,45 @@ class ContinuousEngine:
                     continue  # no decode chunk landed this iteration
                 self._advance_prefill(entry, take, final)
             elif use_spec:
-                self._pool_cache, self._pool_logits, vtoks, vacc = (
-                    self._verify_for(needed)(
-                        self.params, self._pool_cache, self._pool_logits,
-                        drafts, self._spec_ban.copy(),
-                        self._positions.copy(), self._active.copy(),
-                        self._temps.copy(), self._top_ps.copy(),
-                        self._top_ks.copy(), key))
+                if self.paged:
+                    a = self._rung(needed)
+                    self._pool_cache, self._pool_logits, vtoks, vacc = (
+                        self._paged_verify_for(a)(
+                            self.params, self._pool_cache,
+                            self._pool_logits, self._block_tables(a),
+                            drafts, self._spec_ban.copy(),
+                            self._positions.copy(), self._active.copy(),
+                            self._temps.copy(), self._top_ps.copy(),
+                            self._top_ks.copy(), key))
+                else:
+                    self._pool_cache, self._pool_logits, vtoks, vacc = (
+                        self._verify_for(needed)(
+                            self.params, self._pool_cache,
+                            self._pool_logits,
+                            drafts, self._spec_ban.copy(),
+                            self._positions.copy(), self._active.copy(),
+                            self._temps.copy(), self._top_ps.copy(),
+                            self._top_ks.copy(), key))
                 spec_out = (vtoks, vacc)
             elif live:
-                self._pool_cache, self._pool_logits, toks = self._decode_for(
-                    needed)(
-                    self.params, self._pool_cache, self._pool_logits,
-                    self._positions.copy(), self._active.copy(),
-                    self._temps.copy(), self._top_ps.copy(),
-                    self._top_ks.copy(), key)
-            if has_prefill and (not live or live_seg):
+                if self.paged:
+                    a = self._rung(needed)
+                    self._pool_cache, self._pool_logits, toks = (
+                        self._paged_decode_for(a)(
+                            self.params, self._pool_cache,
+                            self._pool_logits, self._block_tables(a),
+                            self._positions.copy(), self._active.copy(),
+                            self._temps.copy(), self._top_ps.copy(),
+                            self._top_ks.copy(), key))
+                else:
+                    self._pool_cache, self._pool_logits, toks = (
+                        self._decode_for(needed)(
+                            self.params, self._pool_cache,
+                            self._pool_logits,
+                            self._positions.copy(), self._active.copy(),
+                            self._temps.copy(), self._top_ps.copy(),
+                            self._top_ks.copy(), key))
+            if has_prefill and (not live or live_seg or not can_fuse):
                 # no decode dispatch to ride (idle pool), or the pool
                 # decodes through the segment-aware program: run the
                 # chunk standalone, AFTER the decode dispatch — the
@@ -2209,19 +2905,47 @@ class ContinuousEngine:
                 # device stream, and the slot activates only once both
                 # are in flight (the next dispatch samples its first
                 # token from the prefill logits, never a clobbered row)
-                entry, ptoks, take, final, write_slot, p_needed = (
-                    self._prefill_chunk_args())
-                try:
-                    self._pool_cache, self._pool_logits = (
-                        self._chunk_prefill_for(p_needed)(
-                            self.params, self._pool_cache,
-                            self._pool_logits,
-                            np.int32(entry[1]), ptoks, np.int32(entry[3]),
-                            np.int32(take), np.int32(write_slot)))
-                except Exception as e:  # noqa: BLE001 — fail THIS request
-                    self._fail_prefill_head(entry, e)
-                else:
+                # paged monolithic admission (budget 0) DRAINS the whole
+                # queue here — each entry is exactly one whole-remainder
+                # chunk, so serializing them across loop iterations
+                # would only interleave admission stalls into the decode
+                # stream; one drain per iteration matches the legacy
+                # batched-prefill admission bound
+                while self._prefilling:
+                    entry, ptoks, take, final, write_slot, p_needed = (
+                        self._prefill_chunk_args())
+                    try:
+                        if self.paged:
+                            a = self._rung(p_needed)
+                            nblk = -(-a // self.block_size)
+                            row = np.full((1, nblk),
+                                          self._alloc.pad_block,
+                                          np.int32)
+                            blocks = self._slot_blocks[entry[1]]
+                            row[0, :min(len(blocks), nblk)] = \
+                                blocks[:nblk]
+                            self._pool_cache, self._pool_logits = (
+                                self._paged_chunk_for(a, len(ptoks))(
+                                    self.params, self._pool_cache,
+                                    self._pool_logits, row, ptoks,
+                                    np.int32(entry[3]), np.int32(take),
+                                    np.int32(write_slot)))
+                        else:
+                            self._pool_cache, self._pool_logits = (
+                                self._chunk_prefill_for(p_needed)(
+                                    self.params, self._pool_cache,
+                                    self._pool_logits,
+                                    np.int32(entry[1]), ptoks,
+                                    np.int32(entry[3]),
+                                    np.int32(take), np.int32(write_slot)))
+                    except Exception as e:  # noqa: BLE001 — fail THIS
+                        # request (purge reclaims the head entry next
+                        # loop top)
+                        self._fail_prefill_head(entry, e)
+                        break
                     self._advance_prefill(entry, take, final)
+                    if not (self.paged and self.prefill_budget == 0):
+                        break  # budgeted chunks: one per dispatch cycle
             if not live:
                 # prefill-only iteration: no decode chunk landed, but
                 # earlier dispatches' tokens may be waiting — deliver
@@ -2254,10 +2978,12 @@ class ContinuousEngine:
                     if self._remaining[slot] <= 0:
                         # slot is schedulable for a new occupant
                         # immediately; the request itself resolves when
-                        # its tokens arrive
-                        self._slots[slot] = None
-                        self._active[slot] = False
-                        self._release_seg(slot)
+                        # its tokens arrive (blocks freed here are safe
+                        # to reuse mid-flight: device dispatch order
+                        # writes the new occupant's prefill after this
+                        # chunk — the slot pool's standing stale-KV
+                        # argument, now at block granularity)
+                        self._retire_slot(slot)
                 pending.append((toks, snapshot))
             if self.spec_k > 0:
                 # speculation makes the dispatch schedule value-
@@ -2362,10 +3088,7 @@ class ContinuousEngine:
                 # free the slot unless a new occupant already claimed it
                 # (max_new-tokens freeing happens at dispatch time)
                 if self._slots[slot] is req:
-                    self._slots[slot] = None
-                    self._active[slot] = False
-                    self._remaining[slot] = 0
-                    self._release_seg(slot)
+                    self._retire_slot(slot)
             if emitted and req.first_token_at is None:
                 req.first_token_at = now
             req.tokens.extend(emitted)
@@ -2429,37 +3152,48 @@ class ContinuousEngine:
                 req.done.set()
                 done = True
             if done and self._slots[slot] is req:
-                self._slots[slot] = None
-                self._active[slot] = False
-                self._remaining[slot] = 0
-                self._release_seg(slot)
+                self._retire_slot(slot)
                 ban = -1
             self._spec_ban[slot] = ban
 
 
 class TieredEngine:
-    """N-tier continuous batching: conversations decode in the smallest
-    pool whose KV buffer fits their KNOWN total length.
+    """The tier ladder as an ADMISSION POLICY over ONE paged pool.
 
-    Fixes the pool-global window tax (r3 verdict weak #4; generalized
-    past two tiers per r4 weak #7): in a single pool the decode window
-    is the max over ALL live slots, so one long conversation drags every
-    short request's per-token KV read up to its window.  Requests route
-    at admission by prompt + max_new_tokens (no migration is ever
-    needed): each tier is built over a config with ``max_seq_len`` = its
-    cap, making its decode programs structurally incapable of reading
-    past it; each pool keeps its own admission, dispatch-ahead pipeline,
-    and prefix cache.  The final (uncapped) pool's windows still bucket
-    per its live front.
+    History: r6/r7 tiers were N separate ContinuousEngine pools, each
+    with its own capped KV buffer — the only way a slot-sized contiguous
+    pool could stop one long conversation from billing every short
+    request max_seq_len of reserved HBM.  The paged block economy
+    (ISSUE 6) deletes that reason: a request's KV bill is its actual
+    length in blocks, whatever its neighbors do, so the per-tier pools
+    (and their split prefix caches, duplicated programs, and
+    cross-tier re-prefill tax) are gone — not wrapped, deleted.
 
-    ``tier_lens`` is the ascending ladder of caps (e.g. [128, 512,
-    2048]); the classic two-tier API (``short_len``/``short_slots``) is
-    the one-entry case.  ``tier_slots`` splits ``num_slots`` across the
-    capped tiers (the remainder is the uncapped pool).
+    What survives is the SCHEDULING intent as policy: ``tier_lens``
+    still classifies requests by known total length (prompt +
+    max_new_tokens) and ``tier_slots`` still guarantees each class its
+    share of concurrency — enforced through the engine's
+    ``admission_policy`` hook, so a burst of long conversations can
+    never starve short-request admission (they queue while the short
+    classes' reserved slots stay available).  One pool means one prefix
+    cache spanning every length class: the conversation that outgrows
+    its class now KEEPS its cached blocks.
 
-    Tradeoff (documented, not hidden): prefix reuse does not cross pools
-    — a conversation that outgrows its tier re-enters the next one up
-    and pays its own prefill once.
+    Tradeoff (documented, not hidden): the old per-tier pools ALSO
+    capped the decode window structurally — a short request co-resident
+    with a 2048-token conversation now attends (and gathers) at the
+    pool-wide rung, the r3 window tax the capped short pool used to
+    prevent.  The ladder trades that per-token read tax for the block
+    economy's capacity + one shared prefix cache; operators whose
+    traffic is dominated by short requests next to very long
+    conversations should route them to separate ISvc replicas (the
+    router splits by model, and per-replica pools are cheap once KV is
+    block-billed).
+
+    ``tier_lens`` is the ascending ladder of class boundaries (e.g.
+    [128, 512, 2048]); the classic two-tier API (``short_len`` /
+    ``short_slots``) is the one-entry case.  ``tier_slots`` reserves
+    slots per bounded class (the remainder is the unbounded class).
     """
 
     def __init__(self, cfg, params, *, short_len: int = 512,
@@ -2467,8 +3201,6 @@ class TieredEngine:
                  tier_lens: Optional[list[int]] = None,
                  tier_slots: Optional[list[int]] = None,
                  **kw):
-        import dataclasses as _dc
-
         if tier_lens is None:
             tier_lens = [int(short_len)]
             tier_slots = [num_slots // 2 if short_slots is None
@@ -2493,45 +3225,44 @@ class TieredEngine:
                              ">= 1 slot")
         self.caps = list(tier_lens)
         self.short_len = tier_lens[0]
-        # seq_buckets apply per-pool: the uncapped pool takes them as
-        # given; capped tiers keep only those under their cap (falling
-        # back to defaults if none survive) — silently dropping an
-        # operator-tuned knob would regress admission latency
-        seq_buckets = kw.pop("seq_buckets", None)
-        if not kw.get("mesh_axes"):
-            # commit host params to the device ONCE before building the
-            # pools: each ContinuousEngine device_puts its params, and
-            # N+1 pools must share one copy of the weights, not hold
-            # N+1 (device_put on an already-committed array is a no-op;
-            # the mesh case is likewise idempotent through place_params)
-            params = jax.device_put(params)
-        self.pools: list[ContinuousEngine] = []
-        for cap, n in zip(tier_lens, tier_slots):
-            tb = None
-            if seq_buckets:
-                tb = [b for b in seq_buckets if b < cap] or None
-            self.pools.append(ContinuousEngine(
-                _dc.replace(cfg, max_seq_len=cap), params,
-                num_slots=n, seq_buckets=tb, **kw))
-        self.pools.append(ContinuousEngine(
-            cfg, params, num_slots=num_slots - sum(tier_slots),
-            seq_buckets=seq_buckets, **kw))
-        # 2-tier compatibility surface
-        self.short = self.pools[0]
-        self.long = self.pools[-1]
+        self.quotas = tier_slots + [num_slots - sum(tier_slots)]
+        # the ladder REQUIRES the paged pool (one block economy is what
+        # makes per-tier KV pools deletable); operators may tune the
+        # block size, not opt back into contiguous slots
+        if kw.get("block_size", None) in (None, 0):
+            kw["block_size"] = max(
+                1, min(16, self.short_len // 2))
+        self.engine = ContinuousEngine(
+            cfg, params, num_slots=num_slots,
+            admission_policy=self._admit_quota, **kw)
+        #: compatibility surface: ONE pool — `.pools` iterates it,
+        #: `.short`/`.long` alias it (both classes live there now)
+        self.pools = [self.engine]
+        self.short = self.engine
+        self.long = self.engine
 
-    def _route(self, prompt: list[int], max_new_tokens: Optional[int]):
-        n_new = (self.long.default_max_new_tokens
-                 if max_new_tokens is None else int(max_new_tokens))
-        total = len(prompt) + n_new
-        for cap, pool in zip(self.caps, self.pools):
+    # -- admission policy (scheduler thread) ------------------------------
+
+    def _classify(self, req: Request) -> int:
+        total = len(req.prompt) + req.max_new_tokens
+        for i, cap in enumerate(self.caps):
             if total < cap:
-                return pool
-        return self.pools[-1]
+                return i
+        return len(self.caps)
+
+    def _admit_quota(self, req: Request) -> bool:
+        """Reserve each class its concurrency share: admit only while
+        the request's class holds fewer slots than its quota (counted
+        over the live+reserved slot table, scheduler-thread-only)."""
+        cls = self._classify(req)
+        live = sum(
+            1 for r in self.engine._slots
+            if r is not None and self._classify(r) == cls)
+        return live < self.quotas[cls]
 
     def submit(self, prompt, max_new_tokens=None,
                temperature=None, top_p=None, top_k=None) -> Request:
-        return self._route(prompt, max_new_tokens).submit(
+        return self.engine.submit(
             prompt, max_new_tokens, temperature, top_p=top_p, top_k=top_k)
 
     def generate(self, prompt, max_new_tokens=None,
@@ -2541,60 +3272,55 @@ class TieredEngine:
                            top_p=top_p, top_k=top_k).wait(timeout)
 
     def warmup(self, groups=None) -> None:
-        for pool in self.pools:
-            pool_groups = groups
-            if groups is not None:
-                # prompt buckets beyond a tier's cap can only ever be
-                # admitted higher up — don't warm them here
-                cap = pool.seq_buckets[-1]
-                pool_groups = [g for g in groups if g[1] <= cap] or None
-            pool.warmup(pool_groups)
+        self.engine.warmup(groups)
 
     def stop(self) -> None:
-        for pool in self.pools:
-            pool.stop()
+        self.engine.stop()
 
     # drop-in interface parity with ContinuousEngine: runtimes that front
     # the engine (serving/text.py) read these
     @property
     def eos_id(self):
-        return self.long.eos_id
+        return self.engine.eos_id
 
     @property
     def default_max_new_tokens(self) -> int:
-        return self.long.default_max_new_tokens
+        return self.engine.default_max_new_tokens
 
     @property
     def cfg(self):
-        return self.long.cfg
+        return self.engine.cfg
 
     @property
     def tokens_emitted(self) -> int:
-        return sum(p.tokens_emitted for p in self.pools)
+        return self.engine.tokens_emitted
 
     @property
     def prefix_hits(self) -> int:
-        return sum(p.prefix_hits for p in self.pools)
+        return self.engine.prefix_hits
 
     @property
     def prefix_tokens_saved(self) -> int:
-        return sum(p.prefix_tokens_saved for p in self.pools)
+        return self.engine.prefix_tokens_saved
 
     def stats(self) -> dict:
-        per = [p.stats() for p in self.pools]
-        merged = {k: sum(d[k] for d in per) for k in per[0]}
-        # per-pool CONSTANTS must not sum across pools (every pool is
-        # built with the same knob; a summed gauge reports a config
-        # nobody set)
-        merged["prefill_budget"] = per[-1]["prefill_budget"]
-        # DERIVED gauges must re-derive from the summed counters (a sum
-        # of per-pool ratios is not a ratio of anything)
-        merged["spec_acceptance_rate"] = round(
-            merged["spec_tokens_accepted_total"]
-            / max(merged["spec_tokens_proposed_total"], 1), 4)
-        merged["pools"] = per
-        merged["short_pool"] = per[0]
-        merged["long_pool"] = per[-1]
+        merged = dict(self.engine.stats())
+        # analysis: ok host-sync-in-dispatch — host scheduler state
+        live = [0] * len(self.quotas)
+        for r in self.engine._slots:
+            if r is not None:
+                live[self._classify(r)] += 1
+        merged["classes"] = [
+            {"cap": (self.caps[i] if i < len(self.caps) else 0),
+             "quota": q, "live": live[i]}
+            for i, q in enumerate(self.quotas)]
+        # ONE snapshot serves the compatibility keys too — re-invoking
+        # engine.stats() per key would pay the slot walk again and
+        # could report two inconsistent snapshots in one payload
+        snap = dict(merged)
+        merged["pools"] = [snap]
+        merged["short_pool"] = snap
+        merged["long_pool"] = snap
         return merged
 
 
@@ -2617,6 +3343,8 @@ def engine_kwargs(config: dict, *, default_eos=None,
         segment_len=int(config.get("segment_len", 0)),
         spec_k=int(config.get("spec_k", 0)),
         spec_ngram=int(config.get("spec_ngram", 3)),
+        block_size=int(config.get("block_size", 0)),
+        num_blocks=int(config.get("num_blocks", 0)),
         default_max_new_tokens=int(
             config.get("max_new_tokens", default_max_new_tokens)),
     )

@@ -424,7 +424,8 @@ class InferenceServiceController(Controller):
 
     #: engine knobs validated at conf-freeze (value below floor -> Failed)
     _ENGINE_KNOBS = ("num_slots", "decode_chunk", "pipeline_depth",
-                     "prefill_budget", "spec_k", "spec_ngram")
+                     "prefill_budget", "spec_k", "spec_ngram",
+                     "block_size", "num_blocks")
 
     def _new_revision(self, isvc, dep: _Deployment, fingerprint: str) -> _Revision:
         runtime_cls, cfg = self._resolve(isvc)
@@ -438,9 +439,11 @@ class InferenceServiceController(Controller):
             # this way it is ONE Failed status with the message
             from .continuous import engine_kwargs
 
+            zero_ok = ("prefill_budget", "spec_k", "block_size",
+                       "num_blocks")
             bad = {k: v for k, v in engine_kwargs(cfg).items()
                    if k in self._ENGINE_KNOBS
-                   and v < (0 if k in ("prefill_budget", "spec_k") else 1)}
+                   and v < (0 if k in zero_ok else 1)}
             if bad:
                 raise ValueError(f"invalid engine knobs: {bad}")
         dep.rev_counter += 1

@@ -764,6 +764,186 @@ class GangEngine(contlib.ContinuousEngine):
 
                 self._fused_verify_for = fused_verify_for
 
+        if self.paged:
+            # paged-KV ops (ISSUE 6): every block-table-carrying
+            # dispatch joins the control stream with its table — the
+            # followers never run the allocator; they replay rank 0's
+            # host decisions (tables, COW src/dst) verbatim, so the
+            # block pools stay bit-identical without allocator state
+            # ever crossing the wire
+            pdecode_inner = self._paged_decode_for
+            pchunk_inner = self._paged_chunk_for
+            pcopy_inner = self._block_copy
+
+            def paged_decode_for(needed: int):
+                prog = pdecode_inner(needed)
+
+                def call(params, cache, logits, bt, positions, active,
+                         temps, top_ps, top_ks, key):
+                    try:
+                        bt = np.asarray(bt)
+                        positions = np.asarray(positions)
+                        active = np.asarray(active)
+                        temps = np.asarray(temps)
+                        top_ps = np.asarray(top_ps)
+                        top_ks = np.asarray(top_ks)
+                        key = np.asarray(key)
+                        ch.publish(("paged_decode", int(needed), bt,
+                                    positions, active, temps, top_ps,
+                                    top_ks, key))
+                        return prog(params, cache, logits, bt, positions,
+                                    active, temps, top_ps, top_ks, key)
+                    except Exception as e:  # noqa: BLE001 — see _fatal
+                        raise self._fatal(e)
+
+                return call
+
+            def paged_chunk_for(needed: int, budget: int):
+                prog = pchunk_inner(needed, budget)
+
+                def call(params, cache, logits, bt_row, toks, start,
+                         length, write_slot):
+                    try:
+                        bt_row = np.asarray(bt_row)
+                        toks = np.asarray(toks)
+                        ch.publish(("paged_chunk", int(needed),
+                                    int(budget), bt_row, toks,
+                                    int(start), int(length),
+                                    int(write_slot)))
+                        return prog(params, cache, logits, bt_row, toks,
+                                    np.int32(start), np.int32(length),
+                                    np.int32(write_slot))
+                    except Exception as e:  # noqa: BLE001
+                        raise self._fatal(e)
+
+                return call
+
+            def block_copy(cache, src, dst):
+                try:
+                    ch.publish(("block_copy", int(src), int(dst)))
+                    return pcopy_inner(cache, np.int32(src),
+                                       np.int32(dst))
+                except Exception as e:  # noqa: BLE001
+                    raise self._fatal(e)
+
+            self._paged_decode_for = paged_decode_for
+            self._paged_chunk_for = paged_chunk_for
+            self._block_copy = block_copy
+
+            if self.prefill_budget > 0:
+                pfused_inner = self._paged_fused_for
+
+                def paged_fused_for(needed: int):
+                    prog = pfused_inner(needed)
+
+                    def call(params, cache, logits, bt, slot, toks,
+                             start, length, write_slot, positions,
+                             active, temps, top_ps, top_ks, key):
+                        try:
+                            bt = np.asarray(bt)
+                            toks = np.asarray(toks)
+                            positions = np.asarray(positions)
+                            active = np.asarray(active)
+                            temps = np.asarray(temps)
+                            top_ps = np.asarray(top_ps)
+                            top_ks = np.asarray(top_ks)
+                            key = np.asarray(key)
+                            ch.publish(("paged_fused", int(needed), bt,
+                                        int(slot), toks, int(start),
+                                        int(length), int(write_slot),
+                                        positions, active, temps,
+                                        top_ps, top_ks, key))
+                            return prog(params, cache, logits, bt,
+                                        np.int32(slot), toks,
+                                        np.int32(start),
+                                        np.int32(length),
+                                        np.int32(write_slot), positions,
+                                        active, temps, top_ps, top_ks,
+                                        key)
+                        except Exception as e:  # noqa: BLE001
+                            raise self._fatal(e)
+
+                    return call
+
+                self._paged_fused_for = paged_fused_for
+
+            if self.spec_k > 0:
+                pverify_inner = self._paged_verify_for
+
+                def paged_verify_for(needed: int):
+                    prog = pverify_inner(needed)
+
+                    def call(params, cache, logits, bt, drafts, banned,
+                             positions, active, temps, top_ps, top_ks,
+                             key):
+                        try:
+                            bt = np.asarray(bt)
+                            drafts = np.asarray(drafts)
+                            banned = np.asarray(banned)
+                            positions = np.asarray(positions)
+                            active = np.asarray(active)
+                            temps = np.asarray(temps)
+                            top_ps = np.asarray(top_ps)
+                            top_ks = np.asarray(top_ks)
+                            key = np.asarray(key)
+                            ch.publish(("paged_verify", int(needed), bt,
+                                        drafts, banned, positions,
+                                        active, temps, top_ps, top_ks,
+                                        key))
+                            return prog(params, cache, logits, bt,
+                                        drafts, banned, positions,
+                                        active, temps, top_ps, top_ks,
+                                        key)
+                        except Exception as e:  # noqa: BLE001
+                            raise self._fatal(e)
+
+                    return call
+
+                self._paged_verify_for = paged_verify_for
+
+                if self.prefill_budget > 0:
+                    pfv_inner = self._paged_fused_verify_for
+
+                    def paged_fused_verify_for(needed: int):
+                        prog = pfv_inner(needed)
+
+                        def call(params, cache, logits, bt, slot, toks,
+                                 start, length, write_slot, drafts,
+                                 banned, positions, active, temps,
+                                 top_ps, top_ks, key):
+                            try:
+                                bt = np.asarray(bt)
+                                toks = np.asarray(toks)
+                                drafts = np.asarray(drafts)
+                                banned = np.asarray(banned)
+                                positions = np.asarray(positions)
+                                active = np.asarray(active)
+                                temps = np.asarray(temps)
+                                top_ps = np.asarray(top_ps)
+                                top_ks = np.asarray(top_ks)
+                                key = np.asarray(key)
+                                ch.publish(("paged_fused_verify",
+                                            int(needed), bt, int(slot),
+                                            toks, int(start),
+                                            int(length),
+                                            int(write_slot), drafts,
+                                            banned, positions, active,
+                                            temps, top_ps, top_ks, key))
+                                return prog(params, cache, logits, bt,
+                                            np.int32(slot), toks,
+                                            np.int32(start),
+                                            np.int32(length),
+                                            np.int32(write_slot),
+                                            drafts, banned, positions,
+                                            active, temps, top_ps,
+                                            top_ks, key)
+                            except Exception as e:  # noqa: BLE001
+                                raise self._fatal(e)
+
+                        return call
+
+                    self._paged_fused_verify_for = paged_fused_verify_for
+
         if self.prefix_segments > 0:
             # shared-prefix segment ops join the control stream: segment
             # creation (prefill + merge into the segment pool), batched
@@ -926,6 +1106,53 @@ def follow(engine: contlib.ContinuousEngine, channel: GangChannel) -> None:
                     np.int32(length), np.int32(write_slot), drafts,
                     banned, positions, active, temps, top_ps, top_ks,
                     key))
+        elif op == "paged_decode":
+            (_, needed, bt, positions, active, temps, top_ps, top_ks,
+             key) = msg
+            engine._pool_cache, engine._pool_logits, _toks = (
+                engine._paged_decode_for(needed)(
+                    params, engine._pool_cache, engine._pool_logits, bt,
+                    positions, active, temps, top_ps, top_ks, key))
+        elif op == "paged_chunk":
+            (_, needed, budget, bt_row, toks, start, length,
+             write_slot) = msg
+            engine._pool_cache, engine._pool_logits = (
+                engine._paged_chunk_for(needed, budget)(
+                    params, engine._pool_cache, engine._pool_logits,
+                    bt_row, toks, np.int32(start), np.int32(length),
+                    np.int32(write_slot)))
+        elif op == "paged_fused":
+            (_, needed, bt, slot, toks, start, length, write_slot,
+             positions, active, temps, top_ps, top_ks, key) = msg
+            engine._pool_cache, engine._pool_logits, _toks = (
+                engine._paged_fused_for(needed)(
+                    params, engine._pool_cache, engine._pool_logits, bt,
+                    np.int32(slot), toks, np.int32(start),
+                    np.int32(length), np.int32(write_slot), positions,
+                    active, temps, top_ps, top_ks, key))
+        elif op == "paged_verify":
+            (_, needed, bt, drafts, banned, positions, active, temps,
+             top_ps, top_ks, key) = msg
+            engine._pool_cache, engine._pool_logits, _toks, _acc = (
+                engine._paged_verify_for(needed)(
+                    params, engine._pool_cache, engine._pool_logits, bt,
+                    drafts, banned, positions, active, temps, top_ps,
+                    top_ks, key))
+        elif op == "paged_fused_verify":
+            (_, needed, bt, slot, toks, start, length, write_slot,
+             drafts, banned, positions, active, temps, top_ps, top_ks,
+             key) = msg
+            engine._pool_cache, engine._pool_logits, _toks, _acc = (
+                engine._paged_fused_verify_for(needed)(
+                    params, engine._pool_cache, engine._pool_logits, bt,
+                    np.int32(slot), toks, np.int32(start),
+                    np.int32(length), np.int32(write_slot), drafts,
+                    banned, positions, active, temps, top_ps, top_ks,
+                    key))
+        elif op == "block_copy":
+            _, src, dst = msg
+            engine._pool_cache = engine._block_copy(
+                engine._pool_cache, np.int32(src), np.int32(dst))
         elif op == "prefix":
             _, total, sb, src, dst, lp, suffix, slen = msg
             engine._pool_cache, engine._pool_logits = (

@@ -1,0 +1,270 @@
+"""Paged KV: the block-granular cache economy under every engine variant.
+
+[upstream: kserve huggingfaceserver's vLLM backend] — vLLM's defining
+memory design is *PagedAttention*: KV lives in fixed-size blocks owned by
+a free-list allocator, requests hold per-sequence block tables, and
+prefix sharing/copy-on-write happen at block granularity (ISSUE 6,
+ROADMAP item 1).  The slot pool this replaces reserved ``max_seq_len``
+contiguous KV per slot — a 32-token conversation paid for 4096 — and its
+four parallel sharing regimes (slot-copy prefix cache, refcounted
+whole-segment LCP, the tier ladder, int8 KV) each needed their own
+programs and admission paths.
+
+TPU-first shape of the port (vs vLLM's CUDA paged-attention kernels):
+XLA wants static shapes and the models' decode math already operates on
+a contiguous per-row cache, so the paged programs in
+serving/continuous.py GATHER each dispatch's working view from the
+block pool (``gather_block_view``: per-slot block tables -> the exact
+[slots, attend, ...] layout the existing decode/prefill/verify bodies
+consume, warmed per attend rung so ``jit_recompiles_total`` stays 0)
+and scatter the written blocks back (``scatter_block_view``).  The
+attention/sampling math is byte-identical to the slot-pool programs —
+greedy parity against every pre-paged variant is the refactor's bar —
+while the *storage* becomes block-granular: allocation tracks actual
+sequence length, prefixes share in ``block_size`` quanta across live
+AND retired sequences, and a diverging request forks the boundary block
+with one on-device copy (COW).
+
+Host side, this module owns :class:`BlockAllocator`: free list with
+LRU-ordered reuse (a freed block keeps its bytes AND its token-content
+registration until reallocated, so the free list doubles as the prefix
+cache — the vLLM free-list-as-cache move), refcounts for block sharing,
+and the retired-sequence registry the engine's prefix matcher consults.
+Everything here is host numpy on the scheduler thread; the analyzer's
+``host-sync-in-dispatch`` rule walks ``*Allocator`` classes exactly so
+a stray ``.item()`` on the free list can never creep into the dispatch
+path.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def gather_block_view(pool, bt, block_axes, seq_axes):
+    """Per-row contiguous KV view gathered from the block pool.
+
+    ``pool``: cache pytree shaped like a slot pool but with the row axis
+    = blocks and the seq axis = ``block_size`` (cache_shapes of a
+    block-sized config).  ``bt``: [rows, nblk] int32 block tables; an
+    out-of-range id (the pad sentinel) clips to the last block — its
+    bytes are garbage the per-row causal mask already hides, exactly the
+    slot pool's stale-KV argument.  ``block_axes``/``seq_axes``: per-leaf
+    (row, seq) axis trees probed on the block pool; the view's layout
+    mirrors the pool's (k/v keep seq right after the row axis, int8-KV
+    scale buffers keep it LAST), so the same trees drive both hops.
+
+    Returns the [rows, nblk*block_size, ...] view every leaf — the exact
+    buffer layout the slot-pool decode/prefill/verify bodies consume.
+    """
+    def leaf(c, a, s):
+        if a is None:  # cache_index bookkeeping: shape-free passthrough
+            return c
+        # mode="clip": the pad sentinel reads the LAST block — finite
+        # garbage the causal mask hides (fill-mode NaNs would poison the
+        # masked lanes instead of being ignored)
+        g = jnp.take(c, bt, axis=a, mode="clip")  # rows at a, nblk at a+1
+        g = jnp.moveaxis(g, s + 1, a + 2)    # [..., rows, nblk, bs, ...]
+        sh = list(g.shape)
+        sh[a + 1:a + 3] = [sh[a + 1] * sh[a + 2]]
+        g = g.reshape(sh)                    # merged seq at a+1
+        return jnp.moveaxis(g, a + 1, s)     # seq back to its layout slot
+
+    return jax.tree.map(leaf, pool, block_axes, seq_axes)
+
+
+def scatter_block_view(pool, view, bt, block_axes, seq_axes):
+    """Write a gathered view's blocks back into the pool at ``bt``.
+
+    Every gathered block scatters (mode="drop": the pad sentinel's
+    writes vanish).  Blocks shared by several rows of one dispatch are
+    full immutable prefix blocks — no row may write below its own front,
+    so duplicate indices carry identical bytes and the write order XLA
+    picks is invisible.
+    """
+    def leaf(c, v, a, s):
+        if a is None:
+            return c
+        w = jnp.moveaxis(v, s, a + 1)        # seq right after the row axis
+        sh = list(w.shape)
+        sh[a + 1:a + 2] = [bt.shape[1], c.shape[s]]
+        w = w.reshape(sh)                    # [..., rows, nblk, bs, ...]
+        w = jnp.moveaxis(w, a + 2, s + 1)    # bs back to the pool's seq slot
+        idx = (slice(None),) * a + (bt,)
+        return c.at[idx].set(w, mode="drop")
+
+    return jax.tree.map(leaf, pool, view, block_axes, seq_axes)
+
+
+def lcp(content, prompt_arr: np.ndarray, cap: int) -> int:
+    """Longest common prefix of a token sequence and the prompt array,
+    capped — vectorized, runs per candidate per admission on the
+    scheduler thread (the ONE implementation: the engine's slot/segment
+    matchers and the allocator registry both import it)."""
+    n = min(len(content), cap)
+    if n <= 0:
+        return 0
+    # analysis: ok host-sync-in-dispatch — host token list, no device value
+    c = np.asarray(content[:n], np.int64)
+    neq = np.nonzero(c != prompt_arr[:n])[0]
+    return int(neq[0]) if neq.size else n
+
+
+class BlockAllocator:
+    """Fixed-size KV block economy: free list, refcounts, COW counters,
+    and the retired-sequence prefix registry.
+
+    Block ids are [0, num_blocks); the dispatch-side pad sentinel is
+    ``num_blocks`` itself (out of range: gathers clip, scatters drop) so
+    every pool row is a real allocatable block.
+
+    Free-list-as-cache: ``release`` appends a refcount-zero block to the
+    tail of an ordered free map WITHOUT clearing it — its bytes stay in
+    HBM and any sequence registered over it stays prefix-matchable.
+    ``alloc`` pops from the head (oldest-freed first, the LRU eviction
+    order) and only THEN invalidates registrations touching the block —
+    reuse costs a dict pop, never a clearing dispatch.  ``ref`` on a
+    zero-ref block resurrects it out of the free list (a prefix hit on
+    a retired conversation's blocks).
+    """
+
+    def __init__(self, num_blocks: int, block_size: int):
+        if num_blocks < 1:
+            raise ValueError("num_blocks must be >= 1")
+        if block_size < 1:
+            raise ValueError("block_size must be >= 1")
+        self.num_blocks = int(num_blocks)
+        self.block_size = int(block_size)
+        self._refs = np.zeros(self.num_blocks, np.int64)
+        #: insertion-ordered free map: keys are free block ids, oldest
+        #: freed first (the eviction order); values unused
+        self._free: "OrderedDict[int, None]" = OrderedDict(
+            (b, None) for b in range(self.num_blocks))
+        #: retired sequences still matchable: seq_id -> (tokens, blocks)
+        #: (insertion-ordered: oldest registration evicts first)
+        self._seqs: dict[int, tuple[np.ndarray, tuple[int, ...]]] = {}
+        self._block_seqs: dict[int, set[int]] = {}
+        self._next_seq = 0
+        #: registry bound: a hot shared prefix re-registers on EVERY
+        #: retirement while resurrection keeps its blocks off the
+        #: alloc path (the only lazy pruner), so without a cap the
+        #: registry — and the per-admission match() scan — grows with
+        #: traffic, not with the pool.  There are at most num_blocks
+        #: distinct useful first-blocks, so that is the natural bound.
+        self._max_seqs = self.num_blocks
+        self.cow_copies_total = 0
+        self.prefix_block_hits_total = 0
+
+    # -- capacity ---------------------------------------------------------
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    @property
+    def pad_block(self) -> int:
+        """Out-of-range id used to pad block tables (gather clips,
+        scatter drops)."""
+        return self.num_blocks
+
+    # -- allocation / refcounts ------------------------------------------
+
+    def alloc(self, n: int) -> Optional[list[int]]:
+        """Pop ``n`` blocks off the free list (refcount 1 each), oldest
+        freed first; None when fewer than ``n`` are free — the caller's
+        admission backpressure, never a partial grant."""
+        if n < 0:
+            raise ValueError("alloc count must be >= 0")
+        if n > len(self._free):
+            return None
+        out: list[int] = []
+        for _ in range(n):
+            b, _ = self._free.popitem(last=False)
+            self._refs[b] = 1
+            self._invalidate(b)
+            out.append(b)
+        return out
+
+    def ref(self, blocks) -> None:
+        """Take a reference on each block (prefix sharing).  A zero-ref
+        block resurrects out of the free list — its bytes were never
+        cleared, so the cached KV is still ground truth."""
+        for b in blocks:
+            if self._refs[b] == 0:
+                self._free.pop(b, None)
+            self._refs[b] += 1
+
+    def release(self, blocks) -> None:
+        """Drop one reference per block; refcount-zero blocks join the
+        free-list TAIL uncleaned (reuse without clearing — the per-row
+        causal mask hides stale bytes, and registrations stay valid)."""
+        for b in blocks:
+            self._refs[b] -= 1
+            if self._refs[b] < 0:
+                raise RuntimeError(f"block {b} over-released")
+            if self._refs[b] == 0:
+                self._free[b] = None
+
+    # -- retired-sequence prefix registry --------------------------------
+
+    def register(self, tokens, blocks) -> None:
+        """Record a retired sequence (its KV still sits in ``blocks``)
+        for future prefix matches; entries die lazily when a covering
+        block is reallocated."""
+        cover = -(-len(tokens) // self.block_size)
+        blocks = tuple(int(b) for b in blocks[:cover])
+        if not blocks or len(tokens) < self.block_size:
+            return  # nothing shareable at block granularity
+        sid = self._next_seq
+        self._next_seq += 1
+        # analysis: ok host-sync-in-dispatch — host token list, no device value
+        self._seqs[sid] = (np.asarray(tokens, np.int64), blocks)
+        for b in blocks:
+            self._block_seqs.setdefault(b, set()).add(sid)
+        while len(self._seqs) > self._max_seqs:
+            self._drop_seq(next(iter(self._seqs)))
+
+    def _drop_seq(self, sid: int) -> None:
+        entry = self._seqs.pop(sid, None)
+        if entry is None:
+            return
+        for b in entry[1]:
+            peers = self._block_seqs.get(b)
+            if peers:
+                peers.discard(sid)
+                if not peers:
+                    del self._block_seqs[b]
+
+    def _invalidate(self, block: int) -> None:
+        for sid in list(self._block_seqs.pop(block, ())):  # content dies
+            self._drop_seq(sid)
+
+    def match(self, prompt_arr: np.ndarray, cap: int
+              ) -> tuple[tuple[int, ...], int]:
+        """Best retired-sequence prefix match: (blocks, lcp tokens).
+        The caller shares ``lcp // block_size`` full blocks by ref and
+        may COW-fork the boundary block for the partial remainder."""
+        best_blocks: tuple[int, ...] = ()
+        best = 0
+        for tokens, blocks in self._seqs.values():
+            lim = min(len(tokens), len(blocks) * self.block_size, cap)
+            if lim <= best:
+                continue
+            n = lcp(tokens, prompt_arr, lim)
+            if n > best:
+                best, best_blocks = n, blocks
+        return best_blocks, best
+
+    def stats(self) -> dict:
+        return {
+            "kv_block_size": self.block_size,
+            "kv_blocks_total": self.num_blocks,
+            "kv_blocks_free": len(self._free),
+            "kv_blocks_cow_copies_total": self.cow_copies_total,
+            "prefix_block_hits_total": self.prefix_block_hits_total,
+        }

@@ -101,6 +101,39 @@ class FooEngine:
         assert lint_snippet(tmp_path, code,
                             ["host-sync-in-dispatch"]) == []
 
+    def test_allocator_methods_are_roots(self, tmp_path):
+        """ISSUE 6 satellite: the paged-KV allocator runs ON the
+        scheduler's dispatch path, so EVERY ``*Allocator`` method is a
+        root — a ``.item()`` on the free list is flagged even though no
+        ``_loop``/``_admit`` exists in the file."""
+        code = """
+import numpy as np
+
+class BlockAllocator:
+    def alloc(self, n):
+        return int(self._refs.sum().item())
+
+    def tables(self, bt):
+        return np.asarray(bt)
+"""
+        found = lint_snippet(tmp_path, code, ["host-sync-in-dispatch"],
+                             rel="kubeflow_tpu/serving/_palloc.py")
+        scopes = {f.scope for f in found}
+        assert "BlockAllocator.alloc" in scopes
+        assert "BlockAllocator.tables" in scopes
+
+    def test_allocator_near_miss_other_class(self, tmp_path):
+        code = """
+import numpy as np
+
+class BlockTableHelper:
+    def tables(self, bt):
+        return np.asarray(bt)
+"""
+        assert lint_snippet(tmp_path, code,
+                            ["host-sync-in-dispatch"],
+                            rel="kubeflow_tpu/serving/_palloc.py") == []
+
 
 class TestJitInLoopRule:
     def test_true_positive(self, tmp_path):

@@ -275,21 +275,31 @@ class TestTieredEngine:
             eng.stop()
         assert got_short == want_short and got_long == want_long
 
-    def test_short_pool_window_structurally_bounded(self, tiny_llama):
-        """The short pool's cache BUFFER is short_len long — reading past
-        it is impossible by construction, not by scheduling luck."""
+    def test_one_paged_pool_no_per_tier_kv(self, tiny_llama):
+        """ISSUE 6: the ladder is an admission POLICY over ONE paged
+        pool — no per-tier KV pools remain.  The single pool's cache is
+        block-granular (rows = blocks, seq = block_size), and the class
+        quotas are enforced by the engine's admission_policy hook."""
         from kubeflow_tpu.serving.continuous import TieredEngine
 
         cfg, params = tiny_llama
         eng = TieredEngine(cfg, params, short_len=32, num_slots=4,
                            decode_chunk=1)
         try:
-            big = [x for x in jax.tree.leaves(eng.short._pool_cache)
+            assert len(eng.pools) == 1
+            assert eng.short is eng.long is eng.engine
+            assert eng.engine.paged and eng.engine.block_size > 0
+            bs = eng.engine.block_size
+            big = [x for x in jax.tree.leaves(eng.engine._pool_cache)
                    if x.ndim >= 4]
-            assert big and all(x.shape[-3] == 32 for x in big)
-            lbig = [x for x in jax.tree.leaves(eng.long._pool_cache)
-                    if x.ndim >= 4]
-            assert all(x.shape[-3] == cfg.max_seq_len for x in lbig)
+            # every big leaf stores BLOCKS: seq dim == block_size, row
+            # dim == num_blocks — max_seq_len appears nowhere resident
+            assert big and all(x.shape[-3] == bs for x in big)
+            assert all(x.shape[-4] == eng.engine.num_blocks for x in big)
+            assert (eng.engine.admission_policy.__func__
+                    is TieredEngine._admit_quota)
+            st = eng.stats()
+            assert [c["quota"] for c in st["classes"]] == eng.quotas
         finally:
             eng.stop()
 
@@ -369,7 +379,8 @@ class TestCancellationAndStats:
         ref = register_mem("stats-llama", (cfg, params))
         m = ContinuousLlamaGenerator(
             "statgen", {"params_ref": ref, "max_new_tokens": 3,
-                        "num_slots": 2, "warmup_groups": []})
+                        "num_slots": 2, "block_size": 16,
+                        "warmup_groups": []})
         srv = ModelServer()
         srv.register(m)
         srv.start()
@@ -400,6 +411,17 @@ class TestCancellationAndStats:
             assert 'kft_engine_spec_dispatches_total{model="statgen"} 0' \
                 in text
             assert "# TYPE kft_engine_spec_acceptance_rate gauge" in text
+            # paged-KV block economy (ISSUE 6) rides the same export:
+            # totals/free expose capacity, COW + prefix-block counters
+            # expose the sharing economy, fragmentation the waste
+            assert 'kft_engine_kv_blocks_total{model="statgen"} 16' \
+                in text  # 2 slots * ceil(128/16)
+            assert "# TYPE kft_engine_kv_blocks_free gauge" in text
+            assert 'kft_engine_kv_blocks_cow_copies_total{model="statgen"}' \
+                " 0" in text
+            assert 'kft_engine_prefix_block_hits_total{model="statgen"}' \
+                in text
+            assert "# TYPE kft_engine_kv_fragmentation_ratio gauge" in text
         finally:
             srv.stop()
 
@@ -458,10 +480,10 @@ class TestPerRequestTemperature:
 
 
 class TestNTierEngine:
-    """r4 weak #7: the tiered pool generalized past two tiers — requests
-    route to the smallest pool whose KV buffer fits their known total,
-    and each capped tier's decode programs are structurally incapable of
-    reading past its cap."""
+    """r4 weak #7, re-anchored by ISSUE 6: ``tier_lens`` classifies
+    requests by known total length and guarantees each class its
+    concurrency share — as an admission policy over ONE paged pool, not
+    per-tier KV pools (deleted, not wrapped)."""
 
     def _setup(self):
         import jax
@@ -496,16 +518,18 @@ class TestNTierEngine:
             tier_slots=[2, 2], decode_chunk=2, eos_id=None,
             prefix_cache=False)
         try:
-            assert len(eng.pools) == 3
-            # tier caps bound each pool's KV buffer structurally
-            for pool, cap in zip(eng.pools, [16, 64, 128]):
-                big = [x for x in jax.tree.leaves(pool._pool_cache)
-                       if x.ndim >= 4]
-                assert all(x.shape[-3] == cap for x in big)
+            assert len(eng.pools) == 1  # ONE paged pool, ladder = policy
+            assert eng.quotas == [2, 2, 2]
+            # classification still splits the ladder (totals 7, 34, 74)
+            import types
+
+            classes = [eng._classify(types.SimpleNamespace(
+                prompt=p, max_new_tokens=4)) for p in prompts]
+            assert classes == [0, 1, 2]
             got = [eng.generate(p, max_new_tokens=4) for p in prompts]
             st = eng.stats()
-            # one request landed in each tier (totals 7, 34, 74)
-            assert [d["tokens_emitted"] for d in st["pools"]] == [4, 4, 4]
+            assert st["tokens_emitted"] == 12
+            assert [c["quota"] for c in st["classes"]] == [2, 2, 2]
         finally:
             eng.stop()
         assert got == want
