@@ -8,7 +8,12 @@ serializes host and device and re-introduces the per-token round trip
 the dispatch-ahead pipeline exists to hide.  The rule builds the
 intra-file call graph from every ``*Engine`` class's scheduler roots
 (``_loop``/``_admit``/``_process``...) and flags host-materialization
-calls in anything reachable.  ``*Allocator`` classes (the paged-KV
+calls in anything reachable — and, on the same reachability, blocking
+SOCKET I/O (``sendall``/``recv``/``create_connection``, ISSUE 8): live
+KV migration streams block bytes between replicas, and a socket send on
+the scheduler thread would stall every live request for a network round
+trip (or forever, on a wedged peer) — the migrate path runs on worker
+threads, the scheduler only services its mailbox.  ``*Allocator`` classes (the paged-KV
 block economy, serving/paged.py) sit ON the dispatch path — every
 admission and block-table assembly runs them between dispatches — so
 ALL their methods are roots: block-table math must stay host-side
@@ -193,6 +198,23 @@ def _is_np_materialize(call: ast.Call) -> bool:
                           (ast.List, ast.ListComp, ast.Tuple, ast.Constant))
 
 
+#: blocking socket I/O attribute calls: a ``sendall``/``recv`` reachable
+#: from the scheduler stalls EVERY live request for a network round trip
+#: (or forever, on a wedged peer) — the KV-migration streaming path
+#: (ISSUE 8) must run on a worker thread, with the scheduler touching
+#: only its mailbox.  ``send`` is deliberately absent: generator.send
+#: and queue-ish .send() false-positive; migration code uses sendall.
+_BLOCKING_SOCKET_ATTRS = {"sendall", "recv", "recv_into", "accept"}
+
+
+def _is_blocking_socket(call: ast.Call) -> bool:
+    if (isinstance(call.func, ast.Attribute)
+            and call.func.attr in _BLOCKING_SOCKET_ATTRS):
+        return True
+    return _dotted(call.func) in ("socket.create_connection",
+                                  "create_connection")
+
+
 _REDUCERS = {"max", "min", "sum", "mean", "any", "all", "argmax", "argmin"}
 
 
@@ -216,6 +238,8 @@ _HOST_SYNCS = (
     ("numpy materialization (`np.asarray`/`np.array`)", _is_np_materialize),
     ("scalarized reduction (`int`/`float` of `.max()`-like)",
      _is_scalarized_reduction),
+    ("blocking socket I/O (`sendall`/`recv`/`create_connection` — "
+     "migration streaming must run off-thread)", _is_blocking_socket),
 )
 
 

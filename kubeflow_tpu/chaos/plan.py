@@ -169,6 +169,25 @@ class FaultPlan:
                                  after_calls=after_calls, times=times))
         return self
 
+    def kv_migrate_drop(self, after_frames: Optional[int] = None,
+                        times: int = 1,
+                        max_frames: int = 12) -> "FaultPlan":
+        """Kill a live KV migration mid-stream: the next ``times``
+        sockets wrapped for role ``"kv_migrate"`` die after
+        ``after_frames`` send/recv calls (None = seeded random offset in
+        ``[0, max_frames)`` — early kills hit the handshake/kv_begin,
+        late ones land mid-block or between commit and ack).  Consume
+        via ``sock_wrap=plan.socket_wrapper("kv_migrate")`` on
+        ``migrate_sequence`` / ``KvMigrationServer``.  The contract
+        under test is copy-then-cutover (ISSUE 8): the source sequence
+        keeps decoding, no client token is duplicated or dropped, and
+        neither allocator leaks a block."""
+        if after_frames is None:
+            after_frames = self.rng.randrange(max_frames)
+        self.faults.append(Fault(FaultKind.SOCKET_DROP, role="kv_migrate",
+                                 after_calls=after_frames, times=times))
+        return self
+
     def control_plane_crash(self, after_records: Optional[int] = None,
                             max_records: int = 64,
                             torn_bytes: Optional[int] = None) -> "FaultPlan":

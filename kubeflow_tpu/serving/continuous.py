@@ -66,7 +66,12 @@ from ..analysis.runtime import RecompileCounter, recompile_guard
 from ..models import llama as llamalib
 from . import sharded as shardedlib
 from .model import Model
-from .paged import BlockAllocator, gather_block_view, scatter_block_view
+from .paged import (
+    BlockAllocator,
+    gather_block_view,
+    scatter_block_view,
+    write_window_tables,
+)
 from .paged import lcp as _lcp  # noqa: F401 — the one LCP implementation
 from .storage import fetch_mem
 
@@ -576,8 +581,13 @@ def make_paged_decode_program(cfg, attend: int, chunk: int, block_size: int,
         keys = jax.random.split(key, chunk)
         (view, logits, _pos), toks = jax.lax.scan(
             step, (view, logits, safe), keys)
+        # write-back narrowed to the written suffix window: this dispatch
+        # wrote row r only at [safe[r], safe[r]+chunk) — shared prefix
+        # blocks and idle rows (safe = view_len) scatter nothing
+        bt_w = write_window_tables(bt, safe, block_size)
         pool = shardedlib.constrain_cache(
-            scatter_block_view(pool, view, bt, block_axes, seq_axes), mesh)
+            scatter_block_view(pool, view, bt_w, block_axes, seq_axes),
+            mesh)
         return pool, logits, shardedlib.constrain_replicated(toks.T, mesh)
 
     return shardedlib.mesh_jit(mesh, decode, donate_argnums=(1, 2))
@@ -600,8 +610,12 @@ def make_paged_chunk_prefill_program(cfg, attend: int, budget: int,
         view = gather_block_view(pool, bt_row, block_axes, seq_axes)
         view, logits = body(params, view, logits, jnp.int32(0), toks,
                             start, length, write_slot)
+        # the chunk writes only [start, start+budget): prefix blocks the
+        # slot shares (full blocks below start) scatter nothing
+        bt_w = write_window_tables(
+            bt_row, jnp.reshape(start, (1,)), block_size)
         pool = shardedlib.constrain_cache(
-            scatter_block_view(pool, view, bt_row, block_axes, seq_axes),
+            scatter_block_view(pool, view, bt_w, block_axes, seq_axes),
             mesh)
         return pool, shardedlib.constrain_logits(logits, mesh)
 
@@ -644,8 +658,16 @@ def make_paged_fused_step_program(cfg, attend: int, chunk: int, budget: int,
         keys = jax.random.split(key, chunk)
         (view, logits, _pos), out = jax.lax.scan(
             step, (view, logits, safe), keys)
+        # per-row write fronts: decode rows write from their position,
+        # the admitting slot's chunk writes from ``start``, idle rows
+        # write nothing (front = view_len) — scatter only those blocks
+        front = jnp.where(
+            jnp.arange(bt.shape[0], dtype=jnp.int32) == slot,
+            jnp.minimum(safe, start), safe)
+        bt_w = write_window_tables(bt, front, block_size)
         pool = shardedlib.constrain_cache(
-            scatter_block_view(pool, view, bt, block_axes, seq_axes), mesh)
+            scatter_block_view(pool, view, bt_w, block_axes, seq_axes),
+            mesh)
         return pool, logits, shardedlib.constrain_replicated(out.T, mesh)
 
     return shardedlib.mesh_jit(mesh, fused, donate_argnums=(1, 2))
@@ -670,8 +692,13 @@ def make_paged_verify_program(cfg, attend: int, k: int, block_size: int,
         view, logits, toks, accept = vmath(
             params, view, logits, drafts, banned, positions, active,
             temps, top_ps, top_ks, key)
+        # the verify writes [pos, pos+k+1) per active row — blocks below
+        # the position front (shared prefixes included) scatter nothing
+        bt_w = write_window_tables(
+            bt, jnp.where(active, positions, view_len), block_size)
         pool = shardedlib.constrain_cache(
-            scatter_block_view(pool, view, bt, block_axes, seq_axes), mesh)
+            scatter_block_view(pool, view, bt_w, block_axes, seq_axes),
+            mesh)
         return pool, logits, toks, accept
 
     return shardedlib.mesh_jit(mesh, verify, donate_argnums=(1, 2))
@@ -700,8 +727,14 @@ def make_paged_fused_verify_program(cfg, attend: int, k: int, budget: int,
         view, logits, vtoks, accept = vmath(
             params, view, logits, drafts, banned, positions, active,
             temps, top_ps, top_ks, key)
+        base = jnp.where(active, positions, view_len)
+        front = jnp.where(
+            jnp.arange(bt.shape[0], dtype=jnp.int32) == slot,
+            jnp.minimum(base, start), base)
+        bt_w = write_window_tables(bt, front, block_size)
         pool = shardedlib.constrain_cache(
-            scatter_block_view(pool, view, bt, block_axes, seq_axes), mesh)
+            scatter_block_view(pool, view, bt_w, block_axes, seq_axes),
+            mesh)
         return pool, logits, vtoks, accept
 
     return shardedlib.mesh_jit(mesh, fused, donate_argnums=(1, 2))
@@ -725,6 +758,88 @@ def make_block_copy_program(block_axes, mesh=None):
             jax.tree.map(leaf, pool, block_axes), mesh)
 
     return shardedlib.mesh_jit(mesh, copy, donate_argnums=(0,))
+
+
+#: blocks per migration gather/scatter dispatch: the table is a FIXED
+#: [KV_MIGRATE_GROUP, 1] shape (padded with the sentinel), so ONE
+#: compiled program each way serves sequences of any length while the
+#: per-dispatch overhead amortizes over 8 blocks — an import between
+#: two decode dispatches costs ceil(nblocks/8) scatters, not nblocks
+#: (the import-stall tax the migration bench measures)
+KV_MIGRATE_GROUP = 8
+
+
+def make_kv_export_program(block_axes, seq_axes, mesh=None):
+    """Migration gather (ISSUE 8): up to KV_MIGRATE_GROUP blocks' bytes
+    out of the pool as a tuple of row-major [G, block_size, ...] leaves
+    (row axis moved FIRST so the host slices per-block without knowing
+    each leaf's layout; cache_index bookkeeping leaves skipped — the
+    destination has its own).  Fixed [G, 1] table shape: pad rows carry
+    the clip sentinel and are sliced off host-side.  The pool is NOT
+    donated: export is a read (copy-then-cutover — the source keeps
+    decoding until the destination acks)."""
+
+    def export(pool, bt_rows):
+        view = gather_block_view(pool, bt_rows, block_axes, seq_axes)
+        out = []
+
+        def pick(v, a):
+            if a is not None:
+                out.append(jnp.moveaxis(v, a, 0))
+            return v
+
+        jax.tree.map(pick, view, block_axes)
+        return tuple(out)
+
+    return shardedlib.mesh_jit(mesh, export)
+
+
+def make_kv_import_program(block_axes, seq_axes, mesh=None):
+    """Migration scatter (ISSUE 8): write up to KV_MIGRATE_GROUP
+    received blocks' leaves into the pool at the [G, 1] table — the
+    exact inverse of :func:`make_kv_export_program` (leaves arrive
+    row-major, rows move back to each leaf's probed axis), same fixed
+    shape, pool donated.  Pad rows carry the out-of-range sentinel and
+    drop.  Leaf order matches export's (deterministic tree flatten
+    order)."""
+
+    def imp(pool, bt_rows, leaves):
+        it = iter(leaves)
+        # rebuild the view tree: real block leaves from the wire, the
+        # axis-None bookkeeping leaves from the pool (scatter ignores
+        # them — scatter_block_view returns the pool leaf unchanged)
+        view = jax.tree.map(
+            lambda c, a: (jnp.moveaxis(next(it), 0, a)
+                          if a is not None else c),
+            pool, block_axes)
+        return shardedlib.constrain_cache(
+            scatter_block_view(pool, view, bt_rows, block_axes,
+                               seq_axes),
+            mesh)
+
+    return shardedlib.mesh_jit(mesh, imp, donate_argnums=(0,))
+
+
+def make_logits_take_program(mesh=None):
+    """One slot's next-token logits row (migration export; read-only,
+    mode="clip" so the warmup sentinel slot reads harmlessly)."""
+
+    def take(logits, slot):
+        return shardedlib.constrain_replicated(
+            jnp.take(logits, slot, axis=0, mode="clip"), mesh)
+
+    return shardedlib.mesh_jit(mesh, take)
+
+
+def make_logits_set_program(mesh=None):
+    """Install an imported logits row at the destination slot (logits
+    donated; mode="drop" discards the warmup sentinel write)."""
+
+    def put(logits, row, slot):
+        return shardedlib.constrain_logits(
+            logits.at[slot].set(row, mode="drop"), mesh)
+
+    return shardedlib.mesh_jit(mesh, put, donate_argnums=(0,))
 
 
 class DraftProposer:
@@ -1032,6 +1147,24 @@ class ContinuousEngine:
                     request without consuming a slot.  The tier ladder
                     rides this hook (TieredEngine) instead of owning
                     per-tier KV pools.
+    role:           "mixed" (default) | "prefill" | "decode" — the
+                    prefill/decode disaggregation knob (ISSUE 8).  A
+                    ``prefill`` engine admits and chunk-prefills only:
+                    when a sequence's final chunk lands, the slot
+                    FREEZES at the chunk boundary and ``on_prefilled``
+                    (set by :class:`DisaggregatedPool` or the operator)
+                    hands it to a decode replica via
+                    ``export_sequence``/``import_sequence`` — so decode
+                    ITL on the decode tier never pays prefill compute.
+                    A ``decode`` engine is a migration destination; its
+                    direct-submission path stays functional (drain
+                    fallback), routing is the pool's job.  Roles other
+                    than "mixed" require the paged pool: the migration
+                    unit is the KV block.  Migration is COPY-THEN-
+                    CUTOVER: export never frees the source slot; the
+                    caller releases it only after the destination
+                    acks, and a failed transfer resumes decoding in
+                    place.
     """
 
     def __init__(
@@ -1058,6 +1191,7 @@ class ContinuousEngine:
         block_size: int = 0,
         num_blocks: int = 0,
         admission_policy=None,
+        role: str = "mixed",
     ):
         if num_slots < 1:
             raise ValueError("num_slots must be >= 1")
@@ -1080,6 +1214,13 @@ class ContinuousEngine:
                 "prefix_segments is superseded by the paged pool: "
                 "block-granular sharing subsumes whole-segment LCP — "
                 "drop prefix_segments or set block_size=0")
+        if role not in ("mixed", "prefill", "decode"):
+            raise ValueError(
+                f"role {role!r}: must be mixed|prefill|decode")
+        if role != "mixed" and block_size <= 0:
+            raise ValueError(
+                f"role={role} requires the paged pool (block_size > 0): "
+                "the KV migration unit is the block")
         if 0 < cfg.max_seq_len <= block_size:
             raise ValueError(
                 f"block_size {block_size} must be < max_seq_len "
@@ -1133,6 +1274,31 @@ class ContinuousEngine:
         #: assembled fresh per dispatch in _block_tables)
         self._slot_blocks: list[list[int]] = [[] for _ in range(num_slots)]
         self.admission_policy = admission_policy
+        self.role = role
+        #: disaggregation handoff hook (scheduler thread, must not
+        #: block): called with the Request when a prefill-role engine
+        #: finishes a sequence's final chunk — the slot is already
+        #: frozen at the boundary; the hook enqueues the migration for
+        #: an off-thread worker (blocking socket sends from the
+        #: scheduler are exactly what the analyzer's blocking-socket
+        #: extension flags)
+        self.on_prefilled = None
+        #: live KV migration (ISSUE 8): slots frozen pending cutover
+        #: (slot -> {"req", "entry"}) and the cross-thread mailbox the
+        #: scheduler services between dispatches — export/import/resume/
+        #: release all mutate pool + scheduler state, so they run ONLY
+        #: on the scheduler thread
+        self._migrating: dict[int, dict] = {}
+        self._migrate_q: "queue.Queue[tuple]" = queue.Queue()
+        self.kv_migrations_total = 0
+        self.kv_migrate_failures_total = 0
+        self.kv_migrate_bytes_total = 0
+        #: latency histogram (ms) over completed migrations this engine
+        #: initiated (export -> destination ack), fixed buckets + inf
+        self._mig_buckets = (5.0, 10.0, 25.0, 50.0, 100.0, 250.0,
+                             500.0, 1000.0)
+        self._mig_lat_counts = [0] * (len(self._mig_buckets) + 1)
+        self._mig_lat_sum = 0.0
         self.temperature = float(temperature)
         self.eos_id = eos_id
         self.default_max_new_tokens = default_max_new_tokens
@@ -1611,6 +1777,15 @@ class ContinuousEngine:
             self._paged_fused_verify_for = paged_fused_verify_for
             self._block_copy = guard(
                 make_block_copy_program(self._block_axes, mesh))
+            # live KV migration (ISSUE 8): one-block gather/scatter at a
+            # FIXED [1, 1] table shape — the host loops blocks, so one
+            # compiled program each serves sequences of any length
+            self._kv_export = guard(make_kv_export_program(
+                self._block_axes, self._block_seq_axes, mesh))
+            self._kv_import = guard(make_kv_import_program(
+                self._block_axes, self._block_seq_axes, mesh))
+            self._logits_take = guard(make_logits_take_program(mesh))
+            self._logits_set = guard(make_logits_set_program(mesh))
 
         # logits dtype follows the model's activation dtype (bf16 on TPU;
         # the pool logits buffer must match or the decode scan carry
@@ -1948,6 +2123,20 @@ class ContinuousEngine:
             # the COW fork dispatch (dst out of range: dropped)
             self._pool_cache = self._block_copy(
                 self._pool_cache, np.int32(0), np.int32(pad))
+        # migration gather/scatter (ISSUE 8): warm the fixed grouped
+        # shapes so an import mid-serving never compiles.  Live imports
+        # feed NUMPY leaves (the wire hands us host bytes), so warmup
+        # must too — device-typed warmup args re-traced decode programs
+        # once before (the r7 sampling-key lesson)
+        grp = np.full((KV_MIGRATE_GROUP, 1), pad, np.int32)
+        leaves = jax.device_get(self._kv_export(self._pool_cache, grp))
+        zeros = tuple(np.zeros(np.shape(x), np.asarray(x).dtype)
+                      for x in leaves)
+        self._pool_cache = self._kv_import(self._pool_cache, grp, zeros)
+        row = np.asarray(jax.device_get(self._logits_take(
+            self._pool_logits, np.int32(self.num_slots))))
+        self._pool_logits = self._logits_set(
+            self._pool_logits, np.zeros_like(row), np.int32(self.num_slots))
         if toks is not None:
             jax.block_until_ready(toks)
 
@@ -2034,6 +2223,16 @@ class ContinuousEngine:
             "spec_acceptance_rate": round(
                 self.spec_tokens_accepted_total
                 / max(self.spec_tokens_proposed_total, 1), 4),
+            # live KV migration (ISSUE 8): sequences IMPORTED by this
+            # engine (one count per migration — the exporting side's
+            # outbound view is the latency histogram count), payload
+            # bytes both directions, failures counted by the
+            # orchestrating layer, and the export->ack latency
+            # histogram (cumulative buckets, Prometheus-style)
+            "kv_migrations_total": self.kv_migrations_total,
+            "kv_migrate_bytes_total": self.kv_migrate_bytes_total,
+            "kv_migrate_failures_total": self.kv_migrate_failures_total,
+            **self._migration_histogram(),
             # dispatch hygiene (analysis/runtime.py recompile_guard):
             # jit-cache growth past each program's first compile; MUST
             # stay 0 in steady state — a recompile stalls the whole pool
@@ -2047,6 +2246,18 @@ class ContinuousEngine:
             "segment_tokens_shared": self.segment_tokens_shared,
             "segment_evictions": self.segment_evictions,
         }
+
+    def _migration_histogram(self) -> dict:
+        out = {}
+        cum = 0
+        for b, c in zip(self._mig_buckets, self._mig_lat_counts):
+            cum += c
+            out[f"kv_migrate_latency_ms_bucket_le_{b:g}"] = cum
+        cum += self._mig_lat_counts[-1]
+        out["kv_migrate_latency_ms_bucket_le_inf"] = cum
+        out["kv_migrate_latency_ms_count"] = cum
+        out["kv_migrate_latency_ms_sum"] = round(self._mig_lat_sum, 3)
+        return out
 
     def stop(self) -> None:
         with self._gate:
@@ -2070,6 +2281,7 @@ class ContinuousEngine:
             if req is not None and not req.done.is_set():
                 req.error = RuntimeError("engine shut down")
                 req.done.set()
+        self._fail_migration_waiters(RuntimeError("engine shut down"))
 
     # -- scheduler loop ----------------------------------------------------
 
@@ -2554,6 +2766,7 @@ class ContinuousEngine:
         self._slots[slot] = None
         self._active[slot] = False
         self._remaining[slot] = 0
+        self._migrating.pop(slot, None)
         self._release_seg(slot)
         if self.paged and self._slot_blocks[slot]:
             blocks = self._slot_blocks[slot]
@@ -2561,6 +2774,406 @@ class ContinuousEngine:
                 self._alloc.register(self._slot_content[slot], blocks)
             self._alloc.release(blocks)
             self._slot_blocks[slot] = []
+
+    # -- live KV migration (ISSUE 8) ---------------------------------------
+    #
+    # The transferable unit is PR 6's paged block: export gathers a
+    # sequence's written blocks device->host, import allocs + scatters
+    # them on the destination, and the scheduler state (position,
+    # remaining budget, sampling knobs, next-token logits row) rides
+    # along — the destination resumes at the exact position with
+    # bit-identical greedy tokens.  Discipline is COPY-THEN-CUTOVER:
+    # export freezes the slot but frees NOTHING; only release (after
+    # the destination acks) retires it, and resume un-freezes after a
+    # failed transfer.  All pool/scheduler mutation runs on the
+    # scheduler thread via the mailbox; the device->host fetch and any
+    # socket streaming run on the CALLER's thread (the analyzer's
+    # blocking-socket rule pins that split).
+
+    def export_sequence(self, req: Request,
+                        timeout: float = 60.0) -> Optional[dict]:
+        """Copy step: snapshot ``req``'s live KV + scheduler state.
+
+        Freezes the slot at a chunk boundary (in-flight dispatches are
+        drained first) and returns a host snapshot dict — block bytes
+        as numpy leaves, ready for :meth:`import_sequence` or the gang
+        channel's ``kv_migrate`` framing.  Returns None when the
+        request already finished (nothing to migrate).  The source
+        sequence stays intact and decodable until
+        :meth:`release_sequence`."""
+        if not self.paged:
+            raise RuntimeError(
+                "KV migration requires the paged pool (block_size > 0)")
+        out = self._post_migration_op("export", req, None, timeout)
+        snap = out.get("snap")
+        if snap is None:
+            return None
+        # device->host materialization on the CALLER's thread: the
+        # scheduler only dispatched the (grouped) gathers.  Each group
+        # leaf is row-major [G, ...]; slice the valid rows back into
+        # per-block leaf lists (the wire frames stay per-block)
+        nbytes = 0
+        blocks = []
+        for leaves, valid in snap.pop("blocks_dev"):
+            host = [np.asarray(x) for x in jax.device_get(leaves)]
+            for j in range(valid):
+                blk = [x[j:j + 1] for x in host]
+                nbytes += sum(x.nbytes for x in blk)
+                blocks.append(blk)
+        snap["blocks"] = blocks
+        ld = snap.pop("logits_dev", None)
+        if ld is not None:
+            row = np.asarray(jax.device_get(ld))
+            nbytes += row.nbytes
+            snap["logits"] = row
+        self.kv_migrate_bytes_total += nbytes
+        return snap
+
+    def import_sequence(self, snapshot: dict, req: Optional[Request] = None,
+                        timeout: float = 60.0) -> Request:
+        """Cutover step: install an exported sequence into this pool.
+
+        Allocates the sequence's full remaining worst-case block span
+        (admission semantics: exhaustion is a raised rejection, never a
+        partial hold — the source then resumes in place), scatters the
+        received blocks, installs the logits row and scheduler state,
+        and resumes decoding at the exact position.  ``req`` re-targets
+        an existing Request (in-process handoff: the front server's
+        handle keeps streaming, no client reconnect); None builds a
+        fresh one from the snapshot (cross-process import)."""
+        if not self.paged:
+            raise RuntimeError(
+                "KV migration requires the paged pool (block_size > 0)")
+        if snapshot is None:
+            raise ValueError(
+                "snapshot is None — the sequence had already finished "
+                "on the source (export_sequence returned None)")
+        out = self._post_migration_op("import", snapshot, req, timeout)
+        return out["req"]
+
+    def resume_sequence(self, req: Request, timeout: float = 60.0) -> None:
+        """Abort a migration: un-freeze the exported slot so the source
+        keeps decoding as if the transfer never happened (the failed-
+        mid-stream contract; counts into kv_migrate_failures_total at
+        the orchestrating layer)."""
+        self._post_migration_op("resume", req, None, timeout)
+
+    def release_sequence(self, req: Request, timeout: float = 60.0) -> None:
+        """Commit the cutover after the destination acked: retire the
+        source slot.  Blocks join the free list UNCLEARED with the
+        sequence registered, so the migrated-away conversation stays
+        prefix-matchable here until its blocks are actually reused."""
+        self._post_migration_op("release", req, None, timeout)
+
+    def observe_migration_ms(self, ms: float) -> None:
+        """Record one completed migration's export->ack latency into
+        the kv_migrate_latency_ms histogram."""
+        for i, b in enumerate(self._mig_buckets):
+            if ms <= b:
+                break
+        else:
+            i = len(self._mig_buckets)
+        self._mig_lat_counts[i] += 1
+        self._mig_lat_sum += float(ms)
+
+    def _post_migration_op(self, kind: str, a, b, timeout: float) -> dict:
+        ev = threading.Event()
+        out: dict = {}
+        with self._gate:
+            if self._error is not None:
+                raise RuntimeError(
+                    f"engine failed: {self._error!r}") from self._error
+            if self._stop.is_set():
+                raise RuntimeError("engine is shutting down")
+            self._migrate_q.put((kind, a, b, ev, out))
+            self._ensure_running()
+        self._wake.set()
+        if not ev.wait(timeout):
+            # ABANDON the op so it can never execute later: a stale
+            # import landing after the caller resumed the source would
+            # double-decode one request (both flags are set-then-check
+            # under the GIL, so exactly one side wins — either the
+            # scheduler already took the op, and we wait out its
+            # bounded execution, or it will skip it)
+            out["abandoned"] = True
+            if not (out.get("taken") and ev.wait(60)):
+                raise TimeoutError(
+                    f"migration {kind} not serviced within {timeout}s")
+        err = out.get("error")
+        if err is not None:
+            raise err if isinstance(err, Exception) \
+                else RuntimeError(str(err))
+        return out
+
+    def _service_migrations(self, pending) -> None:
+        """Scheduler-side mailbox pump (between dispatches): every
+        migration op mutates pool buffers and scheduler state, so they
+        all run here — the one thread that owns both."""
+        if self._migrate_q.empty():
+            return
+        while True:
+            try:
+                kind, a, b, ev, out = self._migrate_q.get_nowait()
+            except queue.Empty:
+                return
+            out["taken"] = True
+            if out.get("abandoned"):
+                # the caller timed out and already acted on failure
+                # (resumed the source): executing now would double-own
+                # the sequence — drop the op instead
+                out["error"] = RuntimeError("migration op abandoned")
+                ev.set()
+                continue
+            try:
+                if kind == "export":
+                    self._mig_export(a, out, pending)
+                elif kind == "import":
+                    self._mig_import(a, b, out)
+                elif kind == "resume":
+                    self._mig_resume(a)
+                else:
+                    self._mig_release(a)
+            except Exception as e:  # noqa: BLE001 — resolve THIS waiter;
+                # a GangEngine publish failure set _error: re-raise so
+                # the gang goes fatal instead of diverging
+                out["error"] = e
+                ev.set()
+                if self._error is not None:
+                    raise
+                continue
+            ev.set()
+
+    def _fail_migration_waiters(self, e: Exception) -> None:
+        """Resolve every queued migration op with ``e`` (engine death /
+        shutdown) so cross-thread callers never hang on the mailbox."""
+        while True:
+            try:
+                *_a, ev, out = self._migrate_q.get_nowait()
+            except queue.Empty:
+                return
+            out["error"] = e
+            ev.set()
+
+    def _find_req_slot(self, req: Request) -> Optional[int]:
+        for i, r in enumerate(self._slots):
+            if r is req:
+                return i
+        return None
+
+    def _mig_export(self, req: Request, out: dict, pending) -> None:
+        # land every in-flight dispatch first: the slot's position,
+        # delivered tokens and content record must agree before the
+        # snapshot freezes it
+        while pending:
+            self._process(*pending.pop(0))
+        slot = self._find_req_slot(req)
+        if slot is None or req.done.is_set():
+            out["snap"] = None  # finished/cancelled: nothing to migrate
+            return
+        entry = None
+        if slot in self._migrating:
+            entry = self._migrating[slot].get("entry")
+        else:
+            # a partially-prefilled sequence exports at its chunk
+            # boundary: pull its admission entry so no further chunk
+            # dispatches advance it while the transfer runs
+            for e in self._prefilling:
+                if e[0] is req:
+                    entry = e
+                    break
+            if entry is not None:
+                self._prefilling.remove(entry)
+                self._prefill_tokens_inflight -= len(entry[2]) - entry[3]
+            else:
+                self._active[slot] = False
+            self._migrating[slot] = {"req": req, "entry": entry}
+        out["snap"] = self._snapshot_slot(slot, req, entry)
+
+    def _snapshot_slot(self, slot: int, req: Request, entry) -> dict:
+        """Device-side snapshot (scheduler thread): block gathers are
+        DISPATCHED here, fetched by the caller off-thread."""
+        bs = self.block_size
+        if entry is not None:
+            phase = "prefill"
+            prompt, off = list(entry[2]), int(entry[3])
+            position = off
+            generated: list[int] = []
+            remaining = int(req.max_new_tokens)
+            logits_dev = None
+            temp = (self.temperature if req.temperature is None
+                    else req.temperature)
+            top_p = 1.0 if req.top_p is None else req.top_p
+            top_k = 0 if req.top_k is None else req.top_k
+        else:
+            phase = "decode"
+            position = int(self._positions[slot])
+            generated = list(req.tokens)
+            content = list(self._slot_content[slot])
+            prompt = content[: max(position - len(generated), 0)]
+            remaining = int(self._remaining[slot])
+            logits_dev = self._logits_take(self._pool_logits,
+                                           np.int32(slot))
+            temp = float(self._temps[slot])
+            top_p = float(self._top_ps[slot])
+            top_k = int(self._top_ks[slot])
+        nwritten = min(-(-position // bs), len(self._slot_blocks[slot])) \
+            if position > 0 else 0
+        ids = [int(b) for b in self._slot_blocks[slot][:nwritten]]
+        blocks_dev = []  # [(group leaves, valid rows)]
+        for i in range(0, len(ids), KV_MIGRATE_GROUP):
+            grp = ids[i:i + KV_MIGRATE_GROUP]
+            bt = np.full((KV_MIGRATE_GROUP, 1), self._alloc.pad_block,
+                         np.int32)
+            bt[:len(grp), 0] = grp
+            blocks_dev.append(
+                (self._kv_export(self._pool_cache, bt), len(grp)))
+        return {
+            "v": 1, "phase": phase, "block_size": bs,
+            "prompt": [int(t) for t in prompt],
+            "generated": [int(t) for t in generated],
+            "position": position, "remaining": remaining,
+            "max_new_tokens": int(req.max_new_tokens),
+            "temperature": float(temp), "top_p": float(top_p),
+            "top_k": int(top_k),
+            "spec_ban": int(self._spec_ban[slot]),
+            "blocks_dev": blocks_dev, "logits_dev": logits_dev,
+        }
+
+    def _mig_import(self, snap: dict, req: Optional[Request],
+                    out: dict) -> None:
+        bs = int(snap["block_size"])
+        if bs != self.block_size:
+            raise ValueError(
+                f"block_size mismatch: snapshot {bs} vs pool "
+                f"{self.block_size}")
+        phase = snap.get("phase", "decode")
+        position = int(snap["position"])
+        remaining = int(snap["remaining"])
+        prompt = [int(t) for t in snap["prompt"]]
+        generated = [int(t) for t in snap.get("generated", ())]
+        blocks = snap.get("blocks", [])
+        if phase == "prefill":
+            total = len(prompt) + int(snap["max_new_tokens"])
+        else:
+            total = position + remaining
+        nb_total = max(-(-total // bs), len(blocks), 1)
+        if nb_total > self._alloc.num_blocks:
+            raise RuntimeError(
+                f"sequence needs {nb_total} KV blocks but the pool has "
+                f"{self._alloc.num_blocks}")
+        free = [i for i, r in enumerate(self._slots) if r is None]
+        if not free:
+            raise RuntimeError("no free slot on the destination pool")
+        table = self._alloc.alloc(nb_total)
+        if table is None:
+            raise RuntimeError(
+                f"destination pool exhausted: {self._alloc.free_blocks} "
+                f"free blocks < {nb_total} needed")
+        slot = free[0]
+        try:
+            nbytes = 0
+            G = KV_MIGRATE_GROUP
+            for i in range(0, len(blocks), G):
+                grp = blocks[i:i + G]
+                bt = np.full((G, 1), self._alloc.num_blocks, np.int32)
+                bt[:len(grp), 0] = [int(table[i + j])
+                                    for j in range(len(grp))]
+                leaves = []
+                for li in range(len(grp[0])):
+                    # analysis: ok host-sync-in-dispatch — wire bytes are host numpy
+                    parts = [np.asarray(b[li]) for b in grp]
+                    nbytes += sum(x.nbytes for x in parts)
+                    stack = np.concatenate(parts, axis=0)
+                    if len(grp) < G:
+                        stack = np.concatenate(
+                            [stack, np.zeros(
+                                (G - len(grp),) + stack.shape[1:],
+                                stack.dtype)], axis=0)
+                    leaves.append(stack)
+                self._pool_cache = self._kv_import(
+                    self._pool_cache, bt, tuple(leaves))
+            if req is None:
+                req = Request(
+                    prompt=prompt,
+                    max_new_tokens=int(snap["max_new_tokens"]),
+                    temperature=snap.get("temperature"),
+                    top_p=snap.get("top_p"), top_k=snap.get("top_k"))
+                req.tokens = list(generated)
+            self._slots[slot] = req
+            self._slot_blocks[slot] = [int(b) for b in table]
+            req.slot = slot
+            req.admitted_step = self.step_counter
+            if phase == "prefill":
+                self._slot_content[slot] = prompt[:position]
+                self._slot_owner[slot] = None
+                self._active[slot] = False
+                self._prefilling.append([req, slot, prompt, position])
+                self._prefill_tokens_inflight += len(prompt) - position
+            else:
+                # analysis: ok host-sync-in-dispatch — wire bytes are host numpy
+                row = np.asarray(snap["logits"])
+                nbytes += row.nbytes
+                self._pool_logits = self._logits_set(
+                    self._pool_logits, row, np.int32(slot))
+                self._slot_content[slot] = prompt + generated
+                self._slot_owner[slot] = req
+                self._positions[slot] = position
+                self._remaining[slot] = remaining
+                self._temps[slot] = float(snap.get("temperature") or 0.0)
+                self._top_ps[slot] = float(snap.get("top_p") or 1.0)
+                self._top_ks[slot] = int(snap.get("top_k") or 0)
+                self._spec_ban[slot] = int(snap.get("spec_ban", -1))
+                self._spec_backoff[slot] = 0
+                self._spec_cool[slot] = 0
+                self._active[slot] = not req.done.is_set()
+            self.kv_migrations_total += 1
+            self.kv_migrate_bytes_total += nbytes
+            out["req"] = req
+        except Exception:
+            # failed mid-install: unwind fully — no leaked blocks, no
+            # half-occupied slot (the source still owns the sequence)
+            self._slots[slot] = None
+            self._slot_blocks[slot] = []
+            self._slot_content[slot] = []
+            self._active[slot] = False
+            self._alloc.release(table)
+            raise
+
+    def _mig_resume(self, req: Request) -> None:
+        slot = self._find_req_slot(req)
+        if slot is None:
+            return  # finished and swept while the transfer ran
+        rec = self._migrating.pop(slot, None)
+        if rec is None:
+            # never frozen (e.g. the export op was ABANDONED on
+            # timeout, or resume raced a completed cutover): there is
+            # nothing to undo.  Activating blind here would corrupt a
+            # mid-prefill slot — remaining is 0 until _occupy runs, so
+            # the next schedule advance would retire it and release
+            # blocks its _prefilling entry still references.
+            return
+        if req.done.is_set():
+            return  # the sweep retires it next iteration
+        if rec.get("entry") is not None:
+            e = rec["entry"]
+            # resume at the HEAD: this sequence was mid-admission
+            self._prefilling.appendleft(e)
+            self._prefill_tokens_inflight += len(e[2]) - e[3]
+        else:
+            self._active[slot] = True
+
+    def _mig_release(self, req: Request) -> None:
+        slot = self._find_req_slot(req)
+        if slot is None:
+            return
+        # cutover commit: the destination owns the sequence now.  The
+        # request object itself is NOT resolved — it keeps accruing
+        # tokens from the destination engine (the re-targeted handle).
+        # kv_migrations_total counts on the IMPORTING side only (one
+        # increment per migration — a pool summing both tiers must not
+        # double-count); the source's outbound view is the latency
+        # histogram count.
+        self._retire_slot(slot)
 
     def _best_prefix(self, prompt: list[int]) -> tuple[int, int]:
         """(src_slot, lp): the longest usable prefix of ``prompt`` already
@@ -2621,6 +3234,7 @@ class ContinuousEngine:
                     req.error = e
                     req.done.set()
             self._waiting.clear()
+            self._fail_migration_waiters(e)
 
     def _purge_prefilling(self) -> None:
         """Drop chunked-admission entries whose request resolved out of
@@ -2685,11 +3299,27 @@ class ContinuousEngine:
         if final:
             self._prefilling.popleft()
             self._occupy(req, prompt, slot)
+            if self.role == "prefill" and self.on_prefilled is not None:
+                # disaggregation handoff (ISSUE 8): freeze at the chunk
+                # boundary — the final chunk's logits are in the pool
+                # row, so the DESTINATION samples the first token
+                # exactly as this engine would have.  The hook only
+                # enqueues; a raising hook fails open into local decode
+                # (correctness first, disaggregation second).
+                self._active[slot] = False
+                self._migrating[slot] = {"req": req, "entry": None}
+                try:
+                    self.on_prefilled(req)
+                except Exception as e:  # noqa: BLE001 — degrade to mixed
+                    log.debug("on_prefilled hook failed: %s", e)
+                    self._migrating.pop(slot, None)
+                    self._active[slot] = True
 
     def _loop_inner(self) -> None:
         # in-flight chunk dispatches: (device tokens, [(slot, req, take)])
         pending: list[tuple[Any, list[tuple[int, Request, int]]]] = []
         while not self._stop.is_set():
+            self._service_migrations(pending)
             self._admit()
             # free slots whose request resolved OUT of band (cancel()):
             # the normal retirements already cleared theirs, so a done-
@@ -2714,7 +3344,8 @@ class ContinuousEngine:
                 while pending:
                     self._process(*pending.pop(0))
                 if (self._active.any() or self._waiting or self._prefilling
-                        or not self._queue.empty()):
+                        or not self._queue.empty()
+                        or not self._migrate_q.empty()):
                     continue  # _process freed slots or work arrived
                 self._wake.wait(timeout=0.05)
                 self._wake.clear()
@@ -3324,6 +3955,337 @@ class TieredEngine:
         return merged
 
 
+def migrate_live_sequences(src: "ContinuousEngine", dst=None, *,
+                           send=None, on_latency=None) -> tuple[int, int]:
+    """Drain/rebalance: migrate every live conversation off ``src``.
+
+    The drain primitive behind replica retirement (the ISvc controller's
+    scale-down), defrag (moving the last sequences off a fragmented pool
+    IS compaction — the destination packs them into fresh contiguous
+    blocks), and chaos node-drain recovery.  ``dst`` imports in-process;
+    ``send`` (callable(host_snapshot, req) -> bool) streams over the
+    gang channel's kv_migrate framing instead — a wire ``send`` MUST
+    resolve indeterminate outcomes itself before returning (the
+    ``DisaggregatedPool._send_wire`` pattern: a commit-delivered /
+    ack-lost transfer needs the handle registry + destination-ownership
+    check, or resuming here double-decodes the request).
+    Copy-then-cutover per sequence: a failed transfer resumes decoding
+    on ``src`` — a drain can fall short, never lose a conversation.
+    Returns (moved, failed).
+    """
+    if send is None and dst is None:
+        raise ValueError("migrate_live_sequences needs dst or send")
+    moved = failed = 0
+    for req in [r for r in list(src._slots)
+                if r is not None and not r.done.is_set()]:
+        if send is not None:
+            def transfer(snap, _r=req):
+                return send(snap, _r)
+        else:
+            def transfer(snap, _r=req):
+                return dst.import_sequence(snap, req=_r) is not None
+        outcome = _migrate_one(src, req, transfer, on_latency)
+        if outcome is True:
+            moved += 1
+        elif outcome is False:
+            failed += 1
+    return moved, failed
+
+
+def _migrate_one(src: "ContinuousEngine", req: Request, transfer,
+                 on_latency=None) -> Optional[bool]:
+    """ONE copy-then-cutover attempt — the shared per-sequence
+    orchestration under both migrate_live_sequences and the
+    DisaggregatedPool handoff worker (export -> transfer -> release on
+    success / resume on failure, with the failure bookkeeping in
+    exactly one place).  ``transfer(host_snapshot)`` returns True
+    (installed), False (definitively not installed) or None
+    (indeterminate — a tri-state wire send that did NOT resolve the
+    two-generals tail itself, contract violation): None is treated as
+    failed-and-resume with a loud warning, which is only safe because
+    an unresolved transfer can at worst orphan a FRESH destination
+    request (no shared handle -> no double-decode); handle-sharing
+    senders must resolve before returning (_send_wire does).
+    Returns True = moved, False = failed, None = nothing to do."""
+    t0 = time.perf_counter()
+    try:
+        snap = src.export_sequence(req)
+    except (RuntimeError, TimeoutError) as e:
+        log.debug("migration export failed: %s", e)
+        src.kv_migrate_failures_total += 1
+        # a timed-out export was ABANDONED (never freezes), but a
+        # failed one may have frozen the slot first: unfreezing a
+        # never-frozen sequence is a no-op, so always try
+        try:
+            src.resume_sequence(req)
+        except (RuntimeError, TimeoutError):
+            pass
+        return False
+    if snap is None:
+        return None  # finished before the transfer could start
+    try:
+        ok = transfer(snap)
+    except Exception as e:  # noqa: BLE001 — rejection/socket death is
+        # a per-sequence failure, not a drain abort: resume in place
+        log.debug("migration transfer failed: %s", e)
+        ok = False
+    if ok is None:
+        log.warning(
+            "kv_migrate transfer returned indeterminate (commit sent, "
+            "ack lost) without resolving it; treating as failed — the "
+            "destination may hold an orphaned copy")
+        ok = False
+    try:
+        if ok:
+            src.release_sequence(req)
+            ms = (time.perf_counter() - t0) * 1e3
+            src.observe_migration_ms(ms)
+            if on_latency is not None:
+                on_latency(ms)
+            return True
+        src.kv_migrate_failures_total += 1
+        src.resume_sequence(req)
+    except (RuntimeError, TimeoutError) as e:
+        log.debug("migration cutover failed: %s", e)
+    return False
+
+
+class DisaggregatedPool:
+    """Prefill/decode disaggregation over live paged-KV migration.
+
+    Chunked prefill (PR 2) bounds the admission stall but prefill still
+    competes with decode for the same chips; this pool splits them: N
+    ``role="prefill"`` engines admit and chunk-prefill only, and every
+    finished sequence is handed — KV blocks, logits row, scheduler
+    state — to the ``role="decode"`` engine with the most free blocks
+    (the load signal the block economy gives for free).  Decode ITL on
+    the decode tier never pays prefill compute again; the handoff is a
+    copy-then-cutover migration, so a failed transfer just decodes on
+    the prefill engine (degraded, never wrong), and the REQUEST HANDLE
+    is re-targeted in place — SSE streams survive the hop without a
+    client reconnect.
+
+    ``wire=True`` routes every handoff through the authenticated,
+    length-framed ``kv_migrate`` stream (serving/gang.py) over
+    loopback TCP — the same bytes a cross-host deployment ships — with
+    the destination resolving the request handle from the migration-id
+    registry; ``wire=False`` imports in-process.  Engine-shaped:
+    runtimes (text.py), the model server's /metrics export and the
+    benches front it exactly like ContinuousEngine.
+    """
+
+    def __init__(self, cfg, params, *, prefill_replicas: int = 1,
+                 decode_replicas: int = 1, wire: bool = False,
+                 migrate_token: str = "", sock_wrap=None,
+                 seq_buckets=None, **kw):
+        if int(kw.get("block_size", 0)) <= 0:
+            raise ValueError(
+                "disaggregation requires the paged pool (block_size > 0)")
+        if prefill_replicas < 1 or decode_replicas < 1:
+            raise ValueError("disaggregation needs >= 1 replica per role")
+        kw.pop("role", None)
+        self.prefill = [
+            ContinuousEngine(cfg, params, role="prefill",
+                             seq_buckets=seq_buckets, **kw)
+            for _ in range(prefill_replicas)]
+        self.decode = [
+            ContinuousEngine(cfg, params, role="decode",
+                             seq_buckets=seq_buckets, **kw)
+            for _ in range(decode_replicas)]
+        self.pools = self.prefill + self.decode
+        self._handoff_q: "queue.Queue" = queue.Queue()
+        self._stopping = threading.Event()
+        from collections import deque
+
+        #: recent handoff latencies for the bench/debugging; the
+        #: unbounded record is the engine-side histogram
+        self.migration_latencies_ms: "deque[float]" = deque(maxlen=4096)
+        self._servers = []
+        if wire and not migrate_token:
+            # the pool's tiers share a process: mint a per-pool secret
+            # instead of running the loopback listener open (the
+            # gang-token rule — an empty token silently opens the
+            # channel); cross-process deployments pass their own
+            import secrets
+
+            migrate_token = secrets.token_hex(16)
+        self._wire_token = migrate_token
+        self._sock_wrap = sock_wrap
+        if wire:
+            # lazy import: gang.py imports this module
+            from .gang import KvMigrationServer
+
+            for eng in self.decode:
+                self._servers.append(KvMigrationServer(
+                    eng, token=migrate_token, sock_wrap=sock_wrap))
+        for eng in self.prefill:
+            eng.on_prefilled = (
+                lambda req, _e=eng: self._handoff_q.put((_e, req)))
+        self._worker = threading.Thread(
+            target=self._pump, name="kv-migrate", daemon=True)
+        self._worker.start()
+
+    def _pump(self) -> None:
+        """Handoff worker: the blocking half of every migration (device
+        fetch, socket streaming, cutover waits) lives HERE, never on an
+        engine scheduler thread (the analyzer's blocking-socket rule
+        pins exactly that)."""
+        while not self._stopping.is_set():
+            try:
+                src, req = self._handoff_q.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            # destination = most free blocks (rebalancing for free)
+            di = max(range(len(self.decode)),
+                     key=lambda i: self.decode[i]._alloc.free_blocks)
+            if self._servers:
+                def transfer(snap, _r=req, _d=di):
+                    return self._send_wire(snap, _r, _d)
+            else:
+                def transfer(snap, _r=req, _d=di):
+                    return self.decode[_d].import_sequence(
+                        snap, req=_r) is not None
+            # any transfer failure degrades to local decode on the
+            # prefill engine (_migrate_one resumes it there)
+            _migrate_one(src, req, transfer,
+                         self.migration_latencies_ms.append)
+
+    def _send_wire(self, snap: dict, req: Request, di: int) -> bool:
+        """One wire handoff with the commit-ack two-generals tail
+        handled: a DEFINITIVE outcome (ack, explicit rejection, or a
+        death before kv_commit went out — confirmed by the handle
+        still being registered) resolves immediately; an INDETERMINATE
+        one (commit delivered, ack lost) must NOT resume blind — the
+        destination's server thread is installing the same request
+        handle, and double-decoding it would duplicate client tokens.
+        There we poll destination ownership for the import's bounded
+        service time: installed -> late cutover (success), rejected ->
+        ownership never appears -> resume after the grace."""
+        from .gang import (
+            migrate_sequence,
+            register_migration_handle,
+            unregister_migration_handle,
+        )
+
+        srv = self._servers[di]
+        mid = register_migration_handle(req)
+        st = migrate_sequence(snap, "127.0.0.1", srv.port,
+                              token=self._wire_token, mid=mid,
+                              sock_wrap=self._sock_wrap)
+        if st is True:
+            return True
+        if st is False:
+            # definitive: withdraw the handle if the server never took
+            # it (pre-commit death); an explicit rejection consumed it
+            unregister_migration_handle(mid)
+            return False
+        if unregister_migration_handle(mid):
+            return False  # commit never arrived: source may resume
+        # commit consumed, ack lost: the import is in flight on the
+        # destination — wait out its bounded service time (mailbox +
+        # grouped scatters; 60s mirrors import_sequence's own timeout)
+        deadline = time.perf_counter() + 60.0
+        while time.perf_counter() < deadline:
+            if (self.decode[di]._find_req_slot(req) is not None
+                    or req.done.is_set()):
+                return True
+            time.sleep(0.01)
+        log.warning(
+            "kv_migrate cutover unresolved after 60s (commit delivered, "
+            "no ack, destination never installed): resuming the source")
+        return False
+
+    # -- engine-shaped surface --------------------------------------------
+
+    def submit(self, prompt, max_new_tokens=None,
+               temperature=None, top_p=None, top_k=None) -> Request:
+        # admissions are role-gated: ONLY prefill engines take traffic
+        # (least-loaded by queued + live), decode engines only import
+        eng = min(self.prefill,
+                  key=lambda e: e._queue.qsize() + len(e._prefilling)
+                  + int(e._active.sum()))
+        return eng.submit(prompt, max_new_tokens, temperature,
+                          top_p=top_p, top_k=top_k)
+
+    def generate(self, prompt, max_new_tokens=None, timeout: float = 120.0,
+                 temperature=None, top_p=None, top_k=None) -> list[int]:
+        return self.submit(prompt, max_new_tokens, temperature,
+                           top_p=top_p, top_k=top_k).wait(timeout)
+
+    def warmup(self, groups=None) -> None:
+        for eng in self.pools:
+            eng.warmup(groups)
+
+    def stop(self) -> None:
+        self._stopping.set()
+        self._worker.join(timeout=10)
+        for srv in self._servers:
+            srv.close()
+        for eng in self.pools:
+            eng.stop()
+
+    @property
+    def eos_id(self):
+        return self.prefill[0].eos_id
+
+    @eos_id.setter
+    def eos_id(self, value) -> None:
+        for eng in self.pools:
+            eng.eos_id = value
+
+    @property
+    def default_max_new_tokens(self) -> int:
+        return self.prefill[0].default_max_new_tokens
+
+    @property
+    def cfg(self):
+        return self.prefill[0].cfg
+
+    @property
+    def tokens_emitted(self) -> int:
+        return sum(e.tokens_emitted for e in self.pools)
+
+    @property
+    def prefix_hits(self) -> int:
+        return sum(e.prefix_hits for e in self.pools)
+
+    @property
+    def prefix_tokens_saved(self) -> int:
+        return sum(e.prefix_tokens_saved for e in self.pools)
+
+    def stats(self) -> dict:
+        """Numeric stats summed across the tiers (counters add; the
+        capacity-style gauges add too — the pool's capacity IS the sum
+        of its tiers'), plus the tier split.  RATIO gauges must not
+        add: they are recomputed from the summed counters (acceptance)
+        or allocation-weighted (fragmentation)."""
+        merged: dict = {}
+        per: list[dict] = []
+        config_keys = {"kv_block_size", "prefill_budget"}
+        for eng in self.pools:
+            st = eng.stats()
+            per.append(st)
+            for k, v in st.items():
+                if isinstance(v, bool) or not isinstance(v, (int, float)):
+                    continue
+                if k in config_keys:
+                    merged.setdefault(k, v)
+                else:
+                    merged[k] = merged.get(k, 0) + v
+        merged["spec_acceptance_rate"] = round(
+            merged.get("spec_tokens_accepted_total", 0)
+            / max(merged.get("spec_tokens_proposed_total", 0), 1), 4)
+        allocated = (merged.get("kv_blocks_total", 0)
+                     - merged.get("kv_blocks_free", 0))
+        merged["kv_fragmentation_ratio"] = round(
+            sum((st["kv_blocks_total"] - st["kv_blocks_free"])
+                * st["kv_fragmentation_ratio"] for st in per)
+            / allocated, 4) if allocated > 0 else 0.0
+        merged["disagg_prefill_replicas"] = len(self.prefill)
+        merged["disagg_decode_replicas"] = len(self.decode)
+        return merged
+
+
 def engine_kwargs(config: dict, *, default_eos=None,
                   default_max_new_tokens: int = 16) -> dict:
     """ContinuousEngine kwargs from a serving-config dict — shared by
@@ -3345,6 +4307,7 @@ def engine_kwargs(config: dict, *, default_eos=None,
         spec_ngram=int(config.get("spec_ngram", 3)),
         block_size=int(config.get("block_size", 0)),
         num_blocks=int(config.get("num_blocks", 0)),
+        role=str(config.get("role", "mixed")),
         default_max_new_tokens=int(
             config.get("max_new_tokens", default_max_new_tokens)),
     )
@@ -3409,7 +4372,31 @@ def build_engine(cfg, params, config: dict, *, default_eos=None,
     cfg, params = apply_serving_quant(cfg, params, config)
     short_len = config.get("short_pool_len")
     tier_lens = config.get("tier_lens")
-    if tier_lens:
+    disagg = config.get("disaggregation")
+    if disagg:
+        # prefill/decode disaggregation (ISSUE 8): {"prefill": n,
+        # "decode": m, "wire": bool} — n prefill-role engines hand
+        # finished sequences to m decode-role engines by live paged-KV
+        # migration, picked by free-block count
+        if tier_lens or short_len:
+            raise ValueError(
+                "disaggregation does not compose with the tier ladder: "
+                "route tiers to separate ISvcs instead")
+        # token side channel first (the gang_token_file rule: configs
+        # are cluster-readable); inline token for hand-rolled/test
+        # configs; empty + wire => the pool mints a per-pool secret
+        tok = str(disagg.get("token", ""))
+        if disagg.get("token_file"):
+            with open(disagg["token_file"]) as f:
+                tok = f.read().strip()
+        engine = DisaggregatedPool(
+            cfg, params,
+            prefill_replicas=int(disagg.get("prefill", 1)),
+            decode_replicas=int(disagg.get("decode", 1)),
+            wire=bool(disagg.get("wire", False)),
+            migrate_token=tok,
+            seq_buckets=config.get("seq_buckets"), **kw)
+    elif tier_lens:
         engine = TieredEngine(
             cfg, params, tier_lens=[int(t) for t in tier_lens],
             tier_slots=config.get("tier_slots"),

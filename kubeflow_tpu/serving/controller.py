@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import importlib
 import json
+import logging
 import threading
 import time
 import urllib.error
@@ -45,6 +46,8 @@ from .storage import download
 
 SCALE_IDLE_SECONDS = 2.0  # idle window before scale-down (KPA-ish)
 ACTIVATION_TIMEOUT = 15.0
+
+log = logging.getLogger("kubeflow_tpu.serving")
 
 
 class _GangMetrics:
@@ -430,7 +433,8 @@ class InferenceServiceController(Controller):
     def _new_revision(self, isvc, dep: _Deployment, fingerprint: str) -> _Revision:
         runtime_cls, cfg = self._resolve(isvc)
         if (isvc.spec.predictor.gang is not None
-                or any(k in cfg for k in self._ENGINE_KNOBS)):
+                or any(k in cfg for k in self._ENGINE_KNOBS)
+                or "role" in cfg or "disaggregation" in cfg):
             # validate the engine knobs HERE, inside the reconcile's
             # Failed-phase guard, where the revision config freezes: a
             # bad value (prefill_budget: -1, spec_k: -2, ...) otherwise
@@ -446,6 +450,33 @@ class InferenceServiceController(Controller):
                    and v < (0 if k in zero_ok else 1)}
             if bad:
                 raise ValueError(f"invalid engine knobs: {bad}")
+            # migration/disaggregation knobs (ISSUE 8) freeze here too:
+            # a bad role would otherwise be one ValueError per replica
+            # process — crash-looping pods instead of ONE Failed status
+            role = str(cfg.get("role", "mixed"))
+            if role not in ("mixed", "prefill", "decode"):
+                raise ValueError(
+                    f"invalid engine knobs: role {role!r} "
+                    "(mixed|prefill|decode)")
+            if role != "mixed" and int(cfg.get("block_size", 0) or 0) <= 0:
+                raise ValueError(
+                    f"invalid engine knobs: role={role} requires the "
+                    "paged pool (block_size > 0)")
+            disagg = cfg.get("disaggregation")
+            if disagg is not None:
+                if not isinstance(disagg, dict):
+                    raise ValueError(
+                        "invalid engine knobs: disaggregation must be "
+                        '{"prefill": n, "decode": m[, "wire": bool]}')
+                if (int(disagg.get("prefill", 1)) < 1
+                        or int(disagg.get("decode", 1)) < 1):
+                    raise ValueError(
+                        "invalid engine knobs: disaggregation needs "
+                        ">= 1 replica per role")
+                if int(cfg.get("block_size", 0) or 0) <= 0:
+                    raise ValueError(
+                        "invalid engine knobs: disaggregation requires "
+                        "the paged pool (block_size > 0)")
         dep.rev_counter += 1
         return _Revision(
             dep.rev_counter, fingerprint, isvc.spec.model_copy(deep=True),
@@ -644,11 +675,56 @@ class InferenceServiceController(Controller):
         while len(rev.predictors) > desired:
             server = rev.predictors.pop()
             self._wire(isvc, dep)  # drop from router before stopping
-            self._drain_stop_server(isvc, server)
+            # migrate-then-retire (ISSUE 8): live paged conversations
+            # move to a surviving replica of the SAME revision instead
+            # of racing the 5s drain deadline — a scale-down (or a node
+            # making its replica unhealthy) stops costing long
+            # conversations their KV.  Runs INSIDE the async drain
+            # thread: per-sequence migration ops carry 60s timeouts,
+            # and a wedged replica must not stall the shared reconcile
+            # worker (the same invariant the bounded drain holds).
+            self._drain_stop_server(isvc, server, migrate_rev=rev)
             changed = True
         return changed
 
-    def _drain_stop_server(self, isvc, server: ModelServer) -> None:
+    def _migrate_replica_conversations(self, isvc, rev: _Revision,
+                                       server) -> int:
+        """Drain a retiring in-process replica's live conversations onto
+        a ready peer replica via live paged-KV migration.  The request
+        handles are shared in-process, so streams in flight keep reading
+        the same objects — the front server re-targets, clients never
+        reconnect.  Best-effort: with no paged peer the replica falls
+        back to the classic bounded drain (conversations finish or are
+        cut at the deadline)."""
+        engines = getattr(server, "engines", None)
+        if engines is None:
+            return 0  # gang handles drain via JaxJob semantics
+        peers = [s for s in rev.predictors
+                 if s is not server and getattr(s, "ready", True)
+                 and getattr(s, "engines", None) is not None]
+        moved_total = 0
+        for name, eng in engines().items():
+            if not getattr(eng, "paged", False):
+                continue
+            for peer in peers:
+                dst = peer.engines().get(name)
+                if dst is None or not getattr(dst, "paged", False):
+                    continue
+                from .continuous import migrate_live_sequences
+
+                moved, failed = migrate_live_sequences(eng, dst)
+                moved_total += moved
+                if failed == 0:
+                    break
+        if moved_total:
+            self.emit_event(
+                isvc, "ConversationsMigrated",
+                f"{moved_total} live conversations migrated off a "
+                "retiring replica")
+        return moved_total
+
+    def _drain_stop_server(self, isvc, server: ModelServer,
+                           migrate_rev: Optional[_Revision] = None) -> None:
         """Stop a replica after its in-flight requests finish.
 
         Drain runs asynchronously: requests already dispatched to this
@@ -656,9 +732,18 @@ class InferenceServiceController(Controller):
         surfacing as 5xx, and the reconcile worker is not blocked for the
         (bounded) drain period.  The initial settle sleep covers requests
         the router already picked this backend for but whose handler has
-        not yet reached _dispatch's inflight increment."""
-        def _drain_stop(srv=server, svc=isvc):
+        not yet reached _dispatch's inflight increment.  With
+        ``migrate_rev`` set, live paged conversations first migrate to a
+        ready peer of that revision (ISSUE 8) — on this thread, for the
+        same reason the drain itself is here."""
+        def _drain_stop(srv=server, svc=isvc, rev=migrate_rev):
             time.sleep(0.1)
+            if rev is not None:
+                try:
+                    self._migrate_replica_conversations(svc, rev, srv)
+                except Exception as e:  # noqa: BLE001 — migration is
+                    # best-effort; the bounded drain below still runs
+                    log.debug("drain migration failed: %s", e)
             deadline = time.monotonic() + 5.0
             while srv.metrics.inflight > 0 and time.monotonic() < deadline:
                 time.sleep(0.02)

@@ -61,6 +61,7 @@ so drops/delays can be injected at exact protocol points.
 from __future__ import annotations
 
 import json
+import logging
 import os
 import pickle
 import signal
@@ -74,6 +75,8 @@ import numpy as np
 
 from . import continuous as contlib
 from ..runtime import bootstrap
+
+log = logging.getLogger("kubeflow_tpu.serving")
 
 #: pod-env key holding the JSON serving config (engine knobs +
 #: storage_path + serve_port + gang_port) the ISvc controller freezes at
@@ -520,6 +523,325 @@ class GangChannel:
                 pass
 
 
+# ---------------------------------------------------------------------------
+# kv_migrate: live paged-KV migration between engines (ISSUE 8)
+# ---------------------------------------------------------------------------
+#
+# The transfer stream reuses GangChannel's trust shape — a per-deployment
+# shared token, a length-capped JSON handshake (never pre-auth pickle) —
+# but NOT its pickle body: every kv_migrate frame is a length-framed
+# JSON header plus RAW numpy bytes, so the analyzer's unsafe-pickle
+# allowlist stays exactly one entry.  Protocol (client = the SOURCE
+# engine's migration worker, server = the DESTINATION):
+#
+#   client -> kv_hello {token, mid}          server -> kv_ready
+#   client -> kv_begin {meta, leaf specs}    (no allocation yet)
+#   client -> kv_block {i} + leaf bytes      (buffered host-side)
+#   client -> kv_logits + row bytes
+#   client -> kv_commit                      server imports, -> kv_ack
+#
+# The destination allocates blocks ONLY at kv_commit (inside
+# import_sequence), so a socket death mid-stream leaks nothing on either
+# side — the source still holds the sequence (copy-then-cutover) and the
+# buffered frames are garbage-collected host memory.
+
+#: per-frame hard caps: a kv_migrate peer is authenticated, but a
+#: corrupted length prefix must cost a closed connection, not an OOM
+KV_HELLO_MAX = 4096
+KV_HEADER_MAX = 1 << 20
+KV_FRAME_MAX = 1 << 30
+
+_HDR = struct.Struct("!I")
+
+#: migration-id registry: the front server keeps the REQUEST HANDLE when
+#: a sequence moves between co-hosted replicas — the source registers
+#: the handle under a fresh mid, the destination's KvMigrationServer
+#: resolves it, and the SSE stream keeps reading the same object (slot
+#: re-targeting, no client reconnect).  Cross-process imports simply
+#: never resolve and build a fresh Request from the snapshot.
+_MIGRATION_HANDLES: dict[str, Any] = {}
+_MIGRATION_LOCK = threading.Lock()
+
+
+def register_migration_handle(req) -> str:
+    import uuid
+
+    mid = uuid.uuid4().hex
+    with _MIGRATION_LOCK:
+        _MIGRATION_HANDLES[mid] = req
+    return mid
+
+
+def resolve_migration_handle(mid: str):
+    with _MIGRATION_LOCK:
+        return _MIGRATION_HANDLES.pop(mid, None)
+
+
+def unregister_migration_handle(mid: str) -> bool:
+    """Withdraw a handle after a failed transfer.  True = the handle was
+    still pending, so the destination never reached kv_commit and the
+    source may resume immediately.  False = the destination consumed it
+    (commit arrived; only the ACK was lost) — the classic two-generals
+    tail of copy-then-cutover.  The orchestrator then polls destination
+    ownership instead of resuming blind: resuming while the destination
+    installs the same request handle would DOUBLE-decode it (duplicate
+    client tokens), the one corruption the cutover discipline exists to
+    prevent."""
+    with _MIGRATION_LOCK:
+        return _MIGRATION_HANDLES.pop(mid, None) is not None
+
+
+def _np_dtype(name: str):
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes  # bf16/f8 names register through ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def _kv_send(c, header: dict, payload: bytes = b"") -> None:
+    hb = json.dumps(header).encode()
+    c.sendall(_LEN.pack(_HDR.size + len(hb) + len(payload))
+              + _HDR.pack(len(hb)) + hb + payload)
+
+
+def _kv_recv(c, max_len: int = KV_FRAME_MAX) -> tuple[dict, bytes]:
+    (n,) = _LEN.unpack(GangChannel._read_exact(c, _LEN.size))
+    if n < _HDR.size or n > max_len:
+        raise ChannelClosed(f"kv_migrate frame length {n} out of range")
+    (hn,) = _HDR.unpack(GangChannel._read_exact(c, _HDR.size))
+    if hn > min(n - _HDR.size, KV_HEADER_MAX):
+        raise ChannelClosed(f"kv_migrate header length {hn} out of range")
+    header = json.loads(GangChannel._read_exact(c, hn).decode())
+    payload = GangChannel._read_exact(c, n - _HDR.size - hn)
+    if not isinstance(header, dict):
+        raise ChannelClosed("kv_migrate header is not an object")
+    return header, payload
+
+
+def _leaf_specs(snapshot: dict) -> list[dict]:
+    if not snapshot.get("blocks"):
+        return []
+    return [{"dtype": str(np.asarray(x).dtype),
+             "shape": list(np.shape(x))}
+            for x in snapshot["blocks"][0]]
+
+
+def _pack_leaves(leaves) -> bytes:
+    return b"".join(np.ascontiguousarray(np.asarray(x)).tobytes()
+                    for x in leaves)
+
+
+def _unpack_leaves(payload: bytes, specs: list[dict]) -> list[np.ndarray]:
+    out, off = [], 0
+    for s in specs:
+        dt = _np_dtype(s["dtype"])
+        n = int(np.prod(s["shape"], dtype=np.int64)) * dt.itemsize
+        out.append(np.frombuffer(
+            payload[off:off + n], dtype=dt).reshape(s["shape"]).copy())
+        off += n
+    if off != len(payload):
+        raise ChannelClosed(
+            f"kv_block payload {len(payload)}B != leaf specs {off}B")
+    return out
+
+
+def migrate_sequence(snapshot: dict, host: str, port: int, *,
+                     token: str = "", mid: Optional[str] = None,
+                     timeout: float = 30.0,
+                     sock_wrap=None) -> Optional[bool]:
+    """Source side of a kv_migrate transfer: stream one exported
+    snapshot (``ContinuousEngine.export_sequence``) to a destination
+    :class:`KvMigrationServer`.  Tri-state result, because the
+    cutover decision needs to distinguish how a transfer ended:
+
+    - ``True``  — the destination acked the commit: CUTOVER (release).
+    - ``False`` — DEFINITIVELY not installed: the failure happened
+      before ``kv_commit`` went out, or the destination answered an
+      explicit rejection ack — the source may resume immediately.
+    - ``None``  — INDETERMINATE: the socket died after ``kv_commit``
+      was sent (the two-generals tail) — the destination may or may
+      not install; the orchestrator must consult the migration-handle
+      registry / destination ownership before resuming, or it risks
+      double-decoding the request.
+
+    Runs on a migration worker thread, never an engine scheduler (the
+    analyzer's blocking-socket rule)."""
+    meta = {k: v for k, v in snapshot.items()
+            if k not in ("blocks", "logits", "blocks_dev", "logits_dev")}
+    blocks = snapshot.get("blocks", [])
+    logits = snapshot.get("logits")
+    try:
+        raw = socket.create_connection((host, port), timeout=timeout)
+    except OSError:
+        return False
+    c = (sock_wrap or (lambda s: s))(raw)
+    committed = False
+    try:
+        try:
+            c.settimeout(timeout)
+        except OSError:
+            pass
+        _kv_send(c, {"t": "kv_hello", "token": token, "mid": mid})
+        ready, _ = _kv_recv(c, KV_HELLO_MAX)
+        if ready.get("t") != "kv_ready":
+            return False
+        _kv_send(c, {"t": "kv_begin", "meta": meta,
+                     "nblocks": len(blocks),
+                     "leaves": _leaf_specs(snapshot),
+                     "logits": (None if logits is None else
+                                {"dtype": str(logits.dtype),
+                                 "shape": list(logits.shape)})})
+        for i, blk in enumerate(blocks):
+            _kv_send(c, {"t": "kv_block", "i": i}, _pack_leaves(blk))
+        if logits is not None:
+            _kv_send(c, {"t": "kv_logits"}, _pack_leaves([logits]))
+        _kv_send(c, {"t": "kv_commit"})
+        committed = True
+        ack, _ = _kv_recv(c, KV_HELLO_MAX)
+        if ack.get("t") == "kv_ack":
+            return bool(ack.get("ok"))  # explicit reject = definitive
+        return None
+    except (OSError, ChannelClosed, ValueError, struct.error):
+        return None if committed else False
+    finally:
+        try:
+            c.close()
+        except OSError:
+            pass
+
+
+class KvMigrationServer:
+    """Destination side of the kv_migrate message family: authenticated
+    acceptor that assembles streamed snapshots and installs them through
+    ``engine.import_sequence`` at commit time.
+
+    One thread per transfer connection; the engine's scheduler is only
+    touched through its migration mailbox (import runs between decode
+    dispatches).  ``resolve_request`` maps a migration id to a live
+    Request handle (co-hosted replica handoff — the front server keeps
+    streaming the same object); default = the module registry."""
+
+    def __init__(self, engine, port: Optional[int] = None,
+                 token: str = "", sock_wrap=None, resolve_request=None,
+                 host: str = "127.0.0.1"):
+        from ..utils.net import allocate_port
+
+        self.engine = engine
+        self.port = port or allocate_port()
+        self._token = token
+        self._sock_wrap = sock_wrap or (lambda s: s)
+        self._resolve = resolve_request or resolve_migration_handle
+        self._closing = threading.Event()
+        self.imports_total = 0
+        self.rejects_total = 0
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        # loopback by DEFAULT: a cross-host deployment opts into
+        # host="0.0.0.0" explicitly AND must set a non-empty token —
+        # an empty-token listener on all interfaces would let any
+        # network peer allocate real KV blocks (the gang-token rule,
+        # ADVICE r5)
+        if host != "127.0.0.1" and not token:
+            raise ValueError(
+                "a non-loopback KvMigrationServer requires a token")
+        srv.bind((host, self.port))
+        srv.listen(8)
+        srv.settimeout(0.2)
+        self._srv = srv
+        threading.Thread(target=self._accept_loop, name="kv-migrate-srv",
+                         daemon=True).start()
+
+    def _accept_loop(self) -> None:
+        while not self._closing.is_set():
+            srv = self._srv
+            if srv is None:
+                return
+            try:
+                raw, _addr = srv.accept()
+            except (socket.timeout, TimeoutError):
+                continue
+            except OSError:
+                return
+            threading.Thread(
+                target=self._serve_one, args=(self._sock_wrap(raw),),
+                name="kv-migrate-conn", daemon=True).start()
+
+    def _serve_one(self, c) -> None:
+        import hmac
+
+        try:
+            c.settimeout(30.0)
+            hello, _ = _kv_recv(c, KV_HELLO_MAX)
+            if hello.get("t") != "kv_hello" or not hmac.compare_digest(
+                    str(hello.get("token", "")), self._token):
+                raise ChannelClosed("bad kv_migrate handshake")
+            mid = hello.get("mid")
+            _kv_send(c, {"t": "kv_ready"})
+            meta: Optional[dict] = None
+            specs: list[dict] = []
+            nblocks = 0
+            logits_spec = None
+            blocks: list[list[np.ndarray]] = []
+            logits = None
+            while True:
+                header, payload = _kv_recv(c)
+                t = header.get("t")
+                if t == "kv_begin":
+                    meta = dict(header.get("meta") or {})
+                    specs = list(header.get("leaves") or [])
+                    nblocks = int(header.get("nblocks", 0))
+                    logits_spec = header.get("logits")
+                elif t == "kv_block":
+                    if meta is None or len(blocks) >= nblocks:
+                        raise ChannelClosed("kv_block outside transfer")
+                    blocks.append(_unpack_leaves(payload, specs))
+                elif t == "kv_logits":
+                    if meta is None or logits_spec is None:
+                        raise ChannelClosed("unexpected kv_logits")
+                    logits = _unpack_leaves(payload, [logits_spec])[0]
+                elif t == "kv_commit":
+                    break
+                else:
+                    raise ChannelClosed(f"unknown kv_migrate frame {t!r}")
+            if meta is None or len(blocks) != nblocks:
+                raise ChannelClosed(
+                    f"kv_commit with {len(blocks)}/{nblocks} blocks")
+            snapshot = dict(meta)
+            snapshot["blocks"] = blocks
+            if logits is not None:
+                snapshot["logits"] = logits
+            req = self._resolve(mid) if mid else None
+            try:
+                self.engine.import_sequence(snapshot, req=req)
+                self.imports_total += 1
+                _kv_send(c, {"t": "kv_ack", "ok": True})
+            except Exception as e:  # noqa: BLE001 — rejection (pool
+                # exhausted, mismatched config) is a protocol answer,
+                # not a server death: the source resumes in place
+                self.rejects_total += 1
+                _kv_send(c, {"t": "kv_ack", "ok": False,
+                             "error": f"{type(e).__name__}: {e}"[:500]})
+        except (OSError, ChannelClosed, ValueError, struct.error,
+                EOFError) as e:
+            log.debug("kv_migrate transfer aborted: %s", e)
+        finally:
+            try:
+                c.close()
+            except OSError:
+                pass
+
+    def close(self) -> None:
+        self._closing.set()
+        srv, self._srv = self._srv, None
+        if srv is not None:
+            try:
+                srv.close()
+            except OSError:
+                pass
+
+
 class GangEngine(contlib.ContinuousEngine):
     """Rank-0 engine: every compiled-program call publishes its host args
     before dispatching, so follower hosts replay the identical SPMD
@@ -829,6 +1151,33 @@ class GangEngine(contlib.ContinuousEngine):
             self._paged_decode_for = paged_decode_for
             self._paged_chunk_for = paged_chunk_for
             self._block_copy = block_copy
+
+            # kv_migrate (ISSUE 8): an IMPORT mutates the replicated
+            # pool (scatter + logits row), so followers must replay it
+            # with the incoming host bytes; the export gather is
+            # read-only and stays leader-local
+            pkvimp_inner = self._kv_import
+            plogset_inner = self._logits_set
+
+            def kv_import(cache, bt_row, leaves):
+                try:
+                    bt_row = np.asarray(bt_row)
+                    leaves = tuple(np.asarray(x) for x in leaves)
+                    ch.publish(("kv_import", bt_row, leaves))
+                    return pkvimp_inner(cache, bt_row, leaves)
+                except Exception as e:  # noqa: BLE001 — see _fatal
+                    raise self._fatal(e)
+
+            def logits_set(logits, row, slot):
+                try:
+                    row = np.asarray(row)
+                    ch.publish(("logits_set", row, int(slot)))
+                    return plogset_inner(logits, row, np.int32(slot))
+                except Exception as e:  # noqa: BLE001
+                    raise self._fatal(e)
+
+            self._kv_import = kv_import
+            self._logits_set = logits_set
 
             if self.prefill_budget > 0:
                 pfused_inner = self._paged_fused_for
@@ -1153,6 +1502,14 @@ def follow(engine: contlib.ContinuousEngine, channel: GangChannel) -> None:
             _, src, dst = msg
             engine._pool_cache = engine._block_copy(
                 engine._pool_cache, np.int32(src), np.int32(dst))
+        elif op == "kv_import":
+            _, bt_row, leaves = msg
+            engine._pool_cache = engine._kv_import(
+                engine._pool_cache, bt_row, tuple(leaves))
+        elif op == "logits_set":
+            _, row, slot = msg
+            engine._pool_logits = engine._logits_set(
+                engine._pool_logits, row, np.int32(slot))
         elif op == "prefix":
             _, total, sb, src, dst, lp, suffix, slen = msg
             engine._pool_cache, engine._pool_logits = (

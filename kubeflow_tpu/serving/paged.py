@@ -101,6 +101,25 @@ def scatter_block_view(pool, view, bt, block_axes, seq_axes):
     return jax.tree.map(leaf, pool, view, block_axes, seq_axes)
 
 
+def write_window_tables(bt, front, block_size: int):
+    """Scatter-side block tables narrowed to the WRITTEN suffix window.
+
+    A dispatch writes row ``r`` only at positions >= ``front[r]`` (decode
+    at the position front, a prefill chunk at its start offset, inactive
+    rows nowhere — their front is the view length).  Blocks that END
+    below the front — every shared prefix block under refcount > 1, and
+    every block of a row this dispatch cannot write — were round-tripped
+    through gather/scatter as an identity write (PERF r10's visible
+    paged-KV tax).  Masking their table entries out of range makes the
+    scatter's ``mode="drop"`` skip them: the gather still uses the full
+    table (reads are the attention math), only the write-back narrows.
+    """
+    nblk = bt.shape[1]
+    first = front.astype(jnp.int32) // jnp.int32(block_size)
+    keep = jnp.arange(nblk, dtype=jnp.int32)[None, :] >= first[:, None]
+    return jnp.where(keep, bt, jnp.int32(np.iinfo(np.int32).max))
+
+
 def lcp(content, prompt_arr: np.ndarray, cap: int) -> int:
     """Longest common prefix of a token sequence and the prompt array,
     capped — vectorized, runs per candidate per admission on the

@@ -341,6 +341,17 @@ class ModelServer:
             })
         return out
 
+    def engines(self) -> dict[str, Any]:
+        """Engine-backed models' engines by model name — the surface
+        replica drain (ISSUE 8) walks to migrate live paged
+        conversations onto a peer replica before this server stops."""
+        out: dict[str, Any] = {}
+        for name, model in list(self._models.items()):
+            engine = getattr(model, "engine", None)
+            if engine is not None:
+                out[name] = engine
+        return out
+
     def models(self) -> dict[str, Model]:
         return dict(self._models)
 

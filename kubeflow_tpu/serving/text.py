@@ -240,6 +240,16 @@ class TextGenerator(Model):
     Instances are prompt STRINGS (or {"prompt": str, "max_tokens": int});
     predictions are continuation strings.  Self-batching: concurrent
     requests coalesce in the engine's slot pool at token boundaries.
+
+    Live KV migration (ISSUE 8) is invisible at this layer BY CONTRACT:
+    every wait/stream path below polls the Request HANDLE (tokens list +
+    done event), never an engine slot — so when the engine (a
+    ``DisaggregatedPool`` handoff, a drain, a rebalance) moves the
+    sequence's KV to another pool mid-stream, the same handle simply
+    keeps accruing tokens from the new owner.  SSE streams survive the
+    hop without a client reconnect, and ``cancel()`` keeps working
+    because whichever engine currently owns the slot observes the shared
+    done event at its next chunk boundary.
     """
 
     self_batching = True

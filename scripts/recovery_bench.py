@@ -31,6 +31,15 @@ then time the restarted plane's
 
 ``cold_restart_recovery_s`` (the sum) is that row's headline.
 
+The third row (ISSUE 8) is **replica drain by live KV migration**: a
+serving replica with N live conversations drains onto a peer via
+``migrate_live_sequences`` (export -> kv import -> cutover per
+sequence), and the row times drain-start -> every conversation decoding
+again on the destination (first post-migration token observed).
+``drain_resume_s`` p50 is the headline — the retire path that used to
+race a 5 s deadline (or cut long conversations) now completes lossless
+in migration time.
+
 Usage: python scripts/recovery_bench.py [trials] [workers] [seed]
 """
 
@@ -277,6 +286,49 @@ def run_restart_trial(i: int, workers: int, seed: int,
         shutil.rmtree(data_dir, ignore_errors=True)
 
 
+def run_drain_trial(i: int, conversations: int = 4) -> dict:
+    """One lossless replica drain: N live conversations mid-decode
+    migrate to a fresh peer; measured = drain start -> every migrated
+    conversation has produced a token ON the destination."""
+    import jax
+    import jax.numpy as jnp
+
+    from kubeflow_tpu.models import llama as llamalib
+    from kubeflow_tpu.serving.continuous import (
+        ContinuousEngine,
+        migrate_live_sequences,
+    )
+
+    cfg = llamalib.tiny()
+    params = llamalib.Llama(cfg).init(
+        jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32))["params"]
+    kw = dict(num_slots=conversations, decode_chunk=2,
+              prefix_cache=False, block_size=16)
+    src = ContinuousEngine(cfg, params, **kw)
+    dst = ContinuousEngine(cfg, params, **kw)
+    try:
+        src.warmup()
+        dst.warmup()
+        reqs = [src.submit([7 + i, 8, 9, j + 1], max_new_tokens=96)
+                for j in range(conversations)]
+        while any(len(r.tokens) < 2 for r in reqs):
+            time.sleep(0.002)
+        counts = [len(r.tokens) for r in reqs]
+        t0 = time.perf_counter()
+        moved, failed = migrate_live_sequences(src, dst)
+        while any(len(r.tokens) <= c for r, c in zip(reqs, counts)
+                  if not r.done.is_set()):
+            time.sleep(0.001)
+        resumed_s = time.perf_counter() - t0
+        for r in reqs:
+            r.cancel()
+        return {"drain_resume_s": resumed_s, "moved": moved,
+                "failed": failed, "conversations": conversations}
+    finally:
+        src.stop()
+        dst.stop()
+
+
 def main() -> None:
     trials = int(sys.argv[1]) if len(sys.argv) > 1 else 12
     workers = int(sys.argv[2]) if len(sys.argv) > 2 else 4
@@ -326,6 +378,26 @@ def main() -> None:
             [r["cold_restart_recovery_s"] for r in restart_rows]),
         "phase_p50": restart_p50,
         "objects_recovered": restart_rows[0]["objects_recovered"],
+    }))
+
+    # replica drain by live KV migration (ISSUE 8): lossless retire
+    drain_trials = max(3, trials // 3)
+    drain_rows = []
+    for i in range(drain_trials):
+        row = run_drain_trial(i)
+        drain_rows.append(row)
+        print("# drain trial", i, json.dumps({
+            k: (round(v, 3) if isinstance(v, float) else v)
+            for k, v in row.items()}), file=sys.stderr)
+    print(json.dumps({
+        "metric": "replica_drain_resume_p50_seconds",
+        "unit": (f"s (drain -> all {drain_rows[0]['conversations']} live "
+                 "conversations decoding on the destination, live "
+                 "paged-KV migration, n="
+                 f"{drain_trials}, tiny model CPU stand-in)"),
+        **_percentiles([r["drain_resume_s"] for r in drain_rows]),
+        "moved_total": sum(r["moved"] for r in drain_rows),
+        "failed_total": sum(r["failed"] for r in drain_rows),
     }))
 
 

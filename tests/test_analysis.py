@@ -134,6 +134,47 @@ class BlockTableHelper:
                             ["host-sync-in-dispatch"],
                             rel="kubeflow_tpu/serving/_palloc.py") == []
 
+    def test_blocking_socket_send_in_scheduler_flagged(self, tmp_path):
+        """ISSUE 8 satellite: a blocking socket send reachable from an
+        engine's scheduler roots stalls every live request for a
+        network round trip — the migrate path must run off-thread."""
+        code = """
+class FooEngine:
+    def _loop(self):
+        while True:
+            self._stream_block()
+
+    def _stream_block(self):
+        self.sock.sendall(self._next_frame())
+"""
+        found = lint_snippet(tmp_path, code, ["host-sync-in-dispatch"])
+        assert len(found) == 1
+        assert "socket" in found[0].message
+        assert found[0].scope == "FooEngine._stream_block"
+
+    def test_blocking_socket_near_miss_worker_thread(self, tmp_path):
+        """sendall in a method NOT reachable from scheduler roots (the
+        migration worker pattern) — and in a non-Engine server class —
+        is clean."""
+        code = """
+import socket
+
+class FooEngine:
+    def _loop(self):
+        self._mailbox.get_nowait()
+
+    def _migration_worker(self):
+        # runs on its own thread; never called from _loop
+        self.sock.sendall(b"frame")
+
+class KvMigrationServer:
+    def _serve_one(self, c):
+        c.sendall(b"ack")
+        return socket.create_connection(("h", 1))
+"""
+        assert lint_snippet(tmp_path, code,
+                            ["host-sync-in-dispatch"]) == []
+
 
 class TestJitInLoopRule:
     def test_true_positive(self, tmp_path):

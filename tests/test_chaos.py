@@ -928,3 +928,131 @@ class TestControlPlaneCrash:
             self._assert_consistent_store(c2)
         finally:
             c2.stop()
+
+
+class TestKvMigrateChaos:
+    """Seeded kill/socket-drop mid-``kv_migrate`` (ISSUE 8): the
+    transfer is copy-then-cutover, so a connection that dies at ANY
+    frame leaves the source sequence decoding in place, delivers every
+    client token exactly once, and leaks zero blocks on either
+    allocator (``kv_blocks_free`` returns to baseline on both ends)."""
+
+    def _tiny_paged(self):
+        import jax
+        import jax.numpy as jnp
+
+        from kubeflow_tpu.models import llama as llamalib
+        from kubeflow_tpu.serving.continuous import ContinuousEngine
+
+        cfg = llamalib.tiny()
+        params = llamalib.Llama(cfg).init(
+            jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32))["params"]
+        kw = dict(num_slots=4, decode_chunk=2, prefix_cache=False,
+                  block_size=16)
+        return cfg, params, kw, ContinuousEngine
+
+    def test_seeded_drop_mid_migration_copy_then_cutover(self):
+        from kubeflow_tpu.serving.gang import (
+            KvMigrationServer,
+            migrate_sequence,
+            register_migration_handle,
+            unregister_migration_handle,
+        )
+
+        cfg, params, kw, Engine = self._tiny_paged()
+        prompt = list(range(1, 65))
+        ref = Engine(cfg, params, **kw)
+        try:
+            want = ref.generate(prompt, max_new_tokens=120)
+        finally:
+            ref.stop()
+        for seed in (0, 1, 2):
+            plan = FaultPlan(seed=seed).kv_migrate_drop()
+            src = Engine(cfg, params, **kw)
+            dst = Engine(cfg, params, **kw)
+            srv = KvMigrationServer(dst, token="t")
+            try:
+                base_src = src.stats()["kv_blocks_free"]
+                base_dst = dst.stats()["kv_blocks_free"]
+                req = src.submit(prompt, max_new_tokens=120)
+                wait_for(lambda: len(req.tokens) >= 3,
+                         desc="tokens before export")
+                snap = src.export_sequence(req)
+                assert snap is not None
+                mid = register_migration_handle(req)
+                st = migrate_sequence(
+                    snap, "127.0.0.1", srv.port, token="t", mid=mid,
+                    sock_wrap=plan.socket_wrapper("kv_migrate"),
+                    timeout=5.0)
+                if st is True:
+                    src.release_sequence(req)
+                elif st is False or unregister_migration_handle(mid):
+                    # definitive: rejected, or kv_commit never reached
+                    # the destination — the source resumes immediately
+                    unregister_migration_handle(mid)
+                    src.kv_migrate_failures_total += 1
+                    src.resume_sequence(req)
+                else:
+                    # commit delivered, ack lost (two-generals tail):
+                    # the destination owns it — resuming blind would
+                    # double-decode; await the late cutover instead
+                    wait_for(lambda: dst._find_req_slot(req) is not None,
+                             desc="late cutover after lost ack")
+                    src.release_sequence(req)
+                # exactly once, exactly the unmigrated tokens
+                assert req.wait(120) == want, f"seed {seed}"
+                assert len(req.tokens) == 120
+                # zero leaked blocks on either side once all retires land
+                wait_for(lambda: src.stats()["kv_blocks_free"]
+                         == base_src, desc="src blocks back to baseline")
+                wait_for(lambda: dst.stats()["kv_blocks_free"]
+                         == base_dst, desc="dst blocks back to baseline")
+            finally:
+                srv.close()
+                src.stop()
+                dst.stop()
+
+    def test_drop_during_drain_keeps_draining_engine_serving(self):
+        """A drain whose wire transfer dies mid-stream falls back to
+        decoding in place: migrate_live_sequences reports the failure,
+        the conversation finishes on the source, nothing leaks."""
+        from kubeflow_tpu.serving.continuous import migrate_live_sequences
+        from kubeflow_tpu.serving.gang import (
+            KvMigrationServer,
+            migrate_sequence,
+        )
+
+        cfg, params, kw, Engine = self._tiny_paged()
+        prompt = list(range(1, 65))
+        ref = Engine(cfg, params, **kw)
+        try:
+            want = ref.generate(prompt, max_new_tokens=120)
+        finally:
+            ref.stop()
+        plan = FaultPlan(seed=3).kv_migrate_drop(after_frames=2)
+        src = Engine(cfg, params, **kw)
+        dst = Engine(cfg, params, **kw)
+        srv = KvMigrationServer(dst, token="t")
+        try:
+            base_src = src.stats()["kv_blocks_free"]
+            req = src.submit(prompt, max_new_tokens=120)
+            wait_for(lambda: len(req.tokens) >= 2, desc="tokens")
+
+            def send(snap, _req):
+                return migrate_sequence(
+                    snap, "127.0.0.1", srv.port, token="t",
+                    sock_wrap=plan.socket_wrapper("kv_migrate"),
+                    timeout=5.0)
+
+            moved, failed = migrate_live_sequences(src, send=send)
+            assert failed == 1 and moved == 0
+            assert src.kv_migrate_failures_total == 1
+            assert req.wait(120) == want
+            wait_for(lambda: src.stats()["kv_blocks_free"] == base_src,
+                     desc="src blocks back to baseline")
+            assert dst.stats()["kv_blocks_free"] \
+                == dst.stats()["kv_blocks_total"]
+        finally:
+            srv.close()
+            src.stop()
+            dst.stop()

@@ -422,6 +422,19 @@ class TestCancellationAndStats:
             assert 'kft_engine_prefix_block_hits_total{model="statgen"}' \
                 in text
             assert "# TYPE kft_engine_kv_fragmentation_ratio gauge" in text
+            # live KV migration (ISSUE 8) rides the same export: counts,
+            # bytes, failures and the latency histogram buckets
+            assert 'kft_engine_kv_migrations_total{model="statgen"} 0' \
+                in text
+            assert 'kft_engine_kv_migrate_bytes_total{model="statgen"} 0' \
+                in text
+            assert ('kft_engine_kv_migrate_failures_total{model="statgen"}'
+                    " 0") in text
+            assert "# TYPE kft_engine_kv_migrate_latency_ms_bucket_le_5 " \
+                "gauge" in text
+            assert "kft_engine_kv_migrate_latency_ms_bucket_le_inf" in text
+            assert "kft_engine_kv_migrate_latency_ms_count" in text
+            assert "kft_engine_kv_migrate_latency_ms_sum" in text
         finally:
             srv.stop()
 

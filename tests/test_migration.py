@@ -1,0 +1,502 @@
+"""Live paged-KV migration + prefill/decode disaggregation (ISSUE 8).
+
+Four layers, matching the tentpole:
+
+- the PRIMITIVE: a conversation exported mid-decode and resumed on a
+  second engine produces BIT-IDENTICAL greedy tokens to the unmigrated
+  run (plain/chunked paged variants here; spec + int8-KV in the slow
+  set), with ``jit_recompiles_total == 0`` on both ends and every block
+  returned to both free lists;
+- SAFETY: copy-then-cutover — a rejected transfer (destination pool
+  exhausted) resumes the source in place, and a released sequence stays
+  prefix-matchable on the source until its blocks are reused;
+- DISAGGREGATION: the pool routes admissions to prefill-role engines,
+  hands finished sequences to the decode engine with the most free
+  blocks (in-process and over the wire kv_migrate framing), and SSE
+  streams survive the hop on the same request handle;
+- DRAIN + controller: ``migrate_live_sequences`` empties a replica
+  losslessly, the ISvc scale-down path invokes it, and bad ``role`` /
+  ``disaggregation`` knobs are ONE Failed status at conf-freeze.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubeflow_tpu.models import llama as llamalib
+from kubeflow_tpu.serving.continuous import (
+    ContinuousEngine,
+    DisaggregatedPool,
+    migrate_live_sequences,
+)
+
+
+@pytest.fixture(scope="module")
+def tiny_llama():
+    cfg = llamalib.tiny()
+    model = llamalib.Llama(cfg)
+    params = model.init(jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32))
+    return cfg, params["params"]
+
+
+LONG = list(range(1, 65))  # 64 tokens = 4 blocks at block_size 16
+
+
+def make_engine(tiny_llama, **kw):
+    cfg, params = tiny_llama
+    kw.setdefault("num_slots", 4)
+    kw.setdefault("decode_chunk", 2)
+    kw.setdefault("prefix_cache", False)
+    kw.setdefault("block_size", 16)
+    return ContinuousEngine(cfg, params, **kw)
+
+
+@pytest.fixture(scope="module")
+def oracle(tiny_llama):
+    """Unmigrated greedy truth on a single paged engine."""
+    eng = make_engine(tiny_llama)
+    try:
+        return {
+            "long40": eng.generate(LONG, max_new_tokens=40),
+            "long24": eng.generate(LONG, max_new_tokens=24),
+            "short12": eng.generate([7, 8, 9], max_new_tokens=12),
+        }
+    finally:
+        eng.stop()
+
+
+def _export_after(src, req, n_tokens: int):
+    """Export once ``req`` has emitted >= n_tokens (mid-decode)."""
+    deadline = time.time() + 120
+    while len(req.tokens) < n_tokens:
+        assert time.time() < deadline, "no tokens emitted"
+        time.sleep(0.002)
+    return src.export_sequence(req)
+
+
+class TestMigrationParity:
+    """Acceptance: migration is invisible to greedy correctness."""
+
+    def test_mid_decode_migration_bit_identical(self, tiny_llama, oracle):
+        src = make_engine(tiny_llama)
+        dst = make_engine(tiny_llama)
+        src.warmup()
+        dst.warmup()
+        try:
+            base_free = src.stats()["kv_blocks_free"]
+            req = src.submit(LONG, max_new_tokens=40)
+            snap = _export_after(src, req, 3)
+            assert snap is not None and snap["phase"] == "decode"
+            assert dst.import_sequence(snap, req=req) is req
+            src.release_sequence(req)
+            assert req.wait(120) == oracle["long40"]
+            # zero recompiles on BOTH ends (warmed kv programs)
+            assert src.stats()["jit_recompiles_total"] == 0
+            assert dst.stats()["jit_recompiles_total"] == 0
+            # the source freed everything; the destination retires on
+            # completion (poll: retirement happens at a chunk boundary)
+            deadline = time.time() + 10
+            while src.stats()["kv_blocks_free"] != base_free:
+                assert time.time() < deadline
+                time.sleep(0.01)
+            # one migration counts ONCE, on the importing side; the
+            # source's outbound view is bytes + the latency histogram
+            assert src.kv_migrations_total == 0
+            assert dst.kv_migrations_total == 1
+            assert src.kv_migrate_bytes_total > 0
+            assert dst.kv_migrate_bytes_total > 0
+        finally:
+            src.stop()
+            dst.stop()
+
+    def test_mid_prefill_migration_chunk_boundary(self, tiny_llama,
+                                                  oracle):
+        """A partially-prefilled sequence hands off at its chunk
+        boundary: the destination runs the REMAINING chunks and the
+        tokens still match the unmigrated run."""
+        src = make_engine(tiny_llama, decode_chunk=1, prefill_budget=4)
+        dst = make_engine(tiny_llama, decode_chunk=1, prefill_budget=4)
+        src.warmup()
+        dst.warmup()
+        try:
+            # keep the scheduler busy so the 16-chunk admission of LONG
+            # is observably in flight when we export
+            victim = src.submit([7, 8, 9], max_new_tokens=12)
+            req = src.submit(LONG, max_new_tokens=24)
+            deadline = time.time() + 60
+            while src.prefill_chunks_dispatched < 3:
+                assert time.time() < deadline, "prefill never started"
+                time.sleep(0.002)
+            snap = src.export_sequence(req)
+            assert snap is not None
+            assert dst.import_sequence(snap, req=req) is req
+            src.release_sequence(req)
+            assert req.wait(120) == oracle["long24"]
+            assert victim.wait(120) == oracle["short12"]
+            assert src.stats()["jit_recompiles_total"] == 0
+            assert dst.stats()["jit_recompiles_total"] == 0
+        finally:
+            src.stop()
+            dst.stop()
+
+    @pytest.mark.slow
+    def test_speculative_variant_parity(self, tiny_llama):
+        """Spec-on paged engine: the residual ban and position front
+        migrate with the sequence; greedy tokens stay the spec-off
+        oracle's."""
+        loopy = [5, 6, 5, 6, 5, 6, 5]
+        off = make_engine(tiny_llama, decode_chunk=1)
+        try:
+            want = off.generate(loopy, max_new_tokens=24)
+        finally:
+            off.stop()
+        src = make_engine(tiny_llama, decode_chunk=1, spec_k=4)
+        dst = make_engine(tiny_llama, decode_chunk=1, spec_k=4)
+        src.warmup()
+        dst.warmup()
+        try:
+            req = src.submit(loopy, max_new_tokens=24)
+            snap = _export_after(src, req, 2)
+            if snap is not None:  # may already have finished dispatching
+                dst.import_sequence(snap, req=req)
+                src.release_sequence(req)
+            assert req.wait(300) == want
+            assert src.stats()["jit_recompiles_total"] == 0
+            assert dst.stats()["jit_recompiles_total"] == 0
+        finally:
+            src.stop()
+            dst.stop()
+
+    @pytest.mark.slow
+    def test_int8_kv_variant_parity(self, tiny_llama):
+        """int8-KV blocks (values + seq-LAST scale buffers) survive the
+        byte round trip bit-for-bit."""
+        cfg, params = tiny_llama
+        qcfg, qparams = llamalib.quantize_for_serving(
+            cfg, params, weights=False, kv=True)
+        kw = dict(num_slots=2, decode_chunk=2, prefix_cache=False,
+                  block_size=16)
+        ref = ContinuousEngine(qcfg, qparams, **kw)
+        try:
+            want = ref.generate(LONG, max_new_tokens=24)
+        finally:
+            ref.stop()
+        src = ContinuousEngine(qcfg, qparams, **kw)
+        dst = ContinuousEngine(qcfg, qparams, **kw)
+        src.warmup()
+        dst.warmup()
+        try:
+            req = src.submit(LONG, max_new_tokens=24)
+            snap = _export_after(src, req, 2)
+            assert snap is not None
+            dst.import_sequence(snap, req=req)
+            src.release_sequence(req)
+            assert req.wait(300) == want
+            assert src.stats()["jit_recompiles_total"] == 0
+            assert dst.stats()["jit_recompiles_total"] == 0
+        finally:
+            src.stop()
+            dst.stop()
+
+
+class TestMigrationSafety:
+    """Copy-then-cutover: failure leaves the source intact."""
+
+    def test_destination_exhaustion_rejects_and_source_resumes(
+            self, tiny_llama, oracle):
+        src = make_engine(tiny_llama)
+        dst = make_engine(tiny_llama, num_slots=2, num_blocks=2)
+        try:
+            req = src.submit(LONG, max_new_tokens=40)
+            snap = _export_after(src, req, 3)
+            assert snap is not None
+            with pytest.raises(RuntimeError, match="blocks"):
+                dst.import_sequence(snap, req=req)
+            # nothing leaked on the destination, nothing held
+            assert dst.stats()["kv_blocks_free"] == 2
+            src.resume_sequence(req)
+            assert req.wait(120) == oracle["long40"]
+            assert len(req.tokens) == 40  # no duplicates, no drops
+        finally:
+            src.stop()
+            dst.stop()
+
+    def test_released_sequence_stays_prefix_matchable(self, tiny_llama):
+        """Release registers the migrated-away content: a follow-up
+        prompt sharing the conversation's prefix hits the source's
+        block registry (the free list doubling as the prefix cache)."""
+        src = make_engine(tiny_llama, prefix_cache=True, min_prefix=8)
+        dst = make_engine(tiny_llama, prefix_cache=True, min_prefix=8)
+        try:
+            req = src.submit(LONG, max_new_tokens=24)
+            snap = _export_after(src, req, 2)
+            assert snap is not None
+            dst.import_sequence(snap, req=req)
+            src.release_sequence(req)
+            req.wait(120)
+            src.generate(LONG, max_new_tokens=4)
+            assert src.prefix_hits >= 1
+            assert src.stats()["prefix_block_hits_total"] >= 1
+        finally:
+            src.stop()
+            dst.stop()
+
+    def test_cancel_during_frozen_migration_frees_source(
+            self, tiny_llama):
+        """A client disconnect while the slot is frozen for transfer
+        must still free the source slot (the sweep retires done
+        requests, migrating or not)."""
+        src = make_engine(tiny_llama)
+        try:
+            base_free = src.stats()["kv_blocks_free"]
+            req = src.submit(LONG, max_new_tokens=40)
+            snap = _export_after(src, req, 2)
+            assert snap is not None
+            req.cancel()
+            # resume of a cancelled request is a no-op, never an error
+            src.resume_sequence(req)
+            deadline = time.time() + 10
+            while src.stats()["kv_blocks_free"] != base_free:
+                assert time.time() < deadline
+                time.sleep(0.01)
+        finally:
+            src.stop()
+
+
+class TestDisaggregatedPool:
+    KW = dict(num_slots=4, decode_chunk=2, prefix_cache=False,
+              block_size=16, prefill_budget=16)
+
+    def _mixed_oracle(self, tiny_llama):
+        cfg, params = tiny_llama
+        ref = ContinuousEngine(cfg, params, **self.KW)
+        try:
+            return (ref.generate(LONG, max_new_tokens=24),
+                    ref.generate([7, 8, 9], max_new_tokens=12))
+        finally:
+            ref.stop()
+
+    def test_roles_and_parity_in_process(self, tiny_llama):
+        cfg, params = tiny_llama
+        want_long, want_short = self._mixed_oracle(tiny_llama)
+        pool = DisaggregatedPool(cfg, params, prefill_replicas=1,
+                                 decode_replicas=2, **self.KW)
+        try:
+            pool.warmup()
+            assert pool.generate(LONG, max_new_tokens=24,
+                                 timeout=120) == want_long
+            assert pool.generate([7, 8, 9], max_new_tokens=12,
+                                 timeout=120) == want_short
+            st = pool.stats()
+            assert st["kv_migrations_total"] == 2  # one per handoff
+            assert st["jit_recompiles_total"] == 0
+            # role gate: decode engines never ran a prefill chunk, and
+            # the decode tier emitted (essentially all) the tokens
+            assert all(e.prefill_chunks_dispatched == 0
+                       for e in pool.decode)
+            assert sum(e.tokens_emitted for e in pool.decode) >= 30
+            assert st["kv_migrate_latency_ms_count"] >= 2
+        finally:
+            pool.stop()
+
+    @pytest.mark.slow
+    def test_wire_transport_parity(self, tiny_llama):
+        """The same handoffs over the authenticated kv_migrate TCP
+        framing (the bytes a cross-host deployment ships)."""
+        cfg, params = tiny_llama
+        want_long, want_short = self._mixed_oracle(tiny_llama)
+        pool = DisaggregatedPool(cfg, params, prefill_replicas=1,
+                                 decode_replicas=1, wire=True,
+                                 migrate_token="secret", **self.KW)
+        try:
+            pool.warmup()
+            assert pool.generate(LONG, max_new_tokens=24,
+                                 timeout=120) == want_long
+            assert pool.generate([7, 8, 9], max_new_tokens=12,
+                                 timeout=120) == want_short
+            assert pool.stats()["kv_migrations_total"] >= 2
+            assert pool._servers[0].imports_total >= 2
+        finally:
+            pool.stop()
+
+    def test_sse_stream_survives_handoff(self, tiny_llama):
+        """The front server re-targets the request handle when the KV
+        moves from the prefill tier to the decode tier: one SSE stream,
+        no reconnect, chunk concatenation == the blocking completion."""
+        from kubeflow_tpu.serving.text import TextGenerator
+
+        cfg, params = tiny_llama
+        pool = DisaggregatedPool(cfg, params, prefill_replicas=1,
+                                 decode_replicas=1, **self.KW)
+        model = TextGenerator("m", {"tokenizer": "bytes"}, engine=pool)
+        model.load()
+        try:
+            blocking = model.openai_completions(
+                {"prompt": "hello world, this is a prompt",
+                 "max_tokens": 16})
+            want = blocking["choices"][0]["text"]
+            chunks = []
+            for raw in model.openai_stream(
+                    {"prompt": "hello world, this is a prompt",
+                     "max_tokens": 16, "stream": True}):
+                line = raw.decode()
+                if line.startswith("data: ") and "[DONE]" not in line:
+                    import json as _json
+
+                    chunks.append(_json.loads(
+                        line[len("data: "):])["choices"][0]["text"])
+            assert "".join(chunks) == want
+            assert pool.stats()["kv_migrations_total"] >= 2
+        finally:
+            model.stop()
+
+
+class TestDrainRebalance:
+    def test_drain_moves_every_live_conversation(self, tiny_llama,
+                                                 oracle):
+        """migrate_live_sequences empties the source losslessly: all
+        conversations resume on the destination with exact tokens, the
+        source pool returns to its free baseline, and the latency
+        histogram records every move."""
+        src = make_engine(tiny_llama)
+        dst = make_engine(tiny_llama)
+        try:
+            base_free = src.stats()["kv_blocks_free"]
+            r1 = src.submit(LONG, max_new_tokens=40)
+            r2 = src.submit([7, 8, 9], max_new_tokens=12)
+            deadline = time.time() + 120
+            while len(r1.tokens) < 2 or len(r2.tokens) < 2:
+                assert time.time() < deadline, "no tokens emitted"
+                time.sleep(0.002)
+            moved, failed = migrate_live_sequences(src, dst)
+            assert failed == 0 and moved >= 1
+            assert r1.wait(120) == oracle["long40"]
+            assert r2.wait(120) == oracle["short12"]
+            assert src.stats()["kv_blocks_free"] == base_free
+            assert src.stats()["kv_migrate_latency_ms_count"] == moved
+            # defrag-for-free: the destination packed the sequences
+            # into fresh blocks; nothing fragmented remains on src
+            assert all(not b for b in src._slot_blocks)
+        finally:
+            src.stop()
+            dst.stop()
+
+    def test_controller_scale_down_migrates_replica(self, tiny_llama):
+        """The ISvc drain hook: a retiring replica's live conversations
+        move to a ready peer before the bounded drain runs."""
+        from kubeflow_tpu.serving.controller import (
+            InferenceServiceController,
+        )
+        from kubeflow_tpu.serving.server import ModelServer
+
+        class _Shim:
+            def __init__(self, engine):
+                self.engine = engine
+
+        src = make_engine(tiny_llama)
+        dst = make_engine(tiny_llama)
+        srv_a, srv_b = ModelServer(), ModelServer()
+        srv_a._models["m"] = _Shim(src)
+        srv_b._models["m"] = _Shim(dst)
+        events = []
+
+        class _Ctl:
+            emit_event = staticmethod(
+                lambda isvc, reason, msg: events.append((reason, msg)))
+
+        class _Rev:
+            predictors = [srv_a, srv_b]
+
+        try:
+            req = src.submit(LONG, max_new_tokens=120)
+            deadline = time.time() + 120
+            while len(req.tokens) < 2:
+                assert time.time() < deadline, "no tokens emitted"
+                time.sleep(0.002)
+            moved = InferenceServiceController._migrate_replica_conversations(
+                _Ctl(), None, _Rev(), srv_a)
+            assert moved == 1
+            assert events and events[0][0] == "ConversationsMigrated"
+            assert dst._find_req_slot(req) is not None or req.done.is_set()
+            assert len(req.wait(300)) == 120
+        finally:
+            src.stop()
+            dst.stop()
+
+
+class TestRoleKnobs:
+    def test_bad_role_rejected_at_engine(self, tiny_llama):
+        cfg, params = tiny_llama
+        with pytest.raises(ValueError, match="role"):
+            ContinuousEngine(cfg, params, block_size=16, role="sideways")
+        with pytest.raises(ValueError, match="paged"):
+            ContinuousEngine(cfg, params, role="prefill")
+        with pytest.raises(ValueError, match="paged"):
+            DisaggregatedPool(cfg, params)
+
+    def test_bad_role_fails_isvc_at_conf_freeze(self):
+        """Satellite: a bad ``role`` on an ISvc is ONE Failed status
+        with the knob named — caught at conf-freeze, before any replica
+        constructs (no crash-looping pods)."""
+        import time as _time
+
+        from kubeflow_tpu.api.common import ObjectMeta
+        from kubeflow_tpu.api.inference import (
+            ComponentSpec,
+            InferenceService,
+            InferenceServicePhase,
+            InferenceServiceSpec,
+            ModelFormat,
+        )
+        from kubeflow_tpu.controlplane.cluster import Cluster
+
+        with Cluster() as cluster:
+            cluster.add_tpu_slice("slice-0", 1, 4)
+            cluster.enable_serving()
+            cluster.store.create(InferenceService(
+                metadata=ObjectMeta(name="bad-role"),
+                spec=InferenceServiceSpec(predictor=ComponentSpec(
+                    model_format=ModelFormat(name="llama-continuous"),
+                    config={"params_ref": "mem://never-fetched",
+                            "block_size": 16, "role": "sideways"}))))
+            cluster.store.create(InferenceService(
+                metadata=ObjectMeta(name="bad-disagg"),
+                spec=InferenceServiceSpec(predictor=ComponentSpec(
+                    model_format=ModelFormat(name="llama-continuous"),
+                    config={"params_ref": "mem://never-fetched",
+                            "disaggregation": {"prefill": 0}}))))
+            for name, needle in (("bad-role", "role"),
+                                 ("bad-disagg", "disaggregation")):
+                deadline = _time.time() + 20
+                isvc = None
+                while _time.time() < deadline:
+                    isvc = cluster.store.try_get("InferenceService", name)
+                    if (isvc is not None and isvc.status.phase
+                            == InferenceServicePhase.FAILED):
+                        break
+                    _time.sleep(0.05)
+                assert isvc is not None
+                assert isvc.status.phase == InferenceServicePhase.FAILED, \
+                    isvc.status
+                assert needle in (isvc.status.message or "")
+
+
+class TestScatterWindow:
+    """Satellite r11: the scatter write-window mask is a pure subset of
+    the old full write-back — shared-prefix COW integrity and parity
+    already pin it across the suite; here the helper's mask logic."""
+
+    def test_write_window_mask(self):
+        from kubeflow_tpu.serving.paged import write_window_tables
+
+        bt = jnp.asarray([[3, 4, 5], [6, 7, 8]], jnp.int32)
+        front = jnp.asarray([17, 48], jnp.int32)  # blocks of 16
+        out = np.asarray(write_window_tables(bt, front, 16))
+        # row 0 writes from pos 17 -> block 1 on: entry 0 masked
+        assert out[0, 0] > 1 << 20 and (out[0, 1:] == [4, 5]).all()
+        # row 1 writes from pos 48 = block 3 -> beyond the table: all
+        # entries masked (an idle/inactive row scatters nothing)
+        assert (out[1] > 1 << 20).all()
