@@ -254,11 +254,20 @@ def host_sync_in_dispatch(ctx: LintContext) -> Iterable[Finding]:
         ]
         # paged-KV allocators run between dispatches on the scheduler
         # thread: EVERY method is dispatch-path (block-table assembly,
-        # free-list pops, prefix matching) — host numpy only
+        # free-list pops, prefix matching) — host numpy only.  Traffic-
+        # plane admission classes (ISSUE 9: ``*TrafficPlane`` /
+        # ``*Admission`` / ``*Preemptor``) get the same walk for the
+        # inverse reason:
+        # token-bucket and queue accounting runs on router/HTTP worker
+        # threads and the engine's admission_policy hook runs ON the
+        # scheduler thread — either way a device fetch or a blocking
+        # socket in QoS bookkeeping stalls every live request, so it
+        # must stay host-side stdlib.
         roots += [
             qual
             for cls, methods in graph.by_class.items()
-            if cls.endswith("Allocator")
+            if cls.endswith(("Allocator", "TrafficPlane", "Admission",
+                             "Preemptor"))
             for qual in methods.values()
         ]
         if not roots:

@@ -39,10 +39,20 @@ class ProfileSpec(_Model):
     #: mutations scope to the profile's namespace (apiserver authz;
     #: the reference's Profile RBAC binding analog)
     api_token: Optional[str] = None
+    #: request-plane QoS for this tenant (ISSUE 9; serving/traffic.py
+    #: ``validate_qos`` shape): ``{"rate": req/s, "burst": n,
+    #: "priority": "high"|"normal"|"low", "max_concurrent": n,
+    #: "queue_depth": n}``.  The ISvc controller merges every Profile's
+    #: qos into each front door's traffic plane (tenant id = profile
+    #: name); resource_quota stays the gang scheduler's concern —
+    #: this is the REQUEST-RATE half the platform lacked.  Validated
+    #: by the Profile controller (a bad spec is one Failed status),
+    #: kept a plain dict so the api layer stays serving-agnostic.
+    qos: Optional[dict] = None
 
 
 class ProfileStatus(_Model):
-    phase: str = "Pending"  # Pending | Ready
+    phase: str = "Pending"  # Pending | Ready | Failed
     #: live resource usage of non-terminal pods in the namespace
     usage: dict[str, float] = Field(default_factory=dict)
     message: str = ""

@@ -50,6 +50,7 @@ class FaultKind(str, enum.Enum):
     SOCKET_DROP = "socket_drop"      # gang control socket dies mid-stream
     SOCKET_DELAY = "socket_delay"    # gang control sends are delayed
     CONTROL_PLANE_CRASH = "control_plane_crash"  # kill -9 at a WAL offset
+    REPLICA_KILL = "replica_kill"    # serving replica dies mid-storm
 
 
 @dataclass
@@ -227,6 +228,40 @@ class FaultPlan:
                     after_records=f.after_calls or 0,
                     torn_bytes=f.torn_bytes)
             return self._crashpoint
+
+    def replica_kill_mid_storm(self, world: int,
+                               at: Optional[float] = None,
+                               min_at: float = 0.2,
+                               max_at: float = 2.0) -> "FaultPlan":
+        """Kill one of ``world`` serving replicas at a seeded offset
+        into a traffic storm (ISSUE 9): the member choice AND the kill
+        time are frozen at plan-build time, so a failing storm run
+        reproduces byte-for-byte.  The open-loop traffic bench /
+        chaos test polls :meth:`due_replica_kills` from its arrival
+        loop and abruptly stops the chosen replica server.  The
+        contract under test: already-shed requests got their explicit
+        429 (never a hang), in-flight requests on the dead replica
+        surface as a bounded re-route or 5xx (never a hang), and
+        prefix affinity forgets the corpse — same-prefix traffic
+        re-routes to the survivors."""
+        if at is None:
+            at = min_at + self.rng.random() * max(max_at - min_at, 0.0)
+        self.faults.append(Fault(FaultKind.REPLICA_KILL,
+                                 index=self.rng.randrange(world), at=at))
+        return self
+
+    def due_replica_kills(self, now: Optional[float] = None) -> list[int]:
+        """Replica indices whose seeded kill is due (each fault fires
+        at most once) — the actuator poll for the storm driver."""
+        t = self.elapsed(now)
+        out: list[int] = []
+        with self._lock:
+            for f in self.faults:
+                if (f.kind == FaultKind.REPLICA_KILL and not f.fired
+                        and t >= f.at):
+                    f.fired = 1
+                    out.append(f.index)
+        return out
 
     def socket_delay(self, role: str = "leader", delay: float = 0.01,
                      times: int = 1) -> "FaultPlan":

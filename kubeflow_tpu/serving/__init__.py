@@ -6,6 +6,7 @@ from .model import Model
 from .runtimes import EchoModel, JaxFunctionModel, LlamaGenerator
 from .server import MicroBatcher, ModelServer
 from .storage import StorageError, download, fetch_mem, register_mem
+from .traffic import QosClass, TrafficPlane, validate_qos
 from .transformer import Transformer
 
 __all__ = [
@@ -16,9 +17,12 @@ __all__ = [
     "MicroBatcher",
     "Model",
     "ModelServer",
+    "QosClass",
     "Router",
     "StorageError",
+    "TrafficPlane",
     "Transformer",
+    "validate_qos",
     "download",
     "fetch_mem",
     "register_mem",

@@ -90,6 +90,11 @@ class Request:
     temperature: Optional[float] = None
     top_p: Optional[float] = None
     top_k: Optional[int] = None
+    #: QoS priority tier (serving/traffic.py: 0=high, 1=normal, 2=low):
+    #: admission prefers lower tiers (stable sort — FIFO within a
+    #: tier), and the traffic plane's preemptor may evict-and-requeue
+    #: a live higher-tier sequence for a waiting lower-tier one
+    priority: int = 1
     submitted_at: float = field(default_factory=time.perf_counter)
     #: engine step counter when the request was submitted / admitted
     submitted_step: int = 0
@@ -2144,6 +2149,7 @@ class ContinuousEngine:
         self, prompt: list[int], max_new_tokens: Optional[int] = None,
         temperature: Optional[float] = None,
         top_p: Optional[float] = None, top_k: Optional[int] = None,
+        priority: Optional[int] = None,
     ) -> Request:
         req = Request(
             prompt=list(map(int, prompt)),
@@ -2155,6 +2161,7 @@ class ContinuousEngine:
             temperature=(None if temperature is None else float(temperature)),
             top_p=(None if top_p is None else float(top_p)),
             top_k=(None if top_k is None else int(top_k)),
+            priority=(1 if priority is None else int(priority)),
         )
         req.submitted_step = self.step_counter
         with self._gate:
@@ -2302,6 +2309,11 @@ class ContinuousEngine:
                 break
         self._waiting = [r for r in self._waiting
                          if not r.cancelled.is_set()]
+        # QoS priority admission (serving/traffic.py): better tiers
+        # admit first; the sort is STABLE, so the default all-tier-1
+        # traffic keeps exact FIFO order (a no-op for every deployment
+        # without QoS), and FIFO holds within each tier
+        self._waiting.sort(key=lambda r: r.priority)
         free = [i for i, r in enumerate(self._slots) if r is None]
         taken: list[tuple[Request, int]] = []  # (req, slot)
         plans: list[tuple] = []                # paged: parallel to taken
@@ -3034,7 +3046,7 @@ class ContinuousEngine:
             "position": position, "remaining": remaining,
             "max_new_tokens": int(req.max_new_tokens),
             "temperature": float(temp), "top_p": float(top_p),
-            "top_k": int(top_k),
+            "top_k": int(top_k), "priority": int(req.priority),
             "spec_ban": int(self._spec_ban[slot]),
             "blocks_dev": blocks_dev, "logits_dev": logits_dev,
         }
@@ -3097,7 +3109,8 @@ class ContinuousEngine:
                     prompt=prompt,
                     max_new_tokens=int(snap["max_new_tokens"]),
                     temperature=snap.get("temperature"),
-                    top_p=snap.get("top_p"), top_k=snap.get("top_k"))
+                    top_p=snap.get("top_p"), top_k=snap.get("top_k"),
+                    priority=int(snap.get("priority", 1)))
                 req.tokens = list(generated)
             self._slots[slot] = req
             self._slot_blocks[slot] = [int(b) for b in table]
@@ -3892,9 +3905,11 @@ class TieredEngine:
         return live < self.quotas[cls]
 
     def submit(self, prompt, max_new_tokens=None,
-               temperature=None, top_p=None, top_k=None) -> Request:
+               temperature=None, top_p=None, top_k=None,
+               priority=None) -> Request:
         return self.engine.submit(
-            prompt, max_new_tokens, temperature, top_p=top_p, top_k=top_k)
+            prompt, max_new_tokens, temperature, top_p=top_p, top_k=top_k,
+            priority=priority)
 
     def generate(self, prompt, max_new_tokens=None,
                  timeout: float = 120.0, temperature=None,
@@ -4198,14 +4213,15 @@ class DisaggregatedPool:
     # -- engine-shaped surface --------------------------------------------
 
     def submit(self, prompt, max_new_tokens=None,
-               temperature=None, top_p=None, top_k=None) -> Request:
+               temperature=None, top_p=None, top_k=None,
+               priority=None) -> Request:
         # admissions are role-gated: ONLY prefill engines take traffic
         # (least-loaded by queued + live), decode engines only import
         eng = min(self.prefill,
                   key=lambda e: e._queue.qsize() + len(e._prefilling)
                   + int(e._active.sum()))
         return eng.submit(prompt, max_new_tokens, temperature,
-                          top_p=top_p, top_k=top_k)
+                          top_p=top_p, top_k=top_k, priority=priority)
 
     def generate(self, prompt, max_new_tokens=None, timeout: float = 120.0,
                  temperature=None, top_p=None, top_k=None) -> list[int]:

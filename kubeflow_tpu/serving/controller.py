@@ -219,7 +219,29 @@ def resolve_class(ref: str) -> type:
 
 
 class Router:
-    """Stable URL in front of N replica servers: round-robin + activator."""
+    """Stable URL in front of N replica servers: traffic-aware routing.
+
+    Baseline behavior is the smooth-WRR + activator tier; with a
+    :class:`~.traffic.TrafficPlane` installed (``set_traffic``) the
+    router becomes the cluster front door (ISSUE 9):
+
+    - **per-tenant QoS**: token-bucket + bounded-queue admission per
+      class; sheds are explicit 429s with ``Retry-After`` and a
+      structured reason, never unbounded buffering (the SSE path blocks
+      at this door inside the class's queue bound);
+    - **prefix-affinity routing**: the prompt's prefix blocks hash to
+      block-content keys (``paged.block_keys``) and the request routes
+      to the replica that already holds them, least-loaded otherwise —
+      the replica prefix caches only pay off when the router feeds
+      them;
+    - **connection-failure re-route**: a dead backend's affinity
+      entries are forgotten and the request retries the surviving
+      replicas (bounded by pool size) — a replica crash mid-storm costs
+      a re-route, not a hang;
+    - **observability**: per-backend request/error/inflight counters on
+      the router's own ``/metrics``, with ``no_backend_total`` and the
+      plane's shed/affinity gauges.
+    """
 
     def __init__(self, activate: Callable[[], None], port: Optional[int] = None):
         self.port = port or allocate_port()
@@ -236,6 +258,11 @@ class Router:
         self._lock = threading.Lock()
         self._activate = activate
         self.last_request_time = 0.0
+        #: optional traffic plane (QoS + affinity); None = classic WRR
+        self.traffic = None
+        #: per-backend counters: url -> {requests, errors, inflight}
+        self._backend_stats: dict[str, dict[str, int]] = {}
+        self.no_backend_total = 0
         router = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -243,34 +270,157 @@ class Router:
                 pass
 
             def _proxy(self) -> None:
+                if self.command == "GET" and self.path == "/metrics":
+                    body = router.metrics_text().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "text/plain; version=0.0.4")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                # the idle clock ticks AFTER the /metrics early-return:
+                # a monitoring poller scraping faster than
+                # SCALE_IDLE_SECONDS would otherwise pin the
+                # deployment's replica count forever (scale-down and
+                # scale-to-zero key off this timestamp)
                 router.last_request_time = time.time()
                 explain = self.path.endswith(":explain")
-                backend = router._pick(explain)
+                length = int(self.headers.get("Content-Length", "0"))
+                body = self.rfile.read(length) if length else None
+                keys, tenant = router._request_context(body, self.headers)
+                plane = router.traffic
+                ticket = None
+                # the QoS door gates INFERENCE POSTs only: readiness /
+                # metadata GETs and admin POSTs (repository load/unload
+                # through the stable URL) are control-plane traffic —
+                # shedding health probes would flap the controller's
+                # view of its own replicas, and charging operators to
+                # the "default" tenant's bucket couples control
+                # operations to tenant rate limits
+                infer = (self.path.startswith("/openai/")
+                         or self.path.endswith((":predict", ":explain",
+                                                "/infer")))
+                if plane is not None and self.command == "POST" and infer:
+                    from .traffic import shed_http
+
+                    if not plane.authenticate(
+                            tenant, self.headers.get("Authorization")):
+                        # a tenant whose Profile carries an api_token
+                        # must prove the claim — otherwise any client
+                        # could adopt a privileged class's rate and
+                        # priority by naming it (the spoof the
+                        # no-self-promotion rule exists to stop)
+                        body401 = json.dumps({
+                            "error": "tenant credential required",
+                            "reason": "bad_tenant_credential",
+                            "tenant": tenant,
+                        }).encode()
+                        self._respond(401, body401)
+                        return
+                    ticket = plane.acquire(tenant)
+                    if not ticket.ok:
+                        shed_http(self, ticket)
+                        return
+                try:
+                    self._route_and_forward(
+                        explain, body, keys, tenant, ticket)
+                finally:
+                    if ticket is not None:
+                        plane.release(ticket)
+
+            def _route_and_forward(self, explain, body, keys, tenant,
+                                   ticket) -> None:
+                backend = router._pick(explain, keys)
                 if backend is None:
                     router._activate()
                     deadline = time.time() + ACTIVATION_TIMEOUT
                     while backend is None and time.time() < deadline:
                         time.sleep(0.05)
-                        backend = router._pick(explain)
-                if backend is None:
-                    self._respond(503, b'{"error": "no ready replicas"}')
-                    return
-                length = int(self.headers.get("Content-Length", "0"))
-                body = self.rfile.read(length) if length else None
-                req = urllib.request.Request(
-                    backend + self.path, data=body, method=self.command,
-                    headers={"Content-Type": "application/json"})
-                try:
-                    with urllib.request.urlopen(req, timeout=60) as resp:
-                        self._respond(resp.status, resp.read())
-                except urllib.error.HTTPError as e:
-                    self._respond(e.code, e.read())
-                except OSError as e:
-                    self._respond(502, json.dumps({"error": str(e)}).encode())
+                        backend = router._pick(explain, keys)
+                tried: set[str] = set()
+                while backend is not None:
+                    headers = {"Content-Type": "application/json"}
+                    if self.headers.get("Authorization"):
+                        # a replica-side plane may hold its own
+                        # qos_tenant_tokens: the credential must
+                        # survive the hop or routed requests from a
+                        # credentialed tenant all 401 at the replica
+                        headers["Authorization"] = \
+                            self.headers["Authorization"]
+                    if router.traffic is not None:
+                        # forward the classification only when this
+                        # router actually made one — a plane-less
+                        # router's "default" must not override the
+                        # payload's user field at a QoS-bearing replica
+                        headers["X-KFT-Tenant"] = tenant
+                    if ticket is not None:
+                        # replica-side plane must not double-charge the
+                        # tenant's token bucket.  Priority: the class
+                        # tier when one classified the tenant; the
+                        # "normal" cap when this door HAS classes but
+                        # this tenant none (an anonymous caller must
+                        # not outrank classed tenants); nothing for a
+                        # class-free affinity-only plane (no ordering
+                        # contract — the payload stands downstream)
+                        headers["X-KFT-Admitted"] = "1"
+                        if ticket.cls is not None:
+                            headers["X-KFT-Priority"] = \
+                                ticket.priority_name
+                        elif router.traffic.classes():
+                            headers["X-KFT-Priority"] = "normal"
+                    elif self.headers.get("X-KFT-Priority"):
+                        headers["X-KFT-Priority"] = \
+                            self.headers["X-KFT-Priority"]
+                    req = urllib.request.Request(
+                        backend + self.path, data=body,
+                        method=self.command, headers=headers)
+                    router._note(backend, delta=+1)
+                    try:
+                        with urllib.request.urlopen(req, timeout=60) as resp:
+                            payload = resp.read()
+                            router._note(backend, delta=-1)
+                            self._respond(resp.status, payload)
+                            return
+                    except urllib.error.HTTPError as e:
+                        router._note(backend, delta=-1,
+                                     error=e.code >= 500)
+                        self._respond(e.code, e.read(),
+                                      retry_after=e.headers.get(
+                                          "Retry-After"))
+                        return
+                    except OSError as e:
+                        router._note(backend, delta=-1, error=True)
+                        # re-route ONLY connection-level death (a
+                        # crashed replica: refused/reset/aborted) —
+                        # a slow-but-alive replica's read timeout must
+                        # NOT re-POST the inference elsewhere (it is
+                        # likely still computing; a duplicate doubles
+                        # the work and the tokens billed) nor wipe a
+                        # healthy replica's affinity
+                        reason = getattr(e, "reason", e)
+                        if not isinstance(reason, ConnectionError):
+                            self._respond(502, json.dumps(
+                                {"error": str(e)}).encode())
+                            return
+                        router._backend_down(backend)
+                        tried.add(backend)
+                        backend = router._pick(explain, keys,
+                                               exclude=tried)
+                router.no_backend_total += 1
+                self._respond(
+                    503, json.dumps({
+                        "error": "no ready replicas",
+                        "reason": "no_ready_replicas",
+                        "retry_after": 1,
+                    }).encode(), retry_after="1")
 
-            def _respond(self, code: int, body: bytes) -> None:
+            def _respond(self, code: int, body: bytes,
+                         retry_after: Optional[str] = None) -> None:
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
+                if retry_after:
+                    self.send_header("Retry-After", retry_after)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
@@ -291,6 +441,87 @@ class Router:
     def url(self) -> str:
         return f"http://127.0.0.1:{self.port}"
 
+    def set_traffic(self, plane) -> None:
+        """Install (or clear) the traffic plane — QoS admission +
+        prefix-affinity routing from the next request on."""
+        self.traffic = plane
+
+    def _request_context(self, body: Optional[bytes],
+                         headers) -> tuple[list, str]:
+        """(affinity keys, tenant) for one request.  The tenant comes
+        from the ``X-KFT-Tenant`` header or the OpenAI ``user`` field;
+        the affinity keys hash the prompt's prefix in block quanta
+        (byte-token ids — exactly the block-content identity for the
+        byte tokenizer, a stable content proxy for any other)."""
+        tenant = headers.get("X-KFT-Tenant") or ""
+        keys: list = []
+        plane = self.traffic
+        if body and plane is not None:
+            try:
+                payload = json.loads(body)
+            except (ValueError, UnicodeDecodeError):
+                payload = None
+            if isinstance(payload, dict):
+                tenant = tenant or str(payload.get("user") or "")
+                prompt = payload.get("prompt")
+                if prompt is None and isinstance(
+                        payload.get("messages"), list):
+                    prompt = "\n".join(
+                        f"{m.get('role', 'user')}: {m.get('content', '')}"
+                        for m in payload["messages"])
+                if isinstance(prompt, list):
+                    prompt = prompt[0] if prompt else ""
+                if isinstance(prompt, str) and prompt:
+                    keys = plane.prefix_keys(list(prompt.encode("utf-8")))
+        return keys, tenant or "default"
+
+    def _note(self, backend: str, delta: int, error: bool = False) -> None:
+        with self._lock:
+            st = self._backend_stats.setdefault(
+                backend, {"requests": 0, "errors": 0, "inflight": 0})
+            if delta > 0:
+                st["requests"] += 1
+            st["inflight"] = max(0, st["inflight"] + delta)
+            if error:
+                st["errors"] += 1
+
+    def _backend_down(self, backend: str) -> None:
+        if self.traffic is not None:
+            self.traffic.affinity.forget(backend)
+
+    def _inflight(self, backend: str) -> int:
+        with self._lock:
+            st = self._backend_stats.get(backend)
+            return st["inflight"] if st else 0
+
+    def backend_stats(self) -> dict[str, dict[str, int]]:
+        with self._lock:
+            return {b: dict(st) for b, st in self._backend_stats.items()}
+
+    def metrics_text(self) -> str:
+        """Router observability in Prometheus text format: per-backend
+        request/error/inflight gauges + the no-backend counter + the
+        traffic plane's shed/affinity/preemption gauges."""
+        from .traffic import prom_label
+
+        lines = []
+        for fam in ("requests", "errors", "inflight"):
+            lines.append(f"# TYPE kft_router_backend_{fam} gauge")
+            for b, st in sorted(self.backend_stats().items()):
+                lines.append(
+                    f'kft_router_backend_{fam}'
+                    f'{{backend="{prom_label(b)}"}} {st[fam]}')
+        lines.append("# TYPE kft_router_no_backend_total gauge")
+        lines.append(f"kft_router_no_backend_total {self.no_backend_total}")
+        if self.traffic is not None:
+            from .traffic import prom_stat_lines
+
+            fams = prom_stat_lines(self.traffic.stats(), "kft_router_")
+            for fam in sorted(fams):
+                lines.append(f"# TYPE {fam} gauge")
+                lines.extend(fams[fam])
+        return "\n".join(lines) + "\n"
+
     def set_backends(self, urls: list[str]) -> None:
         self.set_weighted_backends([(list(urls), 100)])
 
@@ -305,7 +536,19 @@ class Router:
                 self._wrr = [0] * len(new)  # weights changed: reset the WRR
             if [u for u, _ in new] != [u for u, _ in self._pools]:
                 self._rr = [0] * len(new)  # membership changed: reset RR
+            gone = ({u for us, _ in self._pools for u in us}
+                    - {u for us, _ in new for u in us})
             self._pools = new
+            for u in gone:
+                # replica ports never come back: without pruning,
+                # autoscale churn grows the per-backend /metrics rows
+                # (and the dict behind them) without bound
+                self._backend_stats.pop(u, None)
+        # a removed replica's KV is gone with it: keep affinity from
+        # steering same-prefix traffic at a corpse (outside the lock —
+        # the affinity map has its own)
+        for u in gone:
+            self._backend_down(u)
 
     def set_explain_backends(self, urls: list[str]) -> None:
         """Backends for the ``:explain`` verb (KServe routes the verb to the
@@ -322,9 +565,19 @@ class Router:
                 self._ewrr = [0] * len(new)
             if [u for u, _ in new] != [u for u, _ in self._explain_pools]:
                 self._err = [0] * len(new)
+            # same cleanup as the data-plane pools: explain replicas
+            # churn ports too, and their stats rows / affinity entries
+            # must die with them
+            gone = ({u for us, _ in self._explain_pools for u in us}
+                    - {u for us, _ in new for u in us})
             self._explain_pools = new
+            for u in gone:
+                self._backend_stats.pop(u, None)
+        for u in gone:
+            self._backend_down(u)
 
-    def _pick(self, explain: bool = False) -> Optional[str]:
+    def _pick(self, explain: bool = False, keys: Optional[list] = None,
+              exclude: Optional[set] = None) -> Optional[str]:
         with self._lock:
             use_explain = explain and self._explain_pools
             pools = self._explain_pools if use_explain else self._pools
@@ -344,11 +597,33 @@ class Router:
                     best = i
             cur[best] -= total
             pool = pools[best][0]
-            # round-robin WITHIN the chosen pool, cursor per pool — a
-            # shared cursor lets a 1-backend pool reset it and starve
-            # backends of the other pool during a canary split
-            rrs[best] = (rrs[best] + 1) % len(pool)
-            return pool[rrs[best]]
+            if exclude:
+                pool = [u for u in pool if u not in exclude]
+                if not pool:
+                    # crash-retry emptied the WRR-chosen pool: any
+                    # OTHER pool's live backend beats a 503 — a canary
+                    # split must not turn one stable-replica crash
+                    # into "no ready replicas" while the canary serves
+                    for us, _w in pools:
+                        pool = [u for u in us if u not in exclude]
+                        if pool:
+                            break
+                    if not pool:
+                        return None
+            plane = self.traffic
+            if plane is None or not keys:
+                # round-robin WITHIN the chosen pool, cursor per pool — a
+                # shared cursor lets a 1-backend pool reset it and starve
+                # backends of the other pool during a canary split
+                rrs[best] = (rrs[best] + 1) % len(pool)
+                return pool[rrs[best]]
+        # prefix-affinity pick (outside the WRR lock: the plane has its
+        # own): the replica already holding this prompt's prefix blocks
+        # wins unless it is overloaded vs its peers; least-inflight
+        # otherwise, and the choice is recorded so the NEXT same-prefix
+        # request sticks
+        backend, _depth = plane.route(keys, pool, load=self._inflight)
+        return backend
 
     def stop(self) -> None:
         self._httpd.shutdown()
@@ -391,6 +666,10 @@ class _Deployment:
         self.rev_counter = 0
         self.pct = 0  # live canary traffic share
         self.wants_scale_up = False
+        #: fingerprint of the traffic plane's merged config (cfg qos +
+        #: Profile qos): the plane rebuilds only when this changes, so
+        #: counters and affinity state survive the 4 Hz reconcile
+        self.traffic_fp: Optional[str] = None
 
     @property
     def revisions(self) -> list[_Revision]:
@@ -477,6 +756,40 @@ class InferenceServiceController(Controller):
                     raise ValueError(
                         "invalid engine knobs: disaggregation requires "
                         "the paged pool (block_size > 0)")
+        # traffic-plane knobs (ISSUE 9) freeze here too: a negative
+        # rate or an unknown priority tier is ONE Failed status at
+        # conf-freeze, not a replica exploding at load (the PR 4/7
+        # convention); validate_qos is the one shared validator
+        if cfg.get("qos") is not None:
+            from .traffic import validate_qos
+
+            try:
+                validate_qos(cfg["qos"])
+            except ValueError as e:
+                raise ValueError(f"invalid engine knobs: {e}") from e
+        # tenant maps validate even WITHOUT cfg qos: _sync_traffic
+        # consumes them when the classes come from Profiles, and a
+        # mistyped value would otherwise surface per-request at the
+        # router door instead of as ONE Failed status here
+        qt = cfg.get("qos_tenants")
+        if qt is not None and not (
+                isinstance(qt, dict)
+                and all(isinstance(v, str) for v in qt.values())):
+            raise ValueError(
+                "invalid engine knobs: qos_tenants must map "
+                "tenant -> class name")
+        qtt = cfg.get("qos_tenant_tokens")
+        if qtt is not None and not (
+                isinstance(qtt, dict)
+                and all(isinstance(v, str) for v in qtt.values())):
+            raise ValueError(
+                "invalid engine knobs: qos_tenant_tokens must map "
+                "tenant -> bearer token string")
+        ab = cfg.get("affinity_block")
+        if ab is not None and int(ab) < 1:
+            raise ValueError(
+                f"invalid engine knobs: affinity_block {ab} (must be "
+                ">= 1)")
         dep.rev_counter += 1
         return _Revision(
             dep.rev_counter, fingerprint, isvc.spec.model_copy(deep=True),
@@ -547,6 +860,7 @@ class InferenceServiceController(Controller):
             desired = self._desired_replicas(dep, rev)
             self._scale_predictors(isvc, dep, rev, desired)
         self._wire(isvc, dep)
+        self._sync_traffic(dep)
 
         def _up(rev: _Revision) -> bool:
             return any(getattr(s, "ready", True) for s in rev.predictors)
@@ -837,6 +1151,70 @@ class InferenceServiceController(Controller):
             explain_pools.append((canary_explain, dep.pct))
         dep.router.set_weighted_backends(pools)
         dep.router.set_weighted_explain_backends(explain_pools)
+
+    def _sync_traffic(self, dep: _Deployment) -> None:
+        """Keep the router's traffic plane (ISSUE 9) in sync with the
+        stable revision's ``qos``/affinity knobs MERGED with every
+        Profile carrying ``spec.qos`` — Profiles are the tenants, so a
+        tenant's rate/priority contract follows it to every ISvc
+        front door.  The plane rebuilds only when the merged config
+        changes (fingerprinted): counters and the affinity map survive
+        the 4 Hz reconcile loop."""
+        if dep.router is None or dep.stable is None:
+            return
+        cfg = dep.stable.cfg
+        qos = dict(cfg.get("qos") or {})
+        tenants = dict(cfg.get("qos_tenants") or {})
+        from ..api.platform import KIND_PROFILE
+
+        from .traffic import TrafficPlane, validate_qos
+
+        tokens: dict[str, str] = {}
+        for prof in self.store.list(KIND_PROFILE):
+            pq = getattr(prof.spec, "qos", None)
+            if not pq:
+                continue
+            if prof.spec.api_token:
+                # a credentialed Profile's class may only be claimed
+                # with its Bearer token (plane.authenticate at the
+                # door) — QoS classes are identity-scoped privilege
+                tokens[prof.metadata.name] = prof.spec.api_token
+            if prof.metadata.name in qos:
+                continue  # explicit ISvc config wins over the Profile
+            try:
+                validate_qos({prof.metadata.name: pq})
+            except (TypeError, ValueError):
+                continue  # the Profile controller reports it (Failed);
+                # _sync_traffic runs OUTSIDE reconcile's Failed-phase
+                # guard, so one bad Profile must never break every
+                # ISvc's status/scaling loop
+            qos[prof.metadata.name] = dict(pq)
+        # affinity_block doubles as the affinity-only opt-in: a config
+        # with no qos classes but an explicit affinity granularity
+        # still wants the prefix-aware router
+        enabled = bool(qos) or cfg.get("affinity_block") is not None
+        if not enabled:
+            if dep.traffic_fp is not None:
+                dep.router.set_traffic(None)
+                dep.traffic_fp = None
+            return
+        fp = json.dumps(
+            {"qos": qos, "tenants": tenants, "tokens": tokens,
+             "block": cfg.get("affinity_block", 32)},
+            sort_keys=True, default=str)
+        if fp == dep.traffic_fp:
+            return
+        try:
+            plane = TrafficPlane(
+                qos, tenants=tenants, tenant_tokens=tokens,
+                affinity_block=int(cfg.get("affinity_block", 32)))
+        except (TypeError, ValueError) as e:
+            # cfg qos was validated at conf-freeze; this can only be a
+            # racing Profile edit — keep the previous plane
+            log.debug("traffic plane rebuild rejected: %s", e)
+            return
+        dep.router.set_traffic(plane)
+        dep.traffic_fp = fp
 
     def _request_scale_up(self, key: str) -> None:
         with self._lock:

@@ -120,6 +120,34 @@ def write_window_tables(bt, front, block_size: int):
     return jnp.where(keep, bt, jnp.int32(np.iinfo(np.int32).max))
 
 
+def block_keys(tokens, block_size: int, max_blocks: int = 64) -> list[int]:
+    """Chained content keys for a token sequence's FULL prefix blocks.
+
+    ``key[i]`` identifies the exact token content of blocks ``[0, i]`` —
+    each key hashes the previous key plus the block's tokens, so two
+    sequences share ``key[i]`` iff their first ``(i+1) * block_size``
+    tokens are identical.  This is the block economy's identity at the
+    granularity the allocator shares KV (full blocks by refcount): the
+    traffic plane's prefix-affinity router (serving/traffic.py) matches
+    these keys against where it last routed them, because a replica that
+    served a prefix holds its blocks — live, or retired-but-registered
+    in the allocator's free-list-as-cache.  Host-side stdlib hashing
+    only (runs per request on router/server threads, never on a
+    scheduler thread)."""
+    import hashlib
+
+    n = min(len(tokens) // block_size, max_blocks)
+    keys: list[int] = []
+    h = hashlib.blake2b(digest_size=8)
+    for i in range(n):
+        blk = tokens[i * block_size:(i + 1) * block_size]
+        # analysis: ok host-sync-in-dispatch — host token list, no device value
+        h.update(np.asarray(blk, np.int64).tobytes())
+        keys.append(int.from_bytes(h.digest(), "little"))
+        h = hashlib.blake2b(h.digest(), digest_size=8)
+    return keys
+
+
 def lcp(content, prompt_arr: np.ndarray, cap: int) -> int:
     """Longest common prefix of a token sequence and the prompt array,
     capped — vectorized, runs per candidate per admission on the

@@ -428,6 +428,19 @@ class ModelServer:
                         if isinstance(v, (int, float)):
                             families.setdefault(f"kft_engine_{k}", []).append(
                                 f'kft_engine_{k}{{model="{name}"}} {v}')
+                # traffic-plane gauges (QoS admission/shed/preemption
+                # accounting — serving/traffic.py) ride the same
+                # export; per-class counters carry the class as a
+                # LABEL (class names are tenant strings — splicing
+                # them into the metric name breaks the exposition)
+                plane = getattr(model, "traffic", None)
+                if plane is not None:
+                    from .traffic import prom_label, prom_stat_lines
+
+                    for fam, lines in prom_stat_lines(
+                            plane.stats(), "kft_traffic_",
+                            f'model="{prom_label(name)}"').items():
+                        families.setdefault(fam, []).extend(lines)
             for fam in sorted(families):
                 text += f"# TYPE {fam} gauge\n" + \
                     "\n".join(families[fam]) + "\n"
@@ -489,6 +502,64 @@ class ModelServer:
             if m is None or not hasattr(m, call_attr):
                 h._send(404, {"error": f"no completions model {name!r}"})
                 return
+            if payload.get("priority") is not None:
+                # validate the client field up front: an unknown tier
+                # is a 400 (client mistake), not a mid-generation 500
+                # that inflates the router's backend-error counters
+                from .traffic import priority_tier
+
+                try:
+                    priority_tier(payload["priority"])
+                except ValueError as e:
+                    h._send(400, {"error": str(e)})
+                    return
+            # per-tenant QoS front door (serving/traffic.py, ISSUE 9):
+            # shed with an explicit 429 + Retry-After BEFORE any engine
+            # work — on the SSE path this acquire (which may block,
+            # bounded, in the class's admission queue) is the
+            # backpressure that replaces unbounded buffering.  A router
+            # that already charged the tenant's token bucket forwards
+            # X-KFT-Admitted so the bucket is charged exactly once.
+            plane = getattr(m, "traffic", None)
+            ticket = None
+            if plane is not None:
+                from .traffic import shed_http
+
+                tenant = str(h.headers.get("X-KFT-Tenant")
+                             or payload.get("user") or "default")
+                # credentialed tenants prove their claim HERE too —
+                # replicas bind loopback, but the class contract must
+                # not hinge on which door a local client picked.
+                # (X-KFT-Admitted skipping the rate charge remains a
+                # loopback-trust convenience, consistent with the rest
+                # of ModelServer's unauthenticated local surface.)
+                if not plane.authenticate(
+                        tenant, h.headers.get("Authorization")):
+                    h._send(401, {
+                        "error": "tenant credential required",
+                        "reason": "bad_tenant_credential",
+                        "tenant": tenant,
+                    })
+                    return
+                ticket = plane.acquire(
+                    tenant,
+                    charge_rate=h.headers.get("X-KFT-Admitted") != "1")
+                if not ticket.ok:
+                    shed_http(h, ticket)
+                    return
+            # the class tier is the CONTRACT: this plane's ticket (or,
+            # when this replica has no class for the tenant, the
+            # router's X-KFT-Priority cluster classification) bounds
+            # the payload priority — clients may self-demote, never
+            # outrank their class (a spoofed "priority": "high" from a
+            # bulk tenant would admit ahead of and preempt for gold)
+            if ticket is not None or h.headers.get("X-KFT-Priority"):
+                from .traffic import bound_priority
+
+                bound_priority(payload, ticket=ticket,
+                               header=h.headers.get("X-KFT-Priority"),
+                               classed=(plane is not None
+                                        and bool(plane.classes())))
             t0 = time.perf_counter()
             req_id = f"{name}-{time.time_ns()}"
             if self.logger is not None:
@@ -543,6 +614,8 @@ class ModelServer:
             finally:
                 with self.metrics.lock:
                     self.metrics.inflight -= 1
+                if plane is not None and ticket is not None:
+                    plane.release(ticket)
             return
         # V2 repository API: dynamic load/unload + index
         if path == "/v2/repository/index":

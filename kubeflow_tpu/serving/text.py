@@ -271,6 +271,41 @@ class TextGenerator(Model):
         #: on a multi-host predictor
         self.engine = engine
         self.tokenizer = None
+        #: per-tenant QoS front door (serving/traffic.py) — built by
+        #: load() from config["qos"]; ModelServer consults it on the
+        #: OpenAI paths (429 + Retry-After sheds, priority injection)
+        self.traffic = None
+
+    def _build_traffic(self) -> None:
+        qos = self.config.get("qos")
+        tokens = self.config.get("qos_tenant_tokens")
+        # tokens alone still want a door: a config carrying only
+        # qos_tenant_tokens must get its authenticate/401 enforcement,
+        # not a silently-absent plane (the phantom-knob failure mode)
+        if not qos and not tokens:
+            return
+        from .traffic import TrafficPlane
+
+        self.traffic = TrafficPlane(
+            qos or {}, tenants=self.config.get("qos_tenants"),
+            tenant_tokens=tokens)
+        if not bool(self.config.get("qos_preempt", True)):
+            return
+        # priority preemption needs an exportable (paged) pool AND the
+        # demand + the victims in the SAME pool (the preemptor watches
+        # one engine's waiting list against its own slot table): plain
+        # paged engines and the tier ladder (one pool) qualify; the
+        # DisaggregatedPool does not — its demand queues on prefill
+        # engines while victims decode elsewhere, so evicting there
+        # frees nothing the waiter can use.  Disagg still gets
+        # priority-ordered admission on its prefill engines; targeted
+        # preemption across the handoff is future work.
+        engines = ([self.engine] if getattr(self.engine, "paged", False)
+                   else [e for e in getattr(self.engine, "pools", [])
+                         if getattr(e, "paged", False)
+                         and getattr(e, "role", "mixed") == "mixed"])
+        for eng in engines:
+            self.traffic.attach_engine(eng)
 
     def load(self) -> None:
         from .continuous import build_engine, resolve_model_source
@@ -288,6 +323,7 @@ class TextGenerator(Model):
                 # does, or gang and in-process deployments of one config
                 # would stop differently
                 self.engine.eos_id = getattr(self.tokenizer, "eos_id", None)
+            self._build_traffic()
             self.ready = True
             return
         cfg, params = resolve_model_source(self.config, name=self.name)
@@ -299,15 +335,35 @@ class TextGenerator(Model):
         self.engine = build_engine(
             cfg, params, self.config, default_eos=eos,
             default_max_new_tokens=32)
+        self._build_traffic()
         self.ready = True
 
     def stop(self) -> None:
+        if self.traffic is not None:
+            self.traffic.stop()
+            self.traffic = None
         if self.engine is not None:
             self.engine.stop()
             self.engine = None
         super().stop()
 
+    @staticmethod
+    def _priority(value):
+        """Payload ``priority`` ("high"/"normal"/"low" or a tier int)
+        -> engine tier, None when absent (engine default)."""
+        if value is None:
+            return None
+        from .traffic import priority_tier
+
+        return priority_tier(value)
+
     def _submit(self, inst):
+        # NOTE: no ``priority`` here by design — the V1/V2 predict
+        # paths carry no QoS door (no ticket, no header read), so an
+        # instance-level priority would be an unbounded client field
+        # that outranks every classed tenant.  Priority enters through
+        # the OpenAI payload (bounded by ModelServer's
+        # ``bound_priority``) or direct ``engine.submit`` calls.
         if isinstance(inst, dict):
             prompt = inst.get("prompt", "")
             max_new = inst.get("max_tokens")
@@ -345,10 +401,12 @@ class TextGenerator(Model):
         max_tokens = payload.get("max_tokens")
         temp = payload.get("temperature")
         tp, tk = payload.get("top_p"), payload.get("top_k")
+        pr = self._priority(payload.get("priority"))
         n = max(1, int(payload.get("n", 1)))  # same fan-out as blocking
         reqs = [
             self.engine.submit(self.tokenizer.encode(str(p)), max_tokens,
-                               temperature=temp, top_p=tp, top_k=tk)
+                               temperature=temp, top_p=tp, top_k=tk,
+                               priority=pr)
             for p in prompts for _ in range(n)
         ]
         sent = [""] * len(reqs)
@@ -435,12 +493,14 @@ class TextGenerator(Model):
         max_tokens = payload.get("max_tokens")
         temp = payload.get("temperature")
         tp, tk = payload.get("top_p"), payload.get("top_k")
+        pr = self._priority(payload.get("priority"))
         # OpenAI ``n``: independent samples per prompt — each is its own
         # engine request, coalescing in the slot pool like any burst
         n = max(1, int(payload.get("n", 1)))
         reqs = [
             self.engine.submit(self.tokenizer.encode(p), max_tokens,
-                               temperature=temp, top_p=tp, top_k=tk)
+                               temperature=temp, top_p=tp, top_k=tk,
+                               priority=pr)
             for p in prompts for _ in range(n)
         ]
         try:

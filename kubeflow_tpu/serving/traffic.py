@@ -1,0 +1,863 @@
+"""Traffic plane: prefix-affinity routing, per-tenant QoS, preemption.
+
+The front door ROADMAP item 4 names (ISSUE 9): nothing upstream of the
+engines was traffic-aware — the Router smooth-WRRed replicas blind to
+which one already holds a request's cached KV blocks, Profiles enforced
+resource quotas at gang admission but carried no request-rate or
+priority semantics, and overload meant unbounded queue growth inside
+the engine.  This module is the missing subsystem, host-side and
+stdlib-only on purpose (every decision here runs on router / HTTP
+worker threads; the analyzer roots ``*TrafficPlane``/``*Admission``/
+``*Preemptor`` classes in ``host-sync-in-dispatch`` so none of this
+accounting can creep onto an engine scheduler thread):
+
+- **Per-tenant QoS classes** (:class:`QosClass` / :class:`TrafficPlane`):
+  token-bucket rate limiting, a priority tier (``high``/``normal``/
+  ``low`` -> the engine's ``Request.priority``), a max-concurrent slot
+  count, and a BOUNDED admission queue per class.  ``acquire`` returns
+  an explicit shed decision (429 + ``Retry-After``) the HTTP layer
+  writes to the client — the SSE path blocks at this front door inside
+  the bound, so overload becomes explicit backpressure instead of
+  unbounded buffering (the vLLM/apiserver bounded-queue rule the
+  control plane already follows).
+
+- **Prefix-affinity routing** (:class:`PrefixAffinity`): hash the
+  request's prompt-prefix blocks (``paged.block_keys`` — the block
+  economy's content identity) and route to the replica whose allocator
+  registry already holds them; the prefix cache is only as good as the
+  router that feeds it.  Falls back to least-loaded, and an affinity
+  hit is overridden when the target is overloaded relative to its
+  peers (a hot shared prefix must not melt one replica).
+
+- **Priority preemption** (:class:`EnginePreemptor`): when a
+  high-priority request is waiting and the pool is full of
+  lower-priority sequences, export the lowest-priority live sequence
+  (PR 7's ``export_sequence`` — tokens stay bit-identical on resume),
+  release its slot + blocks, and park the snapshot; it re-imports the
+  moment capacity frees and no higher-priority demand waits.
+  Evict-and-requeue is cheap exactly because KV is paged and
+  migratable — the parked state is the same snapshot a live migration
+  ships.
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import threading
+import time
+from typing import Any, Callable, Optional
+
+log = logging.getLogger("kubeflow_tpu.serving")
+
+#: priority tiers, best first — the names Profiles/configs use; the
+#: ints are what the engine's admission sort and the preemptor compare
+PRIORITY_TIERS = {"high": 0, "normal": 1, "low": 2}
+_TIER_NAMES = {v: k for k, v in PRIORITY_TIERS.items()}
+
+
+def priority_tier(value, default: int = 1) -> int:
+    """Priority spec (name or int) -> tier int; raises on unknown."""
+    if value is None:
+        return default
+    if isinstance(value, str):
+        if value not in PRIORITY_TIERS:
+            raise ValueError(
+                f"unknown priority tier {value!r} "
+                f"(one of {sorted(PRIORITY_TIERS)})")
+        return PRIORITY_TIERS[value]
+    tier = int(value)
+    if tier not in _TIER_NAMES:
+        raise ValueError(
+            f"priority tier {tier} out of range "
+            f"({sorted(_TIER_NAMES)})")
+    return tier
+
+
+class QosClass:
+    """One tenant class's QoS contract (the Profile ``qos`` shape).
+
+    ``rate``: sustained requests/second through a token bucket (0 =
+    unlimited); ``burst``: bucket depth (defaults to max(1, rate));
+    ``priority``: tier name; ``max_concurrent``: live requests allowed
+    past the door at once (0 = unlimited); ``queue_depth``: how many
+    requests may WAIT for a concurrency slot before the class sheds
+    (the bounded admission queue — 0 disables waiting entirely).
+    """
+
+    FIELDS = ("rate", "burst", "priority", "max_concurrent",
+              "queue_depth")
+
+    def __init__(self, name: str, rate: float = 0.0,
+                 burst: Optional[float] = None,
+                 priority: Any = "normal", max_concurrent: int = 0,
+                 queue_depth: int = 64):
+        self.name = str(name)
+        self.rate = float(rate)
+        if self.rate < 0:
+            raise ValueError(
+                f"qos class {name!r}: rate must be >= 0, got {rate}")
+        self.burst = float(burst) if burst is not None else max(
+            1.0, self.rate)
+        if self.burst < 1:
+            raise ValueError(
+                f"qos class {name!r}: burst must be >= 1, got {burst}")
+        try:
+            self.priority = priority_tier(priority)
+        except ValueError as e:
+            raise ValueError(f"qos class {name!r}: {e}") from e
+        self.max_concurrent = int(max_concurrent)
+        if self.max_concurrent < 0:
+            raise ValueError(
+                f"qos class {name!r}: max_concurrent must be >= 0")
+        self.queue_depth = int(queue_depth)
+        if self.queue_depth < 0:
+            raise ValueError(
+                f"qos class {name!r}: queue_depth must be >= 0")
+
+    @property
+    def priority_name(self) -> str:
+        return _TIER_NAMES[self.priority]
+
+
+def validate_qos(spec) -> dict[str, QosClass]:
+    """``{"classname": {rate, burst, priority, max_concurrent,
+    queue_depth}}`` -> classes; raises ``ValueError`` with the offending
+    class + field named.  The ONE validation site: conf-freeze (the
+    ISvc controller), the Profile controller, and plane construction
+    all call this, so a negative rate or an unknown priority tier is
+    rejected identically everywhere."""
+    if not isinstance(spec, dict):
+        raise ValueError(
+            f"qos must be a mapping of class name -> spec, got "
+            f"{type(spec).__name__}")
+    out: dict[str, QosClass] = {}
+    for name, cls_spec in spec.items():
+        if not isinstance(cls_spec, dict):
+            raise ValueError(
+                f"qos class {name!r}: spec must be a mapping, got "
+                f"{type(cls_spec).__name__}")
+        unknown = set(cls_spec) - set(QosClass.FIELDS)
+        if unknown:
+            raise ValueError(
+                f"qos class {name!r}: unknown fields {sorted(unknown)} "
+                f"(allowed: {list(QosClass.FIELDS)})")
+        try:
+            out[str(name)] = QosClass(name, **cls_spec)
+        except TypeError as e:
+            # float(None) / int([...]) and friends raise TypeError —
+            # callers are promised ValueError for ANY malformed spec
+            # (the Failed-status paths catch exactly that; a TypeError
+            # escaping here once stalled every ISvc reconcile)
+            raise ValueError(f"qos class {name!r}: {e}") from e
+    return out
+
+
+class TokenBucket:
+    """Classic token bucket on the monotonic clock.  ``try_take``
+    returns 0.0 on grant, else the seconds until a token accrues (the
+    client's ``Retry-After``)."""
+
+    def __init__(self, rate: float, burst: float):
+        self.rate = float(rate)
+        self.burst = float(burst)
+        self._tokens = self.burst
+        self._t = time.monotonic()
+        self._lock = threading.Lock()
+
+    def try_take(self, n: float = 1.0) -> float:
+        if self.rate <= 0:
+            return 0.0  # unlimited
+        with self._lock:
+            now = time.monotonic()
+            self._tokens = min(
+                self.burst, self._tokens + (now - self._t) * self.rate)
+            self._t = now
+            if self._tokens >= n:
+                self._tokens -= n
+                return 0.0
+            return (n - self._tokens) / self.rate
+
+    def refund(self, n: float = 1.0) -> None:
+        """Return a token taken by a request that did no work (a
+        concurrency-path shed after the bucket granted it) — without
+        the refund, rejected requests drain the bucket and the tenant's
+        ADMITTED throughput falls below its contracted rate."""
+        if self.rate <= 0:
+            return
+        with self._lock:
+            self._tokens = min(self.burst, self._tokens + n)
+
+
+class PrefixAffinity:
+    """Block-content-key -> backend map: where a prefix's KV blocks
+    last landed.  Keys come from ``paged.block_keys`` (chained hashes,
+    so ``keys[i]`` identifies the whole prefix through block ``i``);
+    the map remembers the DEEPEST key per chain it has seen per
+    backend, bounded LRU.  ``best`` walks a request's chain from the
+    deepest key down and returns the first backend still live — the
+    replica whose allocator registry (live slots, or the
+    free-list-as-cache) holds the longest prefix of this prompt."""
+
+    def __init__(self, capacity: int = 8192):
+        self.capacity = int(capacity)
+        #: key -> backend id (LRU: oldest observation evicts first)
+        self._map: "collections.OrderedDict[int, str]" = \
+            collections.OrderedDict()
+        self._lock = threading.Lock()
+        self.hits_total = 0
+        self.misses_total = 0
+
+    def observe(self, keys: list[int], backend: str) -> None:
+        """Record that ``backend`` is about to hold these prefix
+        blocks (called after routing — the replica's prefill/registry
+        will hold them by the time the next same-prefix request
+        arrives)."""
+        if not keys:
+            return
+        with self._lock:
+            for k in keys:
+                self._map.pop(k, None)
+                self._map[k] = backend
+            while len(self._map) > self.capacity:
+                self._map.popitem(last=False)
+
+    def forget(self, backend: str) -> None:
+        """Drop every key pointing at a dead/removed backend — its KV
+        is gone; routing to a corpse for affinity would trade a prefill
+        for a connection error."""
+        with self._lock:
+            stale = [k for k, b in self._map.items() if b == backend]
+            for k in stale:
+                del self._map[k]
+
+    def best(self, keys: list[int], candidates) -> tuple[Optional[str], int]:
+        """(backend, matched block depth) for the deepest key any live
+        candidate holds; (None, 0) on a miss.  Deepest-first: a chain
+        match at depth i implies every shallower block matches too."""
+        cand = set(candidates)
+        with self._lock:
+            for depth in range(len(keys), 0, -1):
+                b = self._map.get(keys[depth - 1])
+                if b is not None and b in cand:
+                    self.hits_total += 1
+                    return b, depth
+        self.misses_total += 1
+        return None, 0
+
+
+def prom_label(value) -> str:
+    """Escape a Prometheus label VALUE (backslash, quote, newline per
+    the text exposition format) — class names and model names are
+    arbitrary operator strings, and one stray quote must not poison an
+    entire /metrics scrape."""
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def prom_stat_lines(stats: dict, prefix: str,
+                    base_labels: str = "") -> dict[str, list[str]]:
+    """Render a plane's ``stats()`` into Prometheus families: scalar
+    gauges as ``<prefix><key>``, per-class counters with the class as
+    an ADDED label.  The ONE renderer — the Router and ModelServer
+    exporters must emit byte-compatible lines, so neither carries its
+    own walk."""
+    fams: dict[str, list[str]] = {}
+    for k, v in stats.items():
+        if isinstance(v, (int, float)):
+            fam = f"{prefix}{k}"
+            lbl = f"{{{base_labels}}}" if base_labels else ""
+            fams.setdefault(fam, []).append(f"{fam}{lbl} {v}")
+    for cname, cvals in stats.get("classes", {}).items():
+        cl = f'class="{prom_label(cname)}"'
+        lbl = f"{{{base_labels},{cl}}}" if base_labels else f"{{{cl}}}"
+        for k, v in cvals.items():
+            fam = f"{prefix}{k}"
+            fams.setdefault(fam, []).append(f"{fam}{lbl} {v}")
+    return fams
+
+
+def bound_priority(payload: dict, ticket=None,
+                   header: Optional[str] = None,
+                   classed: bool = False) -> None:
+    """Apply the no-self-promotion rule to ``payload['priority']`` in
+    place — the ONE enforcement site (the ModelServer door calls it
+    with whatever contract it has).  The authoritative tier is the
+    ticket's CLASS when this plane classified the tenant, else the
+    router's ``X-KFT-Priority`` cluster classification.  When the
+    door defines classes (``classed``) but could not classify THIS
+    tenant, the cap is "normal" — an anonymous caller must not
+    outrank the classed tenants the config exists to order.  A client
+    may self-demote below its tier, never outrank it.  Only with no
+    ordering contract at all (no class anywhere, no header, or a
+    class-free affinity/token-only plane) does the payload stand."""
+    auth: Optional[int] = None
+    if ticket is not None and ticket.cls is not None:
+        auth = ticket.priority
+    elif header:
+        try:
+            auth = priority_tier(header)
+        except ValueError:
+            auth = None
+    if auth is None and ticket is not None and classed:
+        auth = PRIORITY_TIERS["normal"]  # classless-at-a-QoS-door cap
+    if auth is None:
+        return
+    asked = payload.get("priority")
+    if asked is not None:
+        try:
+            auth = max(auth, priority_tier(asked))
+        except ValueError:
+            pass
+    payload["priority"] = auth
+
+
+def shed_http(handler, ticket) -> None:
+    """Write the explicit-overload 429 to an http.server handler: a
+    ``Retry-After`` header (integer seconds, RFC 7231) + a structured
+    reason body.  The ONE shed responder — the Router door and the
+    ModelServer door must stay byte-compatible, so neither carries its
+    own copy."""
+    import json
+    import math
+
+    body = json.dumps({
+        "error": "request shed by QoS admission",
+        "reason": ticket.reason,
+        "qos_class": ticket.cls.name if ticket.cls else "",
+        "retry_after": round(ticket.retry_after, 3),
+    }).encode()
+    handler.send_response(429)
+    handler.send_header("Content-Type", "application/json")
+    handler.send_header("Retry-After",
+                        str(max(1, math.ceil(ticket.retry_after))))
+    handler.send_header("Content-Length", str(len(body)))
+    handler.end_headers()
+    handler.wfile.write(body)
+
+
+class _Ticket:
+    """One admitted request's pass through the front door."""
+
+    __slots__ = ("ok", "cls", "tenant", "retry_after", "reason")
+
+    def __init__(self, ok: bool, cls: Optional[QosClass], tenant: str,
+                 retry_after: float = 0.0, reason: str = ""):
+        self.ok = ok
+        self.cls = cls
+        self.tenant = tenant
+        self.retry_after = retry_after
+        self.reason = reason
+
+    @property
+    def priority(self) -> int:
+        return self.cls.priority if self.cls is not None else 1
+
+    @property
+    def priority_name(self) -> str:
+        return _TIER_NAMES[self.priority]
+
+
+class _ClassState:
+    """Live accounting for one QoS class (plane-lock-protected)."""
+
+    def __init__(self, cls: QosClass):
+        self.cls = cls
+        self.bucket = TokenBucket(cls.rate, cls.burst)
+        self.live = 0
+        #: FIFO of waiter tokens — admission order for queued
+        #: requests; its head owns the next freed slot
+        self.queue: "collections.deque" = collections.deque()
+        self.cond: Optional[threading.Condition] = None  # set by plane
+        self.admitted_total = 0
+        self.shed_total = 0
+        self.queued_total = 0
+
+    @property
+    def waiting(self) -> int:
+        return len(self.queue)
+
+
+class TrafficPlane:
+    """Per-tenant QoS admission + prefix-affinity routing state.
+
+    One instance fronts either the cluster Router (HTTP door: sheds
+    with 429 + ``Retry-After`` before a request ever reaches a
+    replica) or one ModelServer (in-process door: concurrency slots +
+    the engine preemptor).  All state is host-side under one lock;
+    ``acquire`` may BLOCK (bounded, timed) when the class queues — that
+    blocking is the SSE path's backpressure, and it happens on the
+    caller's HTTP thread, never a scheduler thread.
+
+    ``classes``: name -> :class:`QosClass`; ``tenants``: tenant id ->
+    class name (a tenant with no mapping and no class of its own name
+    falls to ``default_class``, or rides unlimited when that class is
+    not defined).
+    """
+
+    def __init__(self, qos: Optional[dict] = None,
+                 tenants: Optional[dict[str, str]] = None,
+                 default_class: str = "default",
+                 affinity_block: int = 32,
+                 affinity_capacity: int = 8192,
+                 tenant_tokens: Optional[dict[str, str]] = None):
+        classes = validate_qos(qos or {})
+        self._lock = threading.Lock()
+        self._classes: dict[str, _ClassState] = {}
+        for name, cls in classes.items():
+            st = _ClassState(cls)
+            st.cond = threading.Condition(self._lock)
+            self._classes[name] = st
+        self._tenants = {}
+        for k, v in (tenants or {}).items():
+            if not isinstance(v, str):
+                # class_for would .get() an unhashable/mistyped value
+                # at REQUEST time — fail construction instead (the
+                # conf-freeze/Failed-status paths catch ValueError)
+                raise ValueError(
+                    f"qos tenants[{k!r}] must name a class (string), "
+                    f"got {type(v).__name__}")
+            self._tenants[str(k)] = v
+        #: tenant -> bearer secret (Profile.spec.api_token): a tenant
+        #: with a registered token must PROVE its claim at the door —
+        #: QoS classes are identity-scoped, and an unauthenticated
+        #: claim would let any client adopt a privileged tenant's rate
+        #: and priority.  Tenants without a token stay open (the
+        #: hand-wired/test deployments that never minted credentials).
+        self._tenant_tokens = {
+            k: v for k, v in (tenant_tokens or {}).items() if v}
+        self.default_class = default_class
+        #: prompt-prefix affinity granularity, in TOKENS of the byte
+        #: tokenizer / block-economy quanta (block_keys units)
+        self.affinity_block = int(affinity_block)
+        self.affinity = PrefixAffinity(affinity_capacity)
+        self.preemptors: list[EnginePreemptor] = []
+
+    # -- class resolution --------------------------------------------------
+
+    def class_for(self, tenant: str) -> Optional[_ClassState]:
+        name = self._tenants.get(tenant, tenant)
+        st = self._classes.get(name)
+        if st is None:
+            st = self._classes.get(self.default_class)
+        return st
+
+    def classes(self) -> dict[str, QosClass]:
+        return {n: st.cls for n, st in self._classes.items()}
+
+    def authenticate(self, tenant: str, authorization) -> bool:
+        """True when ``tenant``'s claim is acceptable: either no token
+        is registered for it (open tenant), or the ``Authorization``
+        header carries the matching Bearer secret (constant-time
+        compare)."""
+        import hmac
+
+        want = self._tenant_tokens.get(tenant)
+        if not want:
+            return True
+        got = str(authorization or "")
+        if got.startswith("Bearer "):
+            got = got[len("Bearer "):]
+        return hmac.compare_digest(got, want)
+
+    # -- admission (the front door) ---------------------------------------
+
+    def acquire(self, tenant: str = "default", *, charge_rate: bool = True,
+                wait_timeout: float = 30.0) -> _Ticket:
+        """Admit one request for ``tenant``; the caller MUST
+        :meth:`release` the returned ticket iff ``ticket.ok``.
+
+        Decision order mirrors the reverse of cost: the token bucket
+        sheds instantly (rate is the tenant's contract), then the
+        concurrency gate either passes, queues (bounded by the class's
+        ``queue_depth``, timed by ``wait_timeout``) or sheds.  A shed
+        ticket carries ``retry_after`` seconds and a structured
+        ``reason`` for the 429 body."""
+        st = self.class_for(tenant)
+        if st is None:
+            return _Ticket(True, None, tenant)  # no QoS configured
+        cls = st.cls
+        if charge_rate:
+            wait = st.bucket.try_take()
+            if wait > 0.0:
+                with self._lock:
+                    st.shed_total += 1
+                return _Ticket(False, cls, tenant,
+                               retry_after=max(wait, 0.05),
+                               reason="rate_limited")
+        with self._lock:
+            # the fast path defers to the queue: a fresh arrival must
+            # not snipe a freed slot from a waiter that has been
+            # blocking for it (under sustained arrivals the waiters
+            # would lose every turnover and starve to queue_timeout)
+            if cls.max_concurrent <= 0 or (
+                    st.live < cls.max_concurrent and not st.queue):
+                st.live += 1
+                st.admitted_total += 1
+                return _Ticket(True, cls, tenant)
+            if st.waiting >= cls.queue_depth:
+                st.shed_total += 1
+                if charge_rate:
+                    # the bucket granted a token but no work happened:
+                    # refund it, or concurrency sheds drain the rate
+                    # a tenant contracted for
+                    st.bucket.refund()
+                return _Ticket(False, cls, tenant,
+                               retry_after=self._slot_eta(st),
+                               reason="queue_full")
+            # bounded FIFO admission queue: wait (timed) for a slot —
+            # this blocking IS the SSE path's backpressure.  Only the
+            # HEAD waiter may take a freed slot (release notifies all:
+            # a woken non-head waiter just re-waits), so admission
+            # order is arrival order within the class.
+            me = object()
+            st.queue.append(me)
+            st.queued_total += 1
+            deadline = time.monotonic() + wait_timeout
+            try:
+                while not (st.live < cls.max_concurrent
+                           and st.queue[0] is me):
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        st.queue.remove(me)
+                        # our departure may make the new head eligible
+                        st.cond.notify_all()
+                        st.shed_total += 1
+                        if charge_rate:
+                            st.bucket.refund()
+                        return _Ticket(False, cls, tenant,
+                                       retry_after=self._slot_eta(st),
+                                       reason="queue_timeout")
+                    st.cond.wait(remaining)
+                st.queue.popleft()
+                st.live += 1
+                st.admitted_total += 1
+                return _Ticket(True, cls, tenant)
+            except BaseException:
+                if me in st.queue:
+                    st.queue.remove(me)
+                    st.cond.notify_all()
+                raise
+
+    def _slot_eta(self, st: _ClassState) -> float:
+        """Honest-ish Retry-After for a concurrency shed: with no
+        completion-rate estimate, 1s per queued-ahead requester is a
+        bounded hint, never a promise."""
+        return min(30.0, 1.0 + st.waiting)
+
+    def release(self, ticket: _Ticket) -> None:
+        if not ticket.ok or ticket.cls is None:
+            return
+        st = self._classes.get(ticket.cls.name)
+        if st is None:
+            return
+        with self._lock:
+            st.live = max(0, st.live - 1)
+            # notify_all: only the HEAD waiter may take the slot, and
+            # Condition wakes an arbitrary waiter — waking just one
+            # could wake a non-head that re-waits while the head sleeps
+            st.cond.notify_all()
+
+    # -- routing -----------------------------------------------------------
+
+    def prefix_keys(self, tokens) -> list[int]:
+        """Prompt tokens (byte-token ids at the router, engine token
+        ids at a replica) -> chained block-content keys."""
+        from .paged import block_keys
+
+        return block_keys(tokens, self.affinity_block)
+
+    def route(self, keys: list[int], backends: list[str],
+              load: Optional[Callable[[str], int]] = None
+              ) -> tuple[str, int]:
+        """(backend, affinity depth): the replica already holding the
+        deepest prefix of this request, unless it is overloaded
+        relative to its peers (> 2x the mean load + 1 — a hot shared
+        prefix must not melt one replica); otherwise least-loaded
+        (``load`` callable; index 0 on ties/no signal).  The choice is
+        recorded so the NEXT same-prefix request finds it."""
+        if not backends:
+            raise ValueError("route needs at least one backend")
+        choice, depth = self.affinity.best(keys, backends)
+        if choice is not None and load is not None and len(backends) > 1:
+            # overload check against the PEERS' mean: including the
+            # chosen backend's own load in the mean made the guard
+            # unsatisfiable at 2 replicas (L > L + other + 1)
+            others = [load(b) for b in backends if b != choice]
+            if others and load(choice) > 2 * (sum(others)
+                                              / len(others)) + 1:
+                choice, depth = None, 0  # overloaded: fall through
+        if choice is None:
+            if load is not None:
+                choice = min(backends, key=lambda b: (load(b),
+                                                      backends.index(b)))
+            else:
+                choice = backends[0]
+        self.affinity.observe(keys, choice)
+        return choice, depth
+
+    # -- preemption --------------------------------------------------------
+
+    def attach_engine(self, engine, **kw) -> "EnginePreemptor":
+        """Start a priority preemptor over ``engine`` (paged pools
+        only — eviction is only cheap because sequences are
+        exportable).  Idempotent per engine; ``kw`` tunes
+        ``preempt_after_s``/``poll_s`` on first attach."""
+        for p in self.preemptors:
+            if p.engine is engine:
+                return p
+        p = EnginePreemptor(engine, **kw)
+        self.preemptors.append(p)
+        return p
+
+    def stop(self) -> None:
+        for p in self.preemptors:
+            p.stop()
+        self.preemptors = []
+
+    # -- observability -----------------------------------------------------
+
+    def stats(self) -> dict:
+        """Scalar gauges plus a ``classes`` dict (class name -> its
+        counters).  Class names are tenant/Profile names — arbitrary
+        strings — so exporters must render them as a ``class`` LABEL,
+        never splice them into the metric name (a hyphenated tenant
+        would produce an invalid Prometheus name and poison the whole
+        exposition)."""
+        out: dict[str, Any] = {
+            "qos_affinity_hits_total": self.affinity.hits_total,
+            "qos_affinity_misses_total": self.affinity.misses_total,
+        }
+        with self._lock:
+            out["classes"] = {
+                name: {
+                    "qos_admitted_total": st.admitted_total,
+                    "qos_shed_total": st.shed_total,
+                    "qos_queued_total": st.queued_total,
+                    "qos_live": st.live,
+                    "qos_waiting": st.waiting,
+                }
+                for name, st in self._classes.items()
+            }
+        if self.preemptors:
+            out["qos_preemptions_total"] = sum(
+                p.preemptions_total for p in self.preemptors)
+            out["qos_preempt_resumes_total"] = sum(
+                p.resumes_total for p in self.preemptors)
+            out["qos_preempted_parked"] = sum(
+                p.parked() for p in self.preemptors)
+        return out
+
+
+class EnginePreemptor:
+    """Evict-and-requeue for priority inversion on a full paged pool.
+
+    A worker thread watches the engine: when a request of tier T waits
+    unadmitted past ``preempt_after_s`` while a live sequence of a
+    WORSE tier occupies the pool, the worst such victim is exported
+    (PR 7 snapshot — the parity suite's guarantee that resumed tokens
+    are bit-identical), released (slot + blocks free instantly), and
+    PARKED.  The engine's priority-sorted waiting list then admits the
+    high request first.  Parked sequences re-import — same Request
+    handle, so streams just resume — as soon as no better-tier demand
+    waits and the pool has their span again; import-side exhaustion is
+    retried, never fatal.  All of this runs on the preemptor thread:
+    export/import are the engine's own mailbox ops, so the scheduler
+    thread never blocks here (the analyzer's ``*Preemptor`` root walk
+    keeps it that way).
+    """
+
+    def __init__(self, engine, preempt_after_s: float = 0.05,
+                 poll_s: float = 0.01):
+        if not getattr(engine, "paged", False):
+            raise ValueError(
+                "priority preemption requires the paged pool "
+                "(block_size > 0) — eviction is only cheap when the "
+                "sequence is exportable")
+        self.engine = engine
+        self.preempt_after_s = float(preempt_after_s)
+        self.poll_s = float(poll_s)
+        #: parked snapshots: (tier, parked_at, req, snapshot)
+        self._parked: list[tuple[int, float, Any, dict]] = []
+        self._lock = threading.Lock()
+        self.preemptions_total = 0
+        self.resumes_total = 0
+        self._stopping = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop_preempt, name="qos-preemptor", daemon=True)
+        self._thread.start()
+
+    # -- demand / victim observation (reads scheduler-owned state the
+    # same way migrate_live_sequences does: list() copies under the GIL,
+    # decisions double-checked by the mailbox ops themselves) ----------
+
+    def _pending_best(self):
+        """(tier, req) of the best-tier submitted-but-unadmitted
+        request that has waited past the preemption threshold AND
+        whose wait eviction could actually end, else (None, None).
+
+        A request deferred by the engine's ``admission_policy`` (the
+        tier ladder's class quota, say) is blocked by POLICY, not
+        capacity: evicting a victim frees nothing it may use, and the
+        freed slot would be re-consumed by other traffic — serial
+        eviction churn of healthy streams.  The probe requires the
+        policy to be read-only host logic (TieredEngine's quota count
+        is); a raising policy skips the demand rather than evicting
+        on a guess."""
+        now = time.perf_counter()
+        policy = getattr(self.engine, "admission_policy", None)
+        best: Optional[int] = None
+        best_req = None
+        for req in list(self.engine._waiting):
+            if req.done.is_set():
+                continue
+            if now - req.submitted_at < self.preempt_after_s:
+                continue
+            if policy is not None:
+                try:
+                    if not policy(req):
+                        continue  # policy-deferred, not capacity-blocked
+                except Exception:  # noqa: BLE001 — never evict on a guess
+                    continue
+            tier = getattr(req, "priority", 1)
+            if best is None or tier < best:
+                best, best_req = tier, req
+        return best, best_req
+
+    def _capacity_blocked(self, req) -> bool:
+        """True when ``req`` genuinely cannot admit — no free slot, or
+        the block pool cannot host its worst-case span.  Without this
+        gate the preemptor would evict a victim every poll while the
+        scheduler is merely one cycle away from admitting naturally."""
+        eng = self.engine
+        if not any(r is None for r in list(eng._slots)):
+            return True
+        bs = eng.block_size
+        need = -(-(len(req.prompt) + req.max_new_tokens) // bs)
+        return eng._alloc.free_blocks < need
+
+    def _live_worst(self, better_than: int):
+        """The live victim with the WORST tier strictly greater than
+        ``better_than`` (ties: fewest generated tokens — the cheapest
+        snapshot), or None."""
+        worst = None
+        key = None
+        for req in list(self.engine._slots):
+            if req is None or req.done.is_set():
+                continue
+            tier = getattr(req, "priority", 1)
+            if tier <= better_than:
+                continue
+            k = (-tier, len(req.tokens))
+            if key is None or k < key:
+                worst, key = req, k
+        return worst
+
+    # -- the loop ----------------------------------------------------------
+
+    def _loop_preempt(self) -> None:
+        # idle backoff: a QoS-enabled but quiet deployment must not
+        # burn a 100 Hz poll per engine forever.  Doubling up to the
+        # preemption threshold adds at most ~one threshold of extra
+        # detection latency — which the demand must wait out anyway —
+        # while any action resets to the tight cadence.
+        idle_cap = max(self.poll_s, self.preempt_after_s, 0.05)
+        wait = self.poll_s
+        while not self._stopping.is_set():
+            try:
+                acted = self._step()
+            except Exception as e:  # noqa: BLE001 — a dead engine must
+                # not kill the preemptor silently; parked requests are
+                # failed by stop()/engine shutdown, new work just waits
+                log.debug("preemptor step failed: %s", e)
+                acted = False
+            if acted:
+                wait = self.poll_s
+            else:
+                busy = bool(self._parked) or bool(self.engine._waiting)
+                wait = self.poll_s if busy else min(wait * 2, idle_cap)
+                self._stopping.wait(wait)
+
+    def _step(self) -> bool:
+        demand, demand_req = self._pending_best()
+        if demand is not None and self._capacity_blocked(demand_req):
+            victim = self._live_worst(demand)
+            if victim is not None:
+                return self._preempt(victim)
+        return self._try_resume(demand)
+
+    def _preempt(self, victim) -> bool:
+        try:
+            snap = self.engine.export_sequence(victim)
+        except (RuntimeError, TimeoutError) as e:
+            log.debug("preempt export failed: %s", e)
+            try:
+                self.engine.resume_sequence(victim)
+            except (RuntimeError, TimeoutError):
+                pass
+            return False
+        if snap is None:
+            return False  # finished first — the slot is already free
+        self.engine.release_sequence(victim)
+        tier = getattr(victim, "priority", 1)
+        with self._lock:
+            self._parked.append((tier, time.perf_counter(), victim, snap))
+        self.preemptions_total += 1
+        log.debug("preempted tier-%d sequence (%d tokens generated) "
+                  "for higher-priority demand", tier, len(victim.tokens))
+        return True
+
+    def _try_resume(self, pending_tier: Optional[int]) -> bool:
+        with self._lock:
+            if not self._parked:
+                return False
+            # best tier first, then FIFO — the inverse of eviction order
+            self._parked.sort(key=lambda p: (p[0], p[1]))
+            candidates = list(self._parked)
+        for entry in candidates:
+            tier, _t, req, snap = entry
+            if req.done.is_set() or req.cancelled.is_set():
+                with self._lock:  # client gave up while parked
+                    if entry in self._parked:
+                        self._parked.remove(entry)
+                if not req.done.is_set():
+                    req.done.set()
+                continue
+            if pending_tier is not None and pending_tier <= tier:
+                return False  # better demand still waiting: stay parked
+            try:
+                self.engine.import_sequence(snap, req=req)
+            except RuntimeError:
+                return False  # pool still full: retry next poll
+            except TimeoutError:
+                return False
+            with self._lock:
+                if entry in self._parked:
+                    self._parked.remove(entry)
+            self.resumes_total += 1
+            return True
+        return False
+
+    def parked(self) -> int:
+        with self._lock:
+            return len(self._parked)
+
+    def stats(self) -> dict:
+        return {
+            "qos_preemptions_total": self.preemptions_total,
+            "qos_preempt_resumes_total": self.resumes_total,
+            "qos_preempted_parked": self.parked(),
+        }
+
+    def stop(self, fail_parked: bool = True) -> None:
+        self._stopping.set()
+        self._thread.join(timeout=5)
+        if not fail_parked:
+            return
+        with self._lock:
+            parked, self._parked = self._parked, []
+        for _tier, _t, req, _snap in parked:
+            if not req.done.is_set():
+                req.error = RuntimeError(
+                    "preempted sequence abandoned at shutdown")
+                req.done.set()

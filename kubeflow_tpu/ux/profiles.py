@@ -52,12 +52,27 @@ class ProfileController(Controller):
             return None
         assert isinstance(prof, Profile)
         usage = namespace_usage(self.store, name)
+        qos_err = ""
+        if prof.spec.qos is not None:
+            # validate the tenant's QoS contract HERE (one Failed
+            # status with the field named — the conf-freeze convention)
+            # instead of letting every ISvc front door silently skip a
+            # malformed class; lazy import keeps the control plane free
+            # of the serving stack until a profile actually uses qos
+            from ..serving.traffic import validate_qos
+
+            try:
+                validate_qos({name: prof.spec.qos})
+            except (TypeError, ValueError) as e:
+                # validate_qos promises ValueError, but a Failed status
+                # beats a crash-looping reconcile if that ever slips
+                qos_err = str(e)
 
         def mut(o):
             assert isinstance(o, Profile)
             o.status.usage = usage
-            o.status.phase = "Ready"
-            o.status.message = ""
+            o.status.phase = "Failed" if qos_err else "Ready"
+            o.status.message = qos_err
 
         try:
             self.store.update_with_retry(KIND_PROFILE, name, namespace, mut)

@@ -134,6 +134,50 @@ class BlockTableHelper:
                             ["host-sync-in-dispatch"],
                             rel="kubeflow_tpu/serving/_palloc.py") == []
 
+    def test_traffic_plane_methods_are_roots(self, tmp_path):
+        """ISSUE 9 satellite: token-bucket/queue accounting runs on
+        router/HTTP threads and the engine's admission hook — a device
+        fetch or blocking socket in a ``*TrafficPlane``/``*Admission``
+        method stalls every live request, so EVERY method is a root."""
+        code = """
+import numpy as np
+
+class QosTrafficPlane:
+    def acquire(self, tenant):
+        return self._charge(tenant)
+
+    def _charge(self, tenant):
+        return float(self._tokens.sum())
+
+class PolicyAdmission:
+    def admit(self, req, sock):
+        sock.sendall(b"ping")
+        return np.asarray(self._live)
+
+class EnginePreemptor:
+    def _step(self):
+        return self._victim.tokens.tolist()
+"""
+        found = lint_snippet(tmp_path, code, ["host-sync-in-dispatch"],
+                             rel="kubeflow_tpu/serving/_traffic.py")
+        scopes = {f.scope for f in found}
+        assert "QosTrafficPlane._charge" in scopes
+        assert "PolicyAdmission.admit" in scopes
+        assert "EnginePreemptor._step" in scopes
+        assert any("socket" in f.message for f in found)
+
+    def test_traffic_near_miss_other_class(self, tmp_path):
+        code = """
+import numpy as np
+
+class TrafficReport:
+    def render(self):
+        return np.asarray(self._rows)
+"""
+        assert lint_snippet(tmp_path, code,
+                            ["host-sync-in-dispatch"],
+                            rel="kubeflow_tpu/serving/_traffic.py") == []
+
     def test_blocking_socket_send_in_scheduler_flagged(self, tmp_path):
         """ISSUE 8 satellite: a blocking socket send reachable from an
         engine's scheduler roots stalls every live request for a
