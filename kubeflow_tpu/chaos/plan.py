@@ -51,6 +51,8 @@ class FaultKind(str, enum.Enum):
     SOCKET_DELAY = "socket_delay"    # gang control sends are delayed
     CONTROL_PLANE_CRASH = "control_plane_crash"  # kill -9 at a WAL offset
     REPLICA_KILL = "replica_kill"    # serving replica dies mid-storm
+    GANG_MEMBER_LOSS = "gang_member_loss"  # gang member dies, maybe forever
+    RESIZE_KILL = "resize_kill"      # elastic resize dies at a phase
 
 
 @dataclass
@@ -263,6 +265,79 @@ class FaultPlan:
                     out.append(f.index)
         return out
 
+    def gang_member_loss(self, world: int, at: Optional[float] = None,
+                         permanent: bool = True, min_at: float = 0.1,
+                         max_at: float = 1.0, spare_leader: bool = True,
+                         job: Optional[str] = None) -> "FaultPlan":
+        """Seeded gang-member loss (ISSUE 10).  Today's socket faults
+        are all TRANSIENT — the member reconnects and PR 1's replay
+        heals the stream.  ``permanent=True`` is the fault that
+        machinery cannot absorb: the member never comes back (a dead
+        chip), so the gang must either go fatal past the re-attach
+        grace or — with elastic resize configured — shrink to the
+        surviving degree.  The member choice and kill time are frozen
+        at plan-build time (same seed = same rank dies at the same
+        offset).  ``spare_leader`` keeps rank 0 alive: losing the
+        leader is a full gang restart, not a resize.  Pod-level runs
+        consume it through :meth:`script_fn` (a crash for effectively
+        unlimited incarnations when permanent); in-process gang tests
+        poll :meth:`due_member_losses` and sever the chosen member's
+        channel for good."""
+        if at is None:
+            at = min_at + self.rng.random() * max(max_at - min_at, 0.0)
+        lo = 1 if (spare_leader and world > 1) else 0
+        rank = self.rng.randrange(lo, world)
+        self.faults.append(Fault(
+            FaultKind.GANG_MEMBER_LOSS, index=rank, at=at, job=job,
+            times=(1_000_000 if permanent else 1)))
+        return self
+
+    def due_member_losses(self, now: Optional[float] = None) -> list[int]:
+        """Gang ranks whose seeded loss is due (each fault fires at
+        most once from this poll) — the actuator for in-process gang
+        tests, mirroring :meth:`due_replica_kills`."""
+        t = self.elapsed(now)
+        out: list[int] = []
+        with self._lock:
+            for f in self.faults:
+                if (f.kind == FaultKind.GANG_MEMBER_LOSS and not f.fired
+                        and t >= f.at):
+                    f.fired = 1
+                    out.append(f.index)
+        return out
+
+    RESIZE_PHASES = ("export", "reshard", "commit")
+
+    def kill_mid_resize(self, phases=RESIZE_PHASES,
+                        phase: Optional[str] = None,
+                        times: int = 1) -> "FaultPlan":
+        """Seeded kill at an elastic-resize phase (ISSUE 10): the
+        returned plan's :meth:`resize_failpoint` raises inside
+        ``GangResizer`` at the chosen phase offset — mid-export,
+        mid-reshard or mid-commit.  Contract under test
+        (copy-then-cutover): the old-degree gang keeps serving,
+        every client token is delivered exactly once, and neither
+        allocator leaks a block."""
+        if phase is None:
+            phase = phases[self.rng.randrange(len(phases))]
+        self.faults.append(Fault(FaultKind.RESIZE_KILL, role=str(phase),
+                                 times=times))
+        return self
+
+    def resize_failpoint(self):
+        """A ``callable(phase)`` for ``GangResizer(failpoint=...)``:
+        raises at the plan's seeded RESIZE_KILL phase, at most
+        ``times`` firings; clean pass-through otherwise."""
+        def fp(phase: str) -> None:
+            with self._lock:
+                for f in self.faults:
+                    if (f.kind == FaultKind.RESIZE_KILL
+                            and f.role == phase and f.fired < f.times):
+                        f.fired += 1
+                        raise RuntimeError(
+                            f"chaos: resize killed mid-{phase}")
+        return fp
+
     def socket_delay(self, role: str = "leader", delay: float = 0.01,
                      times: int = 1) -> "FaultPlan":
         """Add ``delay`` seconds to every send on the next ``times``
@@ -354,7 +429,8 @@ class FaultPlan:
                 continue
             if f.kind == FaultKind.BARRIER_HANG and f.index == idx:
                 return PodScript(hang=True, barrier_after=None)
-            if f.kind in (FaultKind.CRASH, FaultKind.FLAKY) and f.index == idx:
+            if f.kind in (FaultKind.CRASH, FaultKind.FLAKY,
+                          FaultKind.GANG_MEMBER_LOSS) and f.index == idx:
                 if incarnation < f.times:
                     return PodScript(run_seconds=f.at,
                                      exit_code=f.exit_code,

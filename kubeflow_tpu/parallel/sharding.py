@@ -138,6 +138,97 @@ def param_shardings(abstract_params: Any, mesh: Mesh, overrides=None) -> Any:
     return nn.logical_to_mesh_sharding(logical_spec, mesh, rules_for_mesh(mesh, overrides))
 
 
+def _spec_axes(entry: Any) -> list[str]:
+    """Mesh axes one PartitionSpec entry names (str | tuple | None)."""
+    if entry is None:
+        return []
+    if isinstance(entry, (tuple, list)):
+        return [str(a) for a in entry]
+    return [str(entry)]
+
+
+def _shard_count(sharding: Optional[NamedSharding], dim: int) -> int:
+    """How many ways ``dim`` splits under ``sharding`` (1 = replicated)."""
+    if sharding is None:
+        return 1
+    spec = tuple(sharding.spec)
+    if dim >= len(spec):
+        return 1
+    n = 1
+    for ax in _spec_axes(spec[dim]):
+        n *= int(dict(zip(sharding.mesh.axis_names,
+                          sharding.mesh.devices.shape))[ax])
+    return n
+
+
+def _spec_json(sharding: Optional[NamedSharding], ndim: int) -> list:
+    """PartitionSpec as a JSON-able per-dim list (str | [str] | None)."""
+    if sharding is None:
+        return [None] * ndim
+    spec = list(sharding.spec) + [None] * (ndim - len(tuple(sharding.spec)))
+    out: list = []
+    for e in spec[:ndim]:
+        if e is None:
+            out.append(None)
+        elif isinstance(e, (tuple, list)):
+            out.append([str(a) for a in e])
+        else:
+            out.append(str(e))
+    return out
+
+
+def reshard_plan(params: Any, src_shardings: Any, dst_shardings: Any) -> list[dict]:
+    """Per-leaf repartition plan for moving a weight PyTree between two
+    mesh layouts (the Tenplex-style degree change, ISSUE 10): each entry
+    records the leaf's path, shape, dtype and its source/destination
+    PartitionSpec as plain JSON values — the header the elastic-resize
+    wire family (serving/resize.py) frames in front of raw numpy bytes,
+    never pickle.
+
+    ``src_shardings``/``dst_shardings`` are trees of NamedSharding (or
+    None = replicated) matching ``params`` — for serving weights that is
+    ``serving.sharded.llama_param_shardings(cfg, mesh)``, i.e. the SAME
+    logical-rules table the trainer and every gang member already use.
+
+    Validates feasibility up front: a destination spec that does not
+    divide the leaf's dim (e.g. 8 heads resized onto a TP=3 mesh) raises
+    ValueError naming the leaf — a resize to an illegal degree must fail
+    at plan time, before anything is quiesced or torn down.
+    """
+    flat, _ = jax.tree_util.tree_flatten_with_path(params)
+    src_leaves = jax.tree.leaves(
+        src_shardings, is_leaf=lambda x: x is None or isinstance(
+            x, NamedSharding))
+    dst_leaves = jax.tree.leaves(
+        dst_shardings, is_leaf=lambda x: x is None or isinstance(
+            x, NamedSharding))
+    if not (len(flat) == len(src_leaves) == len(dst_leaves)):
+        raise ValueError(
+            f"reshard_plan: tree mismatch — {len(flat)} params vs "
+            f"{len(src_leaves)} src / {len(dst_leaves)} dst shardings")
+    plan: list[dict] = []
+    for (path, leaf), src, dst in zip(flat, src_leaves, dst_leaves):
+        name = "/".join(
+            str(getattr(k, "key", getattr(k, "idx", k))) for k in path)
+        shape = tuple(int(d) for d in getattr(leaf, "shape", ()))
+        for dim, size in enumerate(shape):
+            n = _shard_count(dst, dim)
+            if n > 1 and size % n:
+                raise ValueError(
+                    f"reshard_plan: leaf {name!r} dim {dim} (size {size}) "
+                    f"does not divide into {n} destination shards — the "
+                    "target degree is illegal for this model")
+        plan.append({
+            "path": name,
+            "shape": list(shape),
+            "dtype": str(jax.numpy.asarray(leaf).dtype
+                         if not hasattr(leaf, "dtype") else leaf.dtype),
+            "src": _spec_json(src, len(shape)),
+            "dst": _spec_json(dst, len(shape)),
+        })
+    return plan
+
+
 def constrain_microbatches(
     micro: jax.Array, mesh: Mesh, batch_sharding: NamedSharding
 ) -> jax.Array:

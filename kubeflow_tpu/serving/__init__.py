@@ -5,12 +5,15 @@ from .controller import InferenceServiceController, Router
 from .model import Model
 from .runtimes import EchoModel, JaxFunctionModel, LlamaGenerator
 from .server import MicroBatcher, ModelServer
+from .resize import ElasticGangSupervisor, GangResizer
 from .storage import StorageError, download, fetch_mem, register_mem
 from .traffic import QosClass, TrafficPlane, validate_qos
 from .transformer import Transformer
 
 __all__ = [
     "EchoModel",
+    "ElasticGangSupervisor",
+    "GangResizer",
     "InferenceServiceController",
     "JaxFunctionModel",
     "LlamaGenerator",

@@ -2069,6 +2069,36 @@ class ContinuousEngine:
         cover = self._rung(top)
         pad = self._alloc.pad_block
         sent = np.int32(self.num_slots)
+        # migration gather/scatter (ISSUE 8) warm FIRST: kv_import /
+        # logits_set donate and REWRITE the pool buffers, and their
+        # output sharding signature is what every later live dispatch
+        # receives as input.  Warming them after the attend ladder left
+        # the ladder's programs traced against _init_pool's signature
+        # (PartitionSpec() vs the constraint's PartitionSpec(None, ...):
+        # equivalent layouts, unequal cache keys), so a MESHED engine's
+        # first live decode re-traced — exactly the mid-serving stall
+        # class the recompile guard exists to catch (it did, ISSUE 10).
+        # Live imports feed NUMPY leaves (the wire hands us host bytes),
+        # so warmup must too — device-typed warmup args re-traced decode
+        # programs once before (the r7 sampling-key lesson).
+        grp = np.full((KV_MIGRATE_GROUP, 1), pad, np.int32)
+        leaves = jax.device_get(self._kv_export(self._pool_cache, grp))
+        zeros = tuple(np.zeros(np.shape(x), np.asarray(x).dtype)
+                      for x in leaves)
+        self._pool_cache = self._kv_import(self._pool_cache, grp, zeros)
+        row = np.asarray(jax.device_get(self._logits_take(
+            self._pool_logits, np.int32(self.num_slots))))
+        self._pool_logits = self._logits_set(
+            self._pool_logits, np.zeros_like(row), np.int32(self.num_slots))
+        # logits_set is fed BOTH arg kinds in production: numpy rows on
+        # the import path (wire bytes) and the device row stashed at
+        # freeze time on the resume path — warm both committedness
+        # combos or the first in-place resume re-traces mid-serving
+        # (the r7 lesson, third sighting)
+        dev_row = self._logits_take(self._pool_logits,
+                                    np.int32(self.num_slots))
+        self._pool_logits = self._logits_set(
+            self._pool_logits, dev_row, np.int32(self.num_slots))
         idle = (np.zeros(self.num_slots, np.int32),
                 np.zeros(self.num_slots, bool),
                 np.zeros(self.num_slots, np.float32),
@@ -2128,20 +2158,6 @@ class ContinuousEngine:
             # the COW fork dispatch (dst out of range: dropped)
             self._pool_cache = self._block_copy(
                 self._pool_cache, np.int32(0), np.int32(pad))
-        # migration gather/scatter (ISSUE 8): warm the fixed grouped
-        # shapes so an import mid-serving never compiles.  Live imports
-        # feed NUMPY leaves (the wire hands us host bytes), so warmup
-        # must too — device-typed warmup args re-traced decode programs
-        # once before (the r7 sampling-key lesson)
-        grp = np.full((KV_MIGRATE_GROUP, 1), pad, np.int32)
-        leaves = jax.device_get(self._kv_export(self._pool_cache, grp))
-        zeros = tuple(np.zeros(np.shape(x), np.asarray(x).dtype)
-                      for x in leaves)
-        self._pool_cache = self._kv_import(self._pool_cache, grp, zeros)
-        row = np.asarray(jax.device_get(self._logits_take(
-            self._pool_logits, np.int32(self.num_slots))))
-        self._pool_logits = self._logits_set(
-            self._pool_logits, np.zeros_like(row), np.int32(self.num_slots))
         if toks is not None:
             jax.block_until_ready(toks)
 
@@ -2842,7 +2858,7 @@ class ContinuousEngine:
         return snap
 
     def import_sequence(self, snapshot: dict, req: Optional[Request] = None,
-                        timeout: float = 60.0) -> Request:
+                        timeout: float = 60.0, hold: bool = False) -> Request:
         """Cutover step: install an exported sequence into this pool.
 
         Allocates the sequence's full remaining worst-case block span
@@ -2852,7 +2868,15 @@ class ContinuousEngine:
         and resumes decoding at the exact position.  ``req`` re-targets
         an existing Request (in-process handoff: the front server's
         handle keeps streaming, no client reconnect); None builds a
-        fresh one from the snapshot (cross-process import)."""
+        fresh one from the snapshot (cross-process import).
+
+        ``hold=True`` installs the sequence FROZEN (blocks scattered,
+        state recorded, but the slot stays inactive until
+        :meth:`resume_sequence`): the elastic gang resize (ISSUE 10)
+        imports every live conversation into the new-degree pool while
+        the old-degree pool still owns them — only the atomic cutover
+        flips which side decodes, so a resize that dies mid-commit can
+        discard the held copies with zero duplicated tokens."""
         if not self.paged:
             raise RuntimeError(
                 "KV migration requires the paged pool (block_size > 0)")
@@ -2860,8 +2884,43 @@ class ContinuousEngine:
             raise ValueError(
                 "snapshot is None — the sequence had already finished "
                 "on the source (export_sequence returned None)")
-        out = self._post_migration_op("import", snapshot, req, timeout)
+        out = self._post_migration_op("import", snapshot, (req, hold),
+                                      timeout)
         return out["req"]
+
+    def take_waiting(self, timeout: float = 60.0) -> list:
+        """Atomically withdraw every queued-but-unadmitted request (the
+        resize cutover hands them to the new-degree engine, ISSUE 10).
+        Runs on the scheduler thread like every state-mutating
+        migration op — the waiting list is scheduler-owned."""
+        return self._post_migration_op("take_waiting", None, None,
+                                       timeout)["reqs"]
+
+    def quiesced_live_requests(self, timeout: float = 60.0) -> list:
+        """Scheduler-thread snapshot of every admitted, unfinished
+        request (the resize export set).  Taken through the migration
+        mailbox so it lands AFTER any in-flight admission cycle: a
+        request racing the quiesce policy swap must end up in the
+        export set, not stranded in a slot the cutover's stop() then
+        fails (the mailbox services at the loop top, after the racing
+        cycle's slot assignments are visible and before any cycle that
+        already observes the deferred policy admits)."""
+        return self._post_migration_op("live_slots", None, None,
+                                       timeout)["reqs"]
+
+    def adopt_request(self, req: Request) -> None:
+        """Enqueue an EXISTING Request handle (resize cutover: waiting
+        requests follow the pool to the new-degree engine with their
+        handles — and any tokens already streamed — intact)."""
+        with self._gate:
+            if self._error is not None:
+                raise RuntimeError(
+                    f"engine failed: {self._error!r}") from self._error
+            if self._stop.is_set():
+                raise RuntimeError("engine is shutting down")
+            self._queue.put(req)
+            self._ensure_running()
+        self._wake.set()
 
     def resume_sequence(self, req: Request, timeout: float = 60.0) -> None:
         """Abort a migration: un-freeze the exported slot so the source
@@ -2940,9 +2999,15 @@ class ContinuousEngine:
                 if kind == "export":
                     self._mig_export(a, out, pending)
                 elif kind == "import":
-                    self._mig_import(a, b, out)
+                    self._mig_import(a, b[0], out, hold=b[1])
                 elif kind == "resume":
                     self._mig_resume(a)
+                elif kind == "take_waiting":
+                    self._mig_take_waiting(out)
+                elif kind == "live_slots":
+                    out["reqs"] = [r for r in self._slots
+                                   if r is not None
+                                   and not r.done.is_set()]
                 else:
                     self._mig_release(a)
             except Exception as e:  # noqa: BLE001 — resolve THIS waiter;
@@ -2982,28 +3047,44 @@ class ContinuousEngine:
         if slot is None or req.done.is_set():
             out["snap"] = None  # finished/cancelled: nothing to migrate
             return
-        entry = None
         if slot in self._migrating:
-            entry = self._migrating[slot].get("entry")
+            rec = self._migrating[slot]
+            entry = rec.get("entry")
         else:
             # a partially-prefilled sequence exports at its chunk
             # boundary: pull its admission entry so no further chunk
             # dispatches advance it while the transfer runs
+            entry = None
             for e in self._prefilling:
                 if e[0] is req:
                     entry = e
                     break
+            rec = {"req": req, "entry": entry}
             if entry is not None:
                 self._prefilling.remove(entry)
                 self._prefill_tokens_inflight -= len(entry[2]) - entry[3]
             else:
                 self._active[slot] = False
-            self._migrating[slot] = {"req": req, "entry": entry}
-        out["snap"] = self._snapshot_slot(slot, req, entry)
+                # freeze-time logits stash: the pool decode/verify scans
+                # recompute EVERY row's logits — active or not — so a
+                # frozen slot's live row DRIFTS while other slots keep
+                # decoding.  The snapshot and any later resume must read
+                # this frozen copy, never the clobbered live row (found
+                # by the ISSUE 10 resize parity suite: a resumed
+                # sequence's next token sampled from another dispatch's
+                # garbage).
+                rec["logits"] = self._logits_take(self._pool_logits,
+                                                  np.int32(slot))
+            self._migrating[slot] = rec
+        out["snap"] = self._snapshot_slot(slot, req, entry, rec)
 
-    def _snapshot_slot(self, slot: int, req: Request, entry) -> dict:
+    def _snapshot_slot(self, slot: int, req: Request, entry,
+                       rec=None) -> dict:
         """Device-side snapshot (scheduler thread): block gathers are
-        DISPATCHED here, fetched by the caller off-thread."""
+        DISPATCHED here, fetched by the caller off-thread.  ``rec`` is
+        the slot's freeze record — a decode-phase snapshot reads its
+        stashed logits row (taken at freeze time), because the live row
+        is rewritten by every later pool dispatch."""
         bs = self.block_size
         if entry is not None:
             phase = "prefill"
@@ -3023,8 +3104,10 @@ class ContinuousEngine:
             content = list(self._slot_content[slot])
             prompt = content[: max(position - len(generated), 0)]
             remaining = int(self._remaining[slot])
-            logits_dev = self._logits_take(self._pool_logits,
-                                           np.int32(slot))
+            logits_dev = (rec or {}).get("logits")
+            if logits_dev is None:
+                logits_dev = self._logits_take(self._pool_logits,
+                                               np.int32(slot))
             temp = float(self._temps[slot])
             top_p = float(self._top_ps[slot])
             top_k = int(self._top_ks[slot])
@@ -3051,8 +3134,21 @@ class ContinuousEngine:
             "blocks_dev": blocks_dev, "logits_dev": logits_dev,
         }
 
+    def _mig_take_waiting(self, out: dict) -> None:
+        """Withdraw the waiting list + intake queue (resize cutover)."""
+        reqs = [r for r in self._waiting if not r.done.is_set()]
+        self._waiting.clear()
+        while True:
+            try:
+                r = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if not r.done.is_set():
+                reqs.append(r)
+        out["reqs"] = reqs
+
     def _mig_import(self, snap: dict, req: Optional[Request],
-                    out: dict) -> None:
+                    out: dict, hold: bool = False) -> None:
         bs = int(snap["block_size"])
         if bs != self.block_size:
             raise ValueError(
@@ -3120,8 +3216,16 @@ class ContinuousEngine:
                 self._slot_content[slot] = prompt[:position]
                 self._slot_owner[slot] = None
                 self._active[slot] = False
-                self._prefilling.append([req, slot, prompt, position])
-                self._prefill_tokens_inflight += len(prompt) - position
+                entry = [req, slot, prompt, position]
+                if hold:
+                    # installed FROZEN (resize commit): the admission
+                    # entry waits in the freeze record exactly as a
+                    # mid-prefill export's does — resume_sequence
+                    # re-queues it at the head
+                    self._migrating[slot] = {"req": req, "entry": entry}
+                else:
+                    self._prefilling.append(entry)
+                    self._prefill_tokens_inflight += len(prompt) - position
             else:
                 # analysis: ok host-sync-in-dispatch — wire bytes are host numpy
                 row = np.asarray(snap["logits"])
@@ -3138,7 +3242,15 @@ class ContinuousEngine:
                 self._spec_ban[slot] = int(snap.get("spec_ban", -1))
                 self._spec_backoff[slot] = 0
                 self._spec_cool[slot] = 0
-                self._active[slot] = not req.done.is_set()
+                if hold:
+                    self._active[slot] = False
+                    # stash the imported row for the resume reinstall:
+                    # earlier-resumed slots' dispatches rewrite every
+                    # live logits row, held ones included
+                    self._migrating[slot] = {"req": req, "entry": None,
+                                             "logits": row}
+                else:
+                    self._active[slot] = not req.done.is_set()
             self.kv_migrations_total += 1
             self.kv_migrate_bytes_total += nbytes
             out["req"] = req
@@ -3173,6 +3285,12 @@ class ContinuousEngine:
             self._prefilling.appendleft(e)
             self._prefill_tokens_inflight += len(e[2]) - e[3]
         else:
+            if rec.get("logits") is not None:
+                # reinstall the freeze-time logits row: the live row was
+                # rewritten by every pool dispatch that ran while this
+                # slot was frozen — sampling from it would emit garbage
+                self._pool_logits = self._logits_set(
+                    self._pool_logits, rec["logits"], np.int32(slot))
             self._active[slot] = True
 
     def _mig_release(self, req: Request) -> None:

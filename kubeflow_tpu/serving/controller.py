@@ -110,6 +110,9 @@ class _GangPredictor:
         self.store = store
         self.namespace = isvc.metadata.namespace
         self.job_name = f"{isvc.metadata.name}-gang-r{rev}-g{ordinal}"
+        #: the GangSpec this handle placed — the elastic shrink path
+        #: (ISSUE 10) reads it to compute the surviving shape
+        self.gang = gang
         self.port = allocate_port()
         self.metrics = _GangMetrics(f"http://127.0.0.1:{self.port}")
         self._ready_at: float = 0.0
@@ -670,6 +673,11 @@ class _Deployment:
         #: Profile qos): the plane rebuilds only when this changes, so
         #: counters and affinity state survive the 4 Hz reconcile
         self.traffic_fp: Optional[str] = None
+        #: Degraded-deadline tracking (ISSUE 10): when the deployment
+        #: entered Degraded, and whether this episode already escalated
+        #: (one DegradedTimeout + shrink per episode, not per 4 Hz tick)
+        self.degraded_since: Optional[float] = None
+        self.degraded_escalated = False
 
     @property
     def revisions(self) -> list[_Revision]:
@@ -790,6 +798,47 @@ class InferenceServiceController(Controller):
             raise ValueError(
                 f"invalid engine knobs: affinity_block {ab} (must be "
                 ">= 1)")
+        # elastic resize knobs (ISSUE 10) freeze here too — the PR 4/7/8
+        # convention: a mistyped min_degree is ONE Failed status, not N
+        # crash-looping gang pods (or a supervisor exploding at runtime).
+        # The STANDALONE degraded_deadline_s fallback validates as well:
+        # _track_degraded float()s it on every 4 Hz pass
+        sddl = cfg.get("degraded_deadline_s")
+        if sddl is not None:
+            try:
+                ok = float(sddl) > 0
+            except (TypeError, ValueError):
+                ok = False
+            if not ok:
+                raise ValueError(
+                    f"invalid engine knobs: degraded_deadline_s "
+                    f"{sddl!r} (must be a positive number)")
+        elastic = cfg.get("elastic")
+        if elastic is not None:
+            if not isinstance(elastic, dict):
+                raise ValueError(
+                    "invalid engine knobs: elastic must be "
+                    '{"min_degree": n, "resize_deadline_s": s, '
+                    '"degraded_deadline_s": s}')
+            unknown = set(elastic) - {"min_degree", "resize_deadline_s",
+                                      "degraded_deadline_s"}
+            if unknown:
+                raise ValueError(
+                    f"invalid engine knobs: elastic keys {sorted(unknown)}")
+            if int(elastic.get("min_degree", 1)) < 1:
+                raise ValueError(
+                    "invalid engine knobs: elastic.min_degree "
+                    f"{elastic['min_degree']} (must be >= 1)")
+            for k in ("resize_deadline_s", "degraded_deadline_s"):
+                if k in elastic and float(elastic[k]) <= 0:
+                    raise ValueError(
+                        f"invalid engine knobs: elastic.{k} "
+                        f"{elastic[k]} (must be > 0)")
+            if int(cfg.get("block_size", 0) or 0) <= 0:
+                raise ValueError(
+                    "invalid engine knobs: elastic requires the paged "
+                    "pool (block_size > 0) — the resize snapshot unit "
+                    "is the KV block")
         dep.rev_counter += 1
         return _Revision(
             dep.rev_counter, fingerprint, isvc.spec.model_copy(deep=True),
@@ -879,6 +928,7 @@ class InferenceServiceController(Controller):
             1 for r in dep.revisions for s in r.predictors
             if getattr(s, "ready", True))
         degraded = ready and ready_preds < total_preds
+        self._track_degraded(isvc, dep, degraded)
         if degraded:
             phase = InferenceServicePhase.DEGRADED
         elif ready:
@@ -902,6 +952,121 @@ class InferenceServiceController(Controller):
         )
         # periodic requeue drives the autoscaler loop
         return Result(requeue_after=0.25)
+
+    # -- degraded deadline / elastic escalation (ISSUE 10) ----------------
+
+    def _track_degraded(self, isvc, dep: _Deployment,
+                        degraded: bool) -> None:
+        """Bound the Degraded phase.  Degraded used to be UNBOUNDED — a
+        gang that lost a member permanently parked there forever,
+        waiting for a re-form a dead chip can never grant.  With
+        ``degraded_deadline_s`` configured (standalone or inside the
+        ``elastic`` family), a deployment stuck Degraded past the
+        deadline emits a structured ``DegradedTimeout`` event; with
+        ``elastic`` configured, it additionally escalates into the
+        shrink path — re-placing the degraded gang at the surviving
+        degree (floored at ``elastic.min_degree``) and emitting
+        ``GangResized`` instead of waiting forever."""
+        if dep.stable is None:
+            return
+        if not degraded:
+            dep.degraded_since = None
+            dep.degraded_escalated = False
+            return
+        now = time.monotonic()
+        if dep.degraded_since is None:
+            dep.degraded_since = now
+            return
+        cfg = dep.stable.cfg
+        elastic = cfg.get("elastic") or {}
+        ddl = elastic.get("degraded_deadline_s",
+                          cfg.get("degraded_deadline_s"))
+        if ddl is None or dep.degraded_escalated:
+            return
+        try:
+            ddl = float(ddl)
+        except (TypeError, ValueError):
+            return  # conf-freeze rejects this; a hand-rolled config
+            # must not turn every 4 Hz reconcile into a raise
+        waited = now - dep.degraded_since
+        if waited <= ddl:
+            return
+        dep.degraded_escalated = True
+        self.emit_event(
+            isvc, "DegradedTimeout",
+            f"degraded for {waited:.1f}s (deadline {ddl:.1f}s)",
+            type_="Warning")
+        if elastic:
+            self._escalate_shrink(isvc, dep, elastic)
+
+    def _escalate_shrink(self, isvc, dep: _Deployment,
+                         elastic: dict) -> None:
+        """Shrink-to-survive at the placement layer: a gang stuck
+        Degraded past the deadline is re-placed with one fewer host and
+        its TP degree scaled to the surviving shape.  (The in-gang
+        weight/KV repartition path — serving/resize.py — handles the
+        live-conversation case inside serve_main; this controller path
+        is the escalate-or-give-up policy when the gang's own
+        supervisor could not, e.g. a member lost before the gang ever
+        formed.)"""
+        from .resize import degree_of
+
+        min_degree = int(elastic.get("min_degree", 1))
+        for rev in dep.revisions:
+            for i, handle in enumerate(list(rev.predictors)):
+                gang = getattr(handle, "gang", None)
+                if gang is None or getattr(handle, "ready", False):
+                    continue
+                hosts = int(gang.hosts)
+                if hosts <= 1:
+                    continue
+                degree = degree_of(gang.mesh_axes)
+                new_hosts = hosts - 1
+                # compute the ACTUAL surviving mesh first and gate on
+                # its product — gating on degree*new_hosts//hosts could
+                # pass a min_degree the placed mesh then violates (the
+                # scaling only touches one axis).  Scale the largest
+                # axis (TP rides "model" by convention); an uneven
+                # split means no clean surviving shape — skip rather
+                # than place a mesh whose pods crash-loop (model-dim
+                # feasibility itself surfaces at gang start, bounded by
+                # backoff_limit; the in-gang resize path checks it at
+                # plan time).
+                axes = dict(gang.mesh_axes or {})
+                if axes:
+                    key = max(axes, key=lambda k: axes[k])
+                    if (axes[key] * new_hosts) % hosts:
+                        self.emit_event(
+                            isvc, "ResizeSkipped",
+                            f"mesh axis {key}={axes[key]} does not "
+                            f"scale evenly to {new_hosts}/{hosts} "
+                            "hosts; keeping the degraded gang",
+                            type_="Warning")
+                        continue
+                    axes[key] = max(1, axes[key] * new_hosts // hosts)
+                new_degree = degree_of(axes)
+                if new_degree < min_degree:
+                    self.emit_event(
+                        isvc, "ResizeSkipped",
+                        f"surviving degree {new_degree} < min_degree "
+                        f"{min_degree}; keeping {hosts}-host gang",
+                        type_="Warning")
+                    continue
+                new_gang = gang.model_copy(
+                    update={"hosts": new_hosts, "mesh_axes": axes})
+                handle.stop()
+                rev.gang_counter += 1
+                replacement = _GangPredictor(
+                    self.store, isvc, rev.rev, new_gang, rev.cfg,
+                    ordinal=rev.gang_counter - 1)
+                rev.predictors[i] = replacement
+                self.emit_event(
+                    isvc, "GangResized",
+                    f"degraded gang re-placed at the surviving shape: "
+                    f"{hosts} hosts / TP {degree} -> {new_hosts} hosts "
+                    f"/ TP {new_degree}")
+                self._wire(isvc, dep)
+                return
 
     # -- scaling ----------------------------------------------------------
 

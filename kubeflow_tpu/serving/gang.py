@@ -151,6 +151,9 @@ class GangChannel:
         self._log: "deque[tuple[int, bytes]]" = deque(maxlen=max(replay_log, 1))
         self._seq = 0
         self._dead: Optional[Exception] = None
+        #: ranks admitted with no shared history (elastic fresh joins) —
+        #: the supervisor drains this and rebuilds them via a resize
+        self._fresh_joins: set[int] = set()
         # follower state
         self._sock: Optional[Any] = None
         self._addr: Optional[tuple[str, int]] = None
@@ -195,8 +198,16 @@ class GangChannel:
 
     @classmethod
     def connect(cls, host: str, port: int, rank: int, token: str = "",
-                timeout: float = 60.0, **kw) -> "GangChannel":
+                timeout: float = 60.0, fresh: bool = False,
+                **kw) -> "GangChannel":
+        """``fresh=True`` marks an ELASTIC join (ISSUE 10): this member
+        has no shared dispatch history, so it asks for no replay
+        (last_seq = -1) and starts at the stream's current position —
+        the grow-back resize rebuilds its pool state from scratch, so
+        the missed frames are genuinely irrelevant, not a gap."""
         ch = cls(rank, token=token, **kw)
+        if fresh:
+            ch.last_seq = -1
         ch._addr = (host, port)
         ch._dial(timeout)
         threading.Thread(
@@ -237,6 +248,11 @@ class GangChannel:
                     raise ChannelClosed("bad gang token")
                 rank = int(hello.get("rank", -1))
                 last_seq = int(hello.get("last_seq", 0))
+                # _want == 0 means UNCAPPED (the PR 1 contract: quota
+                # is enforced by token + rank-slot replacement, not a
+                # bound) — under elastic resize that is also the
+                # designed behavior: a shrunk-away member that returns
+                # SHOULD be admitted and trigger the grow-back
                 if rank < 1 or (self._want and rank > self._want):
                     raise ChannelClosed(f"rank {rank} out of range")
                 # bounded sends from here on: a wedged-but-alive follower
@@ -259,7 +275,12 @@ class GangChannel:
         """Install (or re-install) a follower connection after a valid
         handshake, replaying exactly the frames it missed."""
         with self._lock:
-            if last_seq < self._seq:
+            if last_seq < 0:
+                # fresh elastic member (ISSUE 10): no shared history to
+                # replay — it enters at the stream's current position
+                # and the grow-back resize rebuilds its state
+                self._fresh_joins.add(rank)
+            elif last_seq < self._seq:
                 oldest = self._log[0][0] if self._log else self._seq + 1
                 if last_seq + 1 < oldest:
                     # the gap rolled off the replay log: this follower can
@@ -363,6 +384,63 @@ class GangChannel:
         """Evicted followers awaiting re-attach (leader side)."""
         with self._lock:
             return sorted(self._lost)
+
+    def lost_since(self) -> dict[int, float]:
+        """Evicted rank -> monotonic eviction time (leader side): the
+        elastic supervisor's escalation input (ISSUE 10)."""
+        with self._lock:
+            return dict(self._lost)
+
+    def follower_ranks(self) -> list[int]:
+        """Currently connected follower ranks (leader side)."""
+        with self._lock:
+            return sorted(self._followers)
+
+    def set_want(self, n: int) -> None:
+        """Adjust the handshake ADMISSION CAP (the max rank a hello may
+        carry): an elastic grow raises it so new ranks can join; 0
+        removes the cap entirely (the PR 1 contract — admission is then
+        guarded by the token alone, and a returning member can always
+        rejoin and grow the gang back).  It is a bound, not a member
+        count — a shrink must NOT lower it below surviving ranks, or
+        they would be refused at their next reconnect (rank ids are
+        stable)."""
+        with self._lock:
+            self._want = max(int(n), 0)
+
+    def forget_rank(self, rank: int) -> None:
+        """Drop an evicted rank from the re-attach ledger (the elastic
+        shrink path, ISSUE 10): its absence becomes a PLANNED degree
+        change instead of a ticking fatality — the hb loop stops
+        counting it toward ``reattach_timeout``."""
+        with self._lock:
+            self._lost.pop(rank, None)
+
+    def touch_lost(self, ranks) -> None:
+        """Restart the re-attach fatality clock for evicted ranks: the
+        elastic supervisor touches them when it COMMITS to a shrink, so
+        a rebuild that outlives the remaining grace (weight reshard +
+        new-degree warmup) cannot kill the gang mid-resize.  The
+        supervisor bounds its touches (max attempts), so the
+        JaxJob-restart backstop stays reachable when resizes keep
+        failing."""
+        with self._lock:
+            now = time.monotonic()
+            for r in ranks:
+                if r in self._lost:
+                    self._lost[r] = now
+
+    def take_fresh_joins(self) -> list[int]:
+        """Drain the fresh-join ledger (leader side): ranks admitted
+        with no shared dispatch history since the last call.  A fresh
+        member SKIPS ops until a resize rebuilds its pool, so the
+        elastic supervisor must answer every entry here with a resize —
+        even a same-degree one (resync-by-rebuild for a member that
+        died and returned inside the resize deadline)."""
+        with self._lock:
+            out = sorted(self._fresh_joins)
+            self._fresh_joins.clear()
+            return out
 
     # -- follower: dial / reconnect / ack ----------------------------------
 
@@ -862,6 +940,10 @@ class GangEngine(contlib.ContinuousEngine):
         if not kw.get("mesh_axes"):
             raise ValueError("a serving gang needs mesh_axes")
         self._channel = channel
+        #: an elastic resize (serving/resize.py) replaces this engine
+        #: but keeps the channel + follower processes alive for the
+        #: successor — the resizer flips this before stop()
+        self.keep_channel_open = False
         super().__init__(cfg, params, **kw)
 
     def _fatal(self, e: Exception) -> Exception:
@@ -1379,6 +1461,8 @@ class GangEngine(contlib.ContinuousEngine):
 
     def stop(self) -> None:
         super().stop()
+        if self.keep_channel_open:
+            return
         try:
             self._channel.publish(("stop",))
         except ChannelClosed:
@@ -1386,7 +1470,51 @@ class GangEngine(contlib.ContinuousEngine):
         self._channel.close()
 
 
-def follow(engine: contlib.ContinuousEngine, channel: GangChannel) -> None:
+def _follower_resize(engine, channel: GangChannel, conf: dict):
+    """Rebuild this follower's engine at a new TP degree (ISSUE 10):
+    fetch the repartitioned weights over the ``reshard`` wire family
+    (length-framed JSON headers + raw numpy bytes, never pickle — the
+    kv_migrate trust shape), build the new-degree engine only at commit,
+    ack on the same connection, and hand the engine back to
+    :func:`follow`.  A failed rebuild acks the failure and KEEPS the old
+    engine — the leader aborts the resize (``resize_abort``) and the
+    old-degree stream continues; copy-then-cutover means nothing was
+    lost."""
+    from .resize import ReshardClient, unflatten_params
+
+    rs = dict(conf.get("reshard") or {})
+    client = None
+    try:
+        client = ReshardClient(
+            rs.get("host", "127.0.0.1"), int(rs["port"]),
+            token=str(rs.get("token", "")), rank=channel.rank,
+            sock_wrap=channel._sock_wrap)
+        _plan, leaves = client.receive()
+        params = unflatten_params(leaves)
+        kw = dict(conf.get("kwargs") or {})
+        # allocation only at commit: the new-degree pool buffers exist
+        # only once every leaf arrived intact
+        new = contlib.ContinuousEngine(
+            engine.cfg, params, mesh_axes=conf.get("mesh_axes"), **kw)
+        client.ack(True)
+        return new
+    except Exception as e:  # noqa: BLE001 — a follower that cannot
+        # rebuild must answer, not die: the leader aborts the resize on
+        # the failed ack and the old-degree gang keeps serving
+        log.warning("follower resize failed: %s", e)
+        if client is not None:
+            try:
+                client.ack(False, f"{type(e).__name__}: {e}")
+            except (OSError, ChannelClosed):
+                pass
+        return engine
+    finally:
+        if client is not None:
+            client.close()
+
+
+def follow(engine: contlib.ContinuousEngine, channel: GangChannel,
+           fresh: bool = False, on_engine=None) -> None:
     """Follower executor: replay rank 0's dispatch stream.
 
     ``engine`` is a plain ContinuousEngine constructed from the same
@@ -1399,11 +1527,50 @@ def follow(engine: contlib.ContinuousEngine, channel: GangChannel) -> None:
     params = engine.params
     row: Optional[tuple] = None
     seg_row = None
+    #: elastic resize (ISSUE 10): the previous-degree engine is kept
+    #: until the next op proves the cutover happened — a published
+    #: ``resize_abort`` rolls back to it.  A ``fresh`` joiner (grow-back
+    #: member with no shared history) SKIPS every op until its first
+    #: resize rebuilds real state — replaying mid-stream ops against an
+    #: empty pool could trip sequencing asserts (merge before prefill).
+    prev_engine = None
+    prev_skipping = False
+    skipping = fresh
     while True:
         msg = channel.next()
         op = msg[0]
         if op == "stop":
             return
+        if op == "resize":
+            new_engine = _follower_resize(engine, channel, msg[1])
+            if new_engine is not engine:
+                prev_engine, engine = engine, new_engine
+                params = engine.params
+                row = seg_row = None
+                prev_skipping, skipping = skipping, False
+                if on_engine is not None:
+                    on_engine(engine)
+            continue
+        if op == "resize_abort":
+            if prev_engine is not None:
+                engine, prev_engine = prev_engine, None
+                params = engine.params
+                row = seg_row = None
+                # a fresh joiner rolled back to its never-initialized
+                # engine must resume SKIPPING — replaying mid-stream ops
+                # against an empty pool is exactly what fresh guards
+                skipping = prev_skipping
+                if on_engine is not None:
+                    on_engine(engine)
+            continue
+        if op == "resize_commit":
+            # the leader cut over: the abort window is closed, so the
+            # previous-degree engine (a full weight + pool device copy)
+            # can be freed instead of living until the next resize
+            prev_engine = None
+            continue
+        if skipping:
+            continue
         if op == "prefill":
             _, bucket, toks, lengths = msg
             row = engine._prefill_for(bucket)(params, toks, lengths)
@@ -1588,12 +1755,20 @@ def serve_main(ctx: bootstrap.PodContext) -> None:
     kw["seq_buckets"] = conf.get("seq_buckets")
     gang_port = int(conf["gang_port"])
     token = _resolve_gang_token(conf)
+    elastic = conf.get("elastic") or {}
     chan_kw = dict(
         hb_interval=float(conf.get("gang_hb_interval", 0.5)),
         dead_peer_timeout=float(conf.get("gang_dead_peer_timeout", 3.0)),
         reattach_timeout=float(conf.get("gang_reattach_timeout", 10.0)),
         reconnect_timeout=float(conf.get("gang_reconnect_timeout", 10.0)),
     )
+    if elastic:
+        # the elastic supervisor must escalate a permanent loss into a
+        # shrink BEFORE the channel's reattach clock goes fatal — widen
+        # the grace so resize_deadline_s always fires first
+        chan_kw["reattach_timeout"] = max(
+            chan_kw["reattach_timeout"],
+            float(elastic.get("resize_deadline_s", 2.0)) * 4)
     followers = ctx.num_processes - 1
 
     if ctx.process_id == 0:
@@ -1636,6 +1811,33 @@ def serve_main(ctx: bootstrap.PodContext) -> None:
                 if time.monotonic() > deadline:
                     raise
                 time.sleep(0.2)
+        supervisor = None
+        resizer = None
+        if elastic:
+            # elastic gang (ISSUE 10): a member evicted past
+            # resize_deadline_s shrinks the gang to the surviving
+            # degree instead of the channel going fatal; a returned or
+            # added member grows it back.  The resizer re-points the
+            # runtime's engine on every cutover.
+            from .resize import ElasticGangSupervisor, GangResizer, degree_of
+
+            degree = degree_of(conf.get("mesh_axes"))
+            resizer = GangResizer(
+                engine, reshard_token=token,
+                # runtimes with a traffic plane re-attach preemptors on
+                # swap (TextGenerator.swap_engine); plain generators
+                # just re-point
+                set_engine=lambda e: (
+                    model.swap_engine(e)
+                    if hasattr(model, "swap_engine")
+                    else setattr(model, "engine", e)))
+            supervisor = ElasticGangSupervisor(
+                resizer, channel,
+                degree_per_member=max(degree // ctx.num_processes, 1),
+                max_degree=degree,
+                min_degree=int(elastic.get("min_degree", 1)),
+                resize_deadline_s=float(
+                    elastic.get("resize_deadline_s", 2.0)))
         stop = threading.Event()
         signal.signal(signal.SIGTERM, lambda *_: stop.set())
         try:
@@ -1644,20 +1846,39 @@ def serve_main(ctx: bootstrap.PodContext) -> None:
                 # failure inside the scheduler -> engine error; an IDLE
                 # gang publishes nothing, so also watch the channel's own
                 # fatal flag (a follower past its re-attach grace).  Exit
-                # non-zero so the JaxJob controller gang-restarts.
-                if engine._error is not None or channel._dead is not None:
+                # non-zero so the JaxJob controller gang-restarts.  Under
+                # elastic resize the LIVE engine is whatever the resizer
+                # last installed.
+                live = resizer.engine if resizer is not None else engine
+                if live._error is not None or channel._dead is not None:
                     raise SystemExit(1)
                 stop.wait(0.2)
         finally:
+            if supervisor is not None:
+                supervisor.stop()
             server.stop()
-            engine.stop()
+            (resizer.engine if resizer is not None else engine).stop()
     else:
         host, _, _ = bootstrap.resolve_coordinator(
             ctx.coordinator_address or "127.0.0.1:0").rpartition(":")
-        channel = GangChannel.connect(
-            host, gang_port, rank=ctx.process_id, token=token, **chan_kw)
-        engine = contlib.ContinuousEngine(cfg, params, **kw)
-        try:
-            follow(engine, channel)
-        finally:
-            channel.close()
+        fresh = False
+        while True:
+            channel = GangChannel.connect(
+                host, gang_port, rank=ctx.process_id, token=token,
+                fresh=fresh, **chan_kw)
+            engine = contlib.ContinuousEngine(cfg, params, **kw)
+            try:
+                follow(engine, channel, fresh=fresh)
+                break
+            except ChannelClosed as e:
+                # elastic grow-back (ISSUE 10): a RESTARTED member's
+                # replay gap has usually rolled off the log — instead of
+                # crash-looping on GONE, rejoin as a FRESH member (no
+                # replay, ops skipped until the supervisor's grow resize
+                # rebuilds its state)
+                if elastic and not fresh and "re-attach" in str(e):
+                    fresh = True
+                    continue
+                raise
+            finally:
+                channel.close()

@@ -148,6 +148,23 @@ def block_keys(tokens, block_size: int, max_blocks: int = 64) -> list[int]:
     return keys
 
 
+def resize_block_budget(num_blocks: int, src_degree: int, dst_degree: int,
+                        *, reserved: int = 0) -> int:
+    """Block count for a pool rebuilt at a new TP degree (ISSUE 10).
+
+    The KV pool shards its kv_heads axis over the TP mesh, so per-chip
+    pool HBM is ``total / degree``: a gang shrinking from N to M chips
+    must shrink the pool to ``num_blocks * M / N`` to keep the per-chip
+    bill constant (and may grow it back symmetrically).  Floored at
+    ``reserved`` — the full worst-case span the surviving live sequences
+    already hold (admission semantics: a resize must never evict
+    mid-decode) — and at 1."""
+    if src_degree < 1 or dst_degree < 1:
+        raise ValueError("degrees must be >= 1")
+    scaled = (int(num_blocks) * int(dst_degree)) // int(src_degree)
+    return max(scaled, int(reserved), 1)
+
+
 def lcp(content, prompt_arr: np.ndarray, cap: int) -> int:
     """Longest common prefix of a token sequence and the prompt array,
     capped — vectorized, runs per candidate per admission on the

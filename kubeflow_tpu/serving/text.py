@@ -338,6 +338,31 @@ class TextGenerator(Model):
         self._build_traffic()
         self.ready = True
 
+    def swap_engine(self, engine) -> None:
+        """Elastic-resize hook (serving/resize.py ``set_engine``):
+        re-point the runtime at the new-degree engine AND migrate the
+        traffic plane's preemptors — each holds an engine reference
+        (its poll thread would silently watch the stopped source
+        forever) and possibly PARKED snapshots, which must follow the
+        pool so an evicted victim re-imports into the LIVE engine."""
+        old, self.engine = self.engine, engine
+        if self.traffic is None:
+            return
+        carried: list = []
+        for p in list(self.traffic.preemptors):
+            if old is not None and p.engine is old:
+                p.stop(fail_parked=False)
+                with p._lock:
+                    carried.extend(p._parked)
+                    p._parked = []
+                self.traffic.preemptors.remove(p)
+        if getattr(engine, "paged", False) and bool(
+                self.config.get("qos_preempt", True)):
+            np_ = self.traffic.attach_engine(engine)
+            if carried:
+                with np_._lock:
+                    np_._parked.extend(carried)
+
     def stop(self) -> None:
         if self.traffic is not None:
             self.traffic.stop()

@@ -742,8 +742,15 @@ class EnginePreemptor:
         snapshot), or None."""
         worst = None
         key = None
-        for req in list(self.engine._slots):
+        frozen = set(self.engine._migrating)
+        for slot, req in enumerate(list(self.engine._slots)):
             if req is None or req.done.is_set():
+                continue
+            if slot in frozen:
+                # frozen for a migration/resize (ISSUE 10): another
+                # orchestrator owns this sequence's cutover — evicting
+                # it here would fork ownership (two snapshots, one
+                # handle, double-decode on whichever side wins)
                 continue
             tier = getattr(req, "priority", 1)
             if tier <= better_than:

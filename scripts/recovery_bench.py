@@ -40,17 +40,36 @@ again on the destination (first post-migration token observed).
 race a 5 s deadline (or cut long conversations) now completes lossless
 in migration time.
 
+The fourth row (ISSUE 10) is **elastic gang resize**: a live paged
+engine at TP=2 shrinks to the surviving degree with N live
+conversations aboard (``GangResizer``: quiesce -> export -> weight
+repartition + new-degree rebuild/warmup -> held imports -> cutover),
+and the row times resize-start -> every conversation decoding again on
+the new-degree engine, phase-decomposed as ``drain_s`` / ``reshard_s``
+/ ``resume_s``.  ``gang_resize_s`` p50 is the headline — the failure
+mode that used to park an ISvc in Degraded forever is now a bounded
+recovery; the live-conversation count is swept to show how the drain
+phase scales.
+
 Usage: python scripts/recovery_bench.py [trials] [workers] [seed]
 """
 
 from __future__ import annotations
 
 import json
+import os
 import statistics
 import sys
 import time
 
 sys.path.insert(0, ".")
+
+# the resize row needs >= 2 virtual devices for its TP=2 source engine
+# (set before any jax import; every other row is meshless or jax-free)
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=2").strip()
 
 
 def _percentiles(samples: list[float]) -> dict:
@@ -329,6 +348,49 @@ def run_drain_trial(i: int, conversations: int = 4) -> dict:
         dst.stop()
 
 
+def run_resize_trial(i: int, conversations: int) -> dict:
+    """One elastic shrink: a TP=2 paged engine with N live
+    conversations resizes to the surviving degree; measured = resize
+    start -> every conversation has produced a token on the new-degree
+    engine, with the resizer's own phase decomposition attached."""
+    import jax
+    import jax.numpy as jnp
+
+    from kubeflow_tpu.models import llama as llamalib
+    from kubeflow_tpu.serving.continuous import ContinuousEngine
+    from kubeflow_tpu.serving.resize import GangResizer
+
+    cfg = llamalib.tiny(num_heads=8, num_kv_heads=8)
+    params = llamalib.Llama(cfg).init(
+        jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32))["params"]
+    kw = dict(num_slots=conversations, decode_chunk=2,
+              prefix_cache=False, block_size=16, seq_buckets=[32])
+    src = ContinuousEngine(cfg, params, mesh_axes={"model": 2}, **kw)
+    new = None
+    try:
+        src.warmup()
+        reqs = [src.submit([7 + i, 8, 9, j + 1], max_new_tokens=96)
+                for j in range(conversations)]
+        while any(len(r.tokens) < 2 for r in reqs):
+            time.sleep(0.002)
+        counts = [len(r.tokens) for r in reqs]
+        rz = GangResizer(src)
+        t0 = time.perf_counter()
+        new = rz.resize({"model": 1})
+        while any(len(r.tokens) <= c for r, c in zip(reqs, counts)
+                  if not r.done.is_set()):
+            time.sleep(0.001)
+        total = time.perf_counter() - t0
+        for r in reqs:
+            r.cancel()
+        return {"gang_resize_s": total, "conversations": conversations,
+                **{k: v for k, v in rz.last_timings.items()
+                   if k != "total_s"},
+                "recompiles": new.stats()["jit_recompiles_total"]}
+    finally:
+        (new if new is not None else src).stop()
+
+
 def main() -> None:
     trials = int(sys.argv[1]) if len(sys.argv) > 1 else 12
     workers = int(sys.argv[2]) if len(sys.argv) > 2 else 4
@@ -398,6 +460,36 @@ def main() -> None:
         **_percentiles([r["drain_resume_s"] for r in drain_rows]),
         "moved_total": sum(r["moved"] for r in drain_rows),
         "failed_total": sum(r["failed"] for r in drain_rows),
+    }))
+
+    # elastic gang resize (ISSUE 10): TP shrink with live conversations,
+    # live-conversation count swept
+    resize_trials = max(3, trials // 4)
+    resize_rows = []
+    for convs in (2, 6):
+        for i in range(resize_trials):
+            row = run_resize_trial(i, conversations=convs)
+            resize_rows.append(row)
+            print("# resize trial", i, json.dumps({
+                k: (round(v, 3) if isinstance(v, float) else v)
+                for k, v in row.items()}), file=sys.stderr)
+    phase_p50 = {}
+    for key in ("drain_s", "reshard_s", "resume_s"):
+        vals = sorted(r[key] for r in resize_rows)
+        phase_p50[key] = round(vals[len(vals) // 2], 3)
+    per_count = {
+        str(c): _percentiles([r["gang_resize_s"] for r in resize_rows
+                              if r["conversations"] == c])["p50"]
+        for c in (2, 6)}
+    print(json.dumps({
+        "metric": "gang_resize_p50_seconds",
+        "unit": (f"s (TP 2 -> 1 shrink -> all live conversations "
+                 f"decoding at the new degree, n={resize_trials} per "
+                 "count, tiny model CPU stand-in)"),
+        **_percentiles([r["gang_resize_s"] for r in resize_rows]),
+        "phase_p50": phase_p50,
+        "p50_by_conversations": per_count,
+        "recompiles_total": sum(r["recompiles"] for r in resize_rows),
     }))
 
 

@@ -178,6 +178,48 @@ class TrafficReport:
                             ["host-sync-in-dispatch"],
                             rel="kubeflow_tpu/serving/_traffic.py") == []
 
+    def test_resizer_and_reshard_classes_rooted(self, tmp_path):
+        """ISSUE 10 satellite (the PR 8 ``*Preemptor`` lesson): every
+        method of a ``*Resizer``/``*Reshard`` class is a lint root —
+        elastic-resize orchestration touches scheduler state, so an
+        undeclared device fetch or blocking socket there must surface,
+        pragma'd with a reason or moved off-thread."""
+        code = """
+import jax
+
+class GangResizer:
+    def _copy_weights(self):
+        return jax.device_get(self._params)
+
+class WeightReshard:
+    def _stream(self):
+        self._sock.sendall(self._frame)
+"""
+        found = lint_snippet(tmp_path, code, ["host-sync-in-dispatch"],
+                             rel="kubeflow_tpu/serving/_resize.py")
+        scopes = {f.scope for f in found}
+        assert "GangResizer._copy_weights" in scopes
+        assert "WeightReshard._stream" in scopes
+        assert any("socket" in f.message for f in found)
+
+    def test_resizer_near_miss_other_class(self, tmp_path):
+        """Prefix lookalikes (``Reshard*``/``Resize*`` without the
+        suffix) are helper/plan classes, not the orchestrator — clean."""
+        code = """
+import numpy as np
+
+class ReshardPlanner:
+    def table(self):
+        return np.asarray(self._rows)
+
+class ResizeReport:
+    def render(self):
+        return self._latency.tolist()
+"""
+        assert lint_snippet(tmp_path, code,
+                            ["host-sync-in-dispatch"],
+                            rel="kubeflow_tpu/serving/_resize.py") == []
+
     def test_blocking_socket_send_in_scheduler_flagged(self, tmp_path):
         """ISSUE 8 satellite: a blocking socket send reachable from an
         engine's scheduler roots stalls every live request for a

@@ -12,6 +12,8 @@ import json
 import threading
 import time
 
+import pytest
+
 from kubeflow_tpu.api import Container, JaxJob, ObjectMeta, ReplicaSpec, Resources
 from kubeflow_tpu.api.common import (
     JobConditionType,
@@ -93,6 +95,41 @@ class TestFaultPlan:
         # different seeds decorrelate (16 choices; seeds 7/8 differ)
         assert picks_a[0] != picks_b[0] or FaultPlan(seed=7).rng.random() != \
             FaultPlan(seed=8).rng.random()
+
+    def test_gang_member_loss_seeded_and_permanent(self):
+        """ISSUE 10 satellite: the permanent-loss fault — seeded member
+        + kill time frozen at build, rank 0 spared (losing the leader
+        is a restart, not a resize), permanence expressed as a crash
+        for effectively unlimited pod incarnations, and the in-process
+        actuator fires each loss exactly once."""
+        a = FaultPlan(seed=9).gang_member_loss(world=4)
+        b = FaultPlan(seed=9).gang_member_loss(world=4)
+        assert a.faults[0].index == b.faults[0].index >= 1
+        assert a.faults[0].at == b.faults[0].at
+        assert a.faults[0].times >= 1_000_000  # permanent: never heals
+        transient = FaultPlan(seed=9).gang_member_loss(
+            world=4, permanent=False)
+        assert transient.faults[0].times == 1
+        # actuator poll: due after `at`, fired exactly once
+        plan = FaultPlan(seed=3).gang_member_loss(world=2, at=0.0)
+        plan.activate()
+        assert plan.due_member_losses() == [1]
+        assert plan.due_member_losses() == []
+
+    def test_kill_mid_resize_seeded_failpoint(self):
+        """The resize chaos seam: a seeded phase choice, a failpoint
+        that raises at exactly that phase, at most ``times`` firings."""
+        assert (FaultPlan(seed=4).kill_mid_resize().faults[0].role
+                == FaultPlan(seed=4).kill_mid_resize().faults[0].role)
+        assert FaultPlan(seed=4).kill_mid_resize().faults[0].role \
+            in FaultPlan.RESIZE_PHASES
+        plan = FaultPlan(seed=0).kill_mid_resize(phase="commit")
+        fp = plan.resize_failpoint()
+        fp("export")  # clean pass-through off-phase
+        fp("reshard")
+        with pytest.raises(RuntimeError, match="mid-commit"):
+            fp("commit")
+        fp("commit")  # times=1: spent
 
     def test_multiphase_script_barrier_and_activity(self):
         """A pod can run healthy, cross the barrier, go quiet, then

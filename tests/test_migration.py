@@ -244,6 +244,38 @@ class TestMigrationSafety:
             src.stop()
             dst.stop()
 
+    def test_resume_after_other_slots_decoded_is_bit_identical(
+            self, tiny_llama, oracle):
+        """Regression (found by the ISSUE 10 resize parity suite): the
+        pool decode scan recomputes EVERY row's logits — inactive rows
+        included — so a slot frozen for migration had its next-token
+        row silently clobbered while its neighbors kept decoding, and a
+        failed transfer's resume sampled garbage.  The freeze now
+        stashes the row and resume reinstalls it; a double export while
+        frozen must also return the stable row, not the live one."""
+        src = make_engine(tiny_llama)
+        src.warmup()
+        try:
+            victim = src.submit([7, 8, 9], max_new_tokens=12)
+            noisy = src.submit(LONG, max_new_tokens=40)
+            snap1 = _export_after(src, victim, 3)
+            assert snap1 is not None
+            # the neighbor decodes on while the victim sits frozen
+            n = len(noisy.tokens)
+            deadline = time.time() + 60
+            while len(noisy.tokens) < n + 6:
+                assert time.time() < deadline
+                time.sleep(0.005)
+            # a re-export of the frozen slot reads the STASHED row
+            snap2 = src.export_sequence(victim)
+            assert np.array_equal(snap1["logits"], snap2["logits"])
+            src.resume_sequence(victim)
+            assert victim.wait(120) == oracle["short12"]
+            assert noisy.wait(300) == oracle["long40"]
+            assert src.stats()["jit_recompiles_total"] == 0
+        finally:
+            src.stop()
+
     def test_cancel_during_frozen_migration_frees_source(
             self, tiny_llama):
         """A client disconnect while the slot is frozen for transfer
