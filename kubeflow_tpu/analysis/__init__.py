@@ -26,11 +26,26 @@ This package enforces those invariants as code:
   ``unsafe-pickle`` (pickle ingestion outside the post-auth gang replay
   path), ``nondaemon-thread`` (a non-daemon helper thread wedges
   interpreter shutdown).
+- :mod:`.rules_threads` — ``thread-affinity``: per-file thread-role
+  graph (scheduler roots vs spawned threads, HTTP handlers, gang
+  replay loops, the public cross-thread API); writes to
+  scheduler-owned engine state from a non-scheduler role fail unless
+  routed through the migration mailbox — the PR 7/PR 9 review-round
+  bug class, made mechanical.
+- :mod:`.rules_protocol` — ``op-table`` (every published gang op needs
+  a ``follow()`` replay arm and vice versa, cross-file across
+  gang.py/resize.py) and ``fault-pairing`` (chaos ``FaultKind``
+  factories vs their ``due_*``/actuator consumers).
 - :mod:`.runtime` — the *runtime* half: :func:`recompile_guard` counts
   jit cache misses after warmup (``jit_recompiles_total`` engine gauge,
-  asserted 0 in steady-state decode) and :class:`LockAudit` records
+  asserted 0 in steady-state decode), :class:`LockAudit` records
   real acquisition order under chaos to catch inversions static nesting
-  cannot see.
+  cannot see, and :class:`BlockLedger` shadow-refcounts the paged-KV
+  block economy (conservation per op, zero-leaked-blocks audits at
+  quiesce/retire/migration/resize boundaries, the
+  ``kv_blocks_leaked_total`` /metrics gauge).
+- :mod:`.selftest` — built-in true-positive/near-miss fixtures per
+  rule; ``--self-test`` runs them with no pytest in the loop.
 
 Intentional violations carry an inline pragma on the offending line (or
 the line above)::
@@ -43,7 +58,12 @@ honored too — hpo/controllers.py's db-retry sites are the exemplar.
 
 Run it: ``python -m kubeflow_tpu.analysis`` (or
 ``scripts/platform_lint.py``); ``--update-baseline`` re-freezes debt
-after an intentional change; ``--json`` emits machine-readable findings.
+after an intentional change; ``--json`` emits machine-readable
+findings; ``--rule`` accepts rule names or group aliases (``threads``,
+``protocol``, ``locks``, ``dispatch``, ``hygiene``); ``--self-test``
+validates the rules against their own fixtures.  Exit codes: 0 = clean
+(or self-test green), 1 = NEW findings above the ratchet baseline (or
+a failed fixture), 2 = usage error.
 This module deliberately imports no jax — the lint half is pure stdlib
 so the CLI and the tier-1 ratchet test stay fast.
 """

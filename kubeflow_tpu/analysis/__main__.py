@@ -6,10 +6,20 @@ Modes:
   --update-baseline    freeze the current findings as the new debt
   --json               machine-readable findings + summary on stdout
   --baseline PATH      compare/write a non-default baseline file
-  --rule NAME          run a subset of rules (repeatable)
+  --rule NAME          run a subset: a rule name OR a group alias
+                       (``threads`` -> thread-affinity, ``protocol`` ->
+                       op-table + fault-pairing, ``locks``, ``dispatch``,
+                       ``hygiene``); repeatable
   --all                list every finding, not just the new ones
+  --self-test          run the built-in rule fixtures (selftest.py) —
+                       the lint binary validating itself, no pytest
 
-Exit codes: 0 = no findings above baseline; 1 = new findings; 2 = usage.
+Exit codes (CI contract, also asserted by tests/test_analysis.py):
+  0  no findings above the baseline / self-test green / baseline written
+  1  NEW findings above the ratchet baseline, or a self-test fixture
+     failed (a rule stopped firing on its true positive or started
+     firing on its near miss)
+  2  usage error (argparse; e.g. --update-baseline with a subset lint)
 """
 
 from __future__ import annotations
@@ -27,6 +37,30 @@ from .astlint import (
     run_lint,
     write_baseline,
 )
+
+#: CLI group aliases -> registered rule names (the ``--rule threads`` /
+#: ``--rule protocol`` filters): one word selects a concern, not a file
+RULE_GROUPS: dict[str, tuple[str, ...]] = {
+    "dispatch": ("host-sync-in-dispatch", "jit-in-loop"),
+    "hygiene": ("swallowed-exception", "unsafe-pickle",
+                "nondaemon-thread"),
+    "locks": ("lock-order",),
+    "threads": ("thread-affinity",),
+    "protocol": ("op-table", "fault-pairing"),
+}
+
+
+def resolve_rules(names) -> list[str] | None:
+    """Expand group aliases into registered rule names (dedup, stable
+    order)."""
+    if names is None:
+        return None
+    out: list[str] = []
+    for n in names:
+        for r in RULE_GROUPS.get(n, (n,)):
+            if r not in out:
+                out.append(r)
+    return out
 
 
 def repo_root() -> str:
@@ -51,11 +85,30 @@ def main(argv=None) -> int:
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="emit machine-readable JSON")
     ap.add_argument("--rule", action="append", default=None,
-                    choices=rule_names(),
-                    help="run only this rule (repeatable)")
+                    choices=rule_names() + sorted(RULE_GROUPS),
+                    help="run only this rule or group alias "
+                         "(threads, protocol, locks, dispatch, hygiene; "
+                         "repeatable)")
     ap.add_argument("--all", action="store_true",
                     help="print every finding, not only new ones")
+    ap.add_argument("--self-test", action="store_true", dest="self_test",
+                    help="run the built-in rule fixtures instead of "
+                         "linting the repo (0 = all green)")
     args = ap.parse_args(argv)
+
+    rules = resolve_rules(args.rule)
+    if args.self_test:
+        if (args.paths or args.baseline or args.update_baseline
+                or args.as_json or args.all):
+            # the fixtures lint synthetic sources, not the repo: a
+            # --json/--baseline caller would get fixture chatter + exit
+            # 0 where it expects the documented lint contract
+            ap.error("--self-test runs the built-in fixtures only; it "
+                     "is incompatible with paths, --baseline, "
+                     "--update-baseline, --json, and --all "
+                     "(--rule filters which fixtures run)")
+        from .selftest import run_selftest
+        return run_selftest(rules=rules)
 
     root = os.path.abspath(args.root) if args.root else repo_root()
     bpath = args.baseline or baseline_path(root)
@@ -66,7 +119,7 @@ def main(argv=None) -> int:
         # the next full run then fails tier-1 on debt nobody added
         ap.error("--update-baseline requires a full lint "
                  "(no positional paths, no --rule)")
-    report = run_lint(root, paths=paths, rules=args.rule)
+    report = run_lint(root, paths=paths, rules=rules)
 
     if args.update_baseline:
         doc = write_baseline(bpath, report)

@@ -152,7 +152,13 @@ def rule_names() -> list[str]:
 def _ensure_rules_loaded() -> None:
     # rule modules self-register via @rule at import; imported lazily so
     # `from .astlint import Finding` never recurses
-    from . import rules_dispatch, rules_hygiene, rules_locks  # noqa: F401
+    from . import (  # noqa: F401
+        rules_dispatch,
+        rules_hygiene,
+        rules_locks,
+        rules_protocol,
+        rules_threads,
+    )
 
 
 #: directories under the repo root that hold platform code to lint;

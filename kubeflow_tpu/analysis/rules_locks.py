@@ -50,7 +50,13 @@ LOCK_SCOPE_PREFIXES = (
     "kubeflow_tpu/native/",
 )
 
-_LOCKISH = ("lock", "gate", "cond", "mutex", "joined")
+#: lexical lock-name markers.  "cv" entered with PR 9: serving/resize.py
+#: guards reshard acks with a ``threading.Condition`` named ``_ack_cv``
+#: and serving/traffic.py parks class waiters on per-class ``cond``s —
+#: Conditions ARE locks (they wrap one), so leaving them out of the
+#: nesting graph silently exempted the two newest modules from the
+#: deadlock check.
+_LOCKISH = ("lock", "gate", "cond", "mutex", "joined", "cv")
 
 
 def _lock_name(expr: ast.AST, pf: ParsedFile, cls: str) -> Optional[str]:
@@ -157,10 +163,19 @@ def _blocking_label(call: ast.Call) -> Optional[str]:
     return None
 
 
-@rule("lock-order")
-def lock_order(ctx: LintContext) -> Iterable[Finding]:
-    #: global edge set: (outer, inner) -> first (pf, node) that creates it
+def collect_lock_graph(ctx: LintContext) -> tuple[
+        dict[tuple[str, str], tuple[ParsedFile, ast.AST]],
+        list[tuple[ParsedFile, ast.AST, str, str]]]:
+    """The platform-wide lock graph: ``(edges, blocking_sites)``.
+
+    ``edges`` maps (outer, inner) nesting pairs to the first site that
+    creates them; ``blocking_sites`` lists (pf, node, label, lock)
+    blocking calls made while a lock is held.  Exposed so tests can
+    re-verify acyclicity and coverage (the PR 8/9 satellite: resize.py's
+    ``_ack_cv`` Condition and traffic.py's per-class ``cond``s must
+    actually appear in this graph)."""
     edges: dict[tuple[str, str], tuple[ParsedFile, ast.AST]] = {}
+    blocking: list[tuple[ParsedFile, ast.AST, str, str]] = []
 
     scoped = [pf for rel, pf in sorted(ctx.files.items())
               if rel.startswith(LOCK_SCOPE_PREFIXES)]
@@ -182,12 +197,7 @@ def lock_order(ctx: LintContext) -> Iterable[Finding]:
                 # blocking call while the lock is held
                 label = _blocking_label(child)
                 if label is not None:
-                    f = ctx.finding(
-                        pf, "lock-order", child,
-                        f"blocking call {label} while holding "
-                        f"`{outer_name}`")
-                    if f:
-                        yield f
+                    blocking.append((pf, child, label, outer_name))
                     continue
                 # 1-level interprocedural: locks the callee takes are
                 # taken under this one
@@ -205,7 +215,14 @@ def lock_order(ctx: LintContext) -> Iterable[Finding]:
                         if inner_name != outer_name:
                             edges.setdefault((outer_name, inner_name),
                                              (pf, child))
+    return edges, blocking
 
+
+def find_cycles(edges: dict[tuple[str, str], tuple[ParsedFile, ast.AST]]
+                ) -> list[tuple[list[str], ParsedFile, ast.AST]]:
+    """Distinct lock-order cycles in ``edges``: (witness path, anchor
+    site) per cycle node-set, anchored at the smallest source node for
+    ratchet-stable identity."""
     # cycle detection: edge a->b closes a cycle iff a is reachable back
     # from b.  BFS with parent links reconstructs one witness path;
     # each distinct node set reports once, anchored at the edge whose
@@ -213,6 +230,7 @@ def lock_order(ctx: LintContext) -> Iterable[Finding]:
     graph: dict[str, set[str]] = {}
     for a, b in edges:
         graph.setdefault(a, set()).add(b)
+    out: list[tuple[list[str], ParsedFile, ast.AST]] = []
     reported: set[frozenset] = set()
     for a, b in sorted(edges):
         parent: dict[str, str] = {b: b}
@@ -239,8 +257,22 @@ def lock_order(ctx: LintContext) -> Iterable[Finding]:
             continue
         reported.add(nodes)
         pf, where = edges[(a, b)]
+        out.append((cycle, pf, where))
+    return out
+
+
+@rule("lock-order")
+def lock_order(ctx: LintContext) -> Iterable[Finding]:
+    edges, blocking = collect_lock_graph(ctx)
+    for pf, node, label, outer_name in blocking:
+        f = ctx.finding(
+            pf, "lock-order", node,
+            f"blocking call {label} while holding `{outer_name}`")
+        if f:
+            yield f
+    for cycle, pf, where in find_cycles(edges):
         f = ctx.finding(
             pf, "lock-order", where,
-            "lock-order cycle: " + " -> ".join(cycle + [a]))
+            "lock-order cycle: " + " -> ".join(cycle + [cycle[0]]))
         if f:
             yield f

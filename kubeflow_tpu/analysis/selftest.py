@@ -1,0 +1,340 @@
+"""Built-in rule fixtures: the lint binary validates itself.
+
+``python -m kubeflow_tpu.analysis --self-test`` (or
+``scripts/platform_lint.py --self-test``) runs every fixture below
+through the real rule engine in a temp tree — one TRUE POSITIVE (the
+rule must fire, with the expected substring in the message) and one
+NEAR MISS (the rule must stay silent) per rule — with no pytest in the
+loop, so tier-1 CI can smoke the analyzer with nothing but the
+interpreter.  The pytest suite (tests/test_analysis.py) runs richer
+variants of the same fixtures; this module is the dependency-free
+floor.
+
+The op-table true positive is the SEEDED DRIFT the acceptance bar
+names: a published gang op whose ``follow()`` arm was deleted — the
+exact protocol rot the rule exists to catch.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from dataclasses import dataclass
+
+from .astlint import run_lint
+
+
+@dataclass(frozen=True)
+class Fixture:
+    rule: str
+    name: str          # "<rule>/<true-positive|near-miss>"
+    rel: str           # path inside the temp tree (rules scope by path)
+    code: str
+    expect: int        # minimum findings (0 = must be clean)
+    needle: str = ""   # substring every finding message must contain
+
+
+FIXTURES: tuple[Fixture, ...] = (
+    Fixture(
+        "host-sync-in-dispatch", "host-sync/true-positive",
+        "kubeflow_tpu/serving/_st_dispatch.py",
+        """
+import jax
+
+class FooEngine:
+    def _loop(self):
+        return jax.device_get(self.buf)
+""",
+        1, "host sync"),
+    Fixture(
+        "host-sync-in-dispatch", "host-sync/near-miss",
+        "kubeflow_tpu/serving/_st_dispatch.py",
+        """
+import jax
+
+class FooEngine:
+    def _loop(self):
+        return 1
+
+    def debug_dump(self):
+        return jax.device_get(self.buf)
+""",
+        0),
+    Fixture(
+        "jit-in-loop", "jit-in-loop/true-positive",
+        "kubeflow_tpu/serving/_st_jit.py",
+        """
+import jax
+
+def bad(fns):
+    out = []
+    for f in fns:
+        out.append(jax.jit(f))
+    return out
+""",
+        1, "recompile treadmill"),
+    Fixture(
+        "jit-in-loop", "jit-in-loop/near-miss",
+        "kubeflow_tpu/serving/_st_jit.py",
+        """
+import jax
+
+def good(fns, cache):
+    def getter(k):
+        if k not in cache:
+            cache[k] = jax.jit(fns[k])
+        return cache[k]
+    out = []
+    for k in range(4):
+        out.append(getter(k)(k))
+    return out
+""",
+        0),
+    Fixture(
+        "lock-order", "lock-order/true-positive",
+        "kubeflow_tpu/serving/_st_locks.py",
+        """
+import threading
+
+a_lock = threading.Lock()
+b_lock = threading.Lock()
+
+def one():
+    with a_lock:
+        with b_lock:
+            pass
+
+def two():
+    with b_lock:
+        with a_lock:
+            pass
+""",
+        1, "cycle"),
+    Fixture(
+        "lock-order", "lock-order/near-miss",
+        "kubeflow_tpu/serving/_st_locks.py",
+        """
+import threading
+
+a_lock = threading.Lock()
+b_lock = threading.Lock()
+
+def one():
+    with a_lock:
+        with b_lock:
+            pass
+
+def two():
+    with a_lock:
+        with b_lock:
+            pass
+""",
+        0),
+    Fixture(
+        "swallowed-exception", "swallowed/true-positive",
+        "kubeflow_tpu/serving/_st_swallow.py",
+        """
+def f():
+    try:
+        risky()
+    except Exception:
+        pass
+""",
+        1, "blanket"),
+    Fixture(
+        "swallowed-exception", "swallowed/near-miss",
+        "kubeflow_tpu/serving/_st_swallow.py",
+        """
+def f():
+    try:
+        risky()
+    except Exception as e:
+        raise RuntimeError("wrapped") from e
+""",
+        0),
+    Fixture(
+        "unsafe-pickle", "pickle/true-positive",
+        "kubeflow_tpu/serving/_st_pickle.py",
+        """
+import pickle
+
+def recv(sock):
+    return pickle.loads(sock.recv(4096))
+""",
+        1, "arbitrary code execution"),
+    Fixture(
+        "unsafe-pickle", "pickle/near-miss",
+        "kubeflow_tpu/serving/_st_pickle.py",
+        """
+import pickle
+
+def send(obj):
+    return pickle.dumps(obj)
+""",
+        0),
+    Fixture(
+        "nondaemon-thread", "nondaemon/true-positive",
+        "kubeflow_tpu/serving/_st_thread.py",
+        """
+import threading
+
+def start(work):
+    threading.Thread(target=work).start()
+""",
+        1, "daemon"),
+    Fixture(
+        "nondaemon-thread", "nondaemon/near-miss",
+        "kubeflow_tpu/serving/_st_thread.py",
+        """
+import threading
+
+def start(work):
+    threading.Thread(target=work, daemon=True).start()
+""",
+        0),
+    Fixture(
+        "thread-affinity", "thread-affinity/true-positive",
+        "kubeflow_tpu/serving/_st_affinity.py",
+        """
+import threading
+
+class FooEngine:
+    def _loop(self):
+        self._admit()
+
+    def _admit(self):
+        self._waiting.sort()
+
+    def submit(self, req):
+        self._waiting.append(req)
+""",
+        1, "scheduler-owned"),
+    Fixture(
+        "thread-affinity", "thread-affinity/near-miss",
+        "kubeflow_tpu/serving/_st_affinity.py",
+        """
+import queue
+
+class FooEngine:
+    def _loop(self):
+        self._service()
+
+    def _service(self):
+        kind, a = self._migrate_q.get_nowait()
+        self._waiting.append(a)          # scheduler thread: fine
+
+    def submit(self, req):
+        self._migrate_q.put(("admit", req))   # the mailbox seam
+""",
+        0),
+    Fixture(
+        # the acceptance bar's seeded drift: op "beta" is published but
+        # its follow() arm was deleted
+        "op-table", "op-table/true-positive",
+        "kubeflow_tpu/serving/_st_ops.py",
+        """
+def leader(ch, toks):
+    ch.publish(("alpha", toks))
+    ch.publish(("beta", toks))
+
+def follow(channel):
+    while True:
+        msg = channel.next()
+        op = msg[0]
+        if op == "alpha":
+            continue
+        raise RuntimeError(f"unknown gang op {op!r}")
+""",
+        1, "`beta`"),
+    Fixture(
+        "op-table", "op-table/near-miss",
+        "kubeflow_tpu/serving/_st_ops.py",
+        """
+def leader(ch, toks):
+    ch.publish(("alpha", toks))
+
+def follow(channel):
+    while True:
+        msg = channel.next()
+        op = msg[0]
+        if op == "alpha":
+            continue
+""",
+        0),
+    Fixture(
+        "fault-pairing", "fault-pairing/true-positive",
+        "kubeflow_tpu/chaos/_st_faults.py",
+        """
+class FaultKind:
+    CRASH = "crash"
+    GHOST = "ghost"
+
+class Fault:
+    def __init__(self, kind, at=0.0):
+        self.kind = kind
+
+class Plan:
+    def crash(self):
+        self.faults.append(Fault(FaultKind.CRASH))
+
+    def ghost(self):
+        self.faults.append(Fault(FaultKind.GHOST))
+
+    def due(self):
+        return [f for f in self.faults if f.kind == FaultKind.CRASH]
+""",
+        1, "GHOST"),
+    Fixture(
+        "fault-pairing", "fault-pairing/near-miss",
+        "kubeflow_tpu/chaos/_st_faults.py",
+        """
+class FaultKind:
+    CRASH = "crash"
+
+class Fault:
+    def __init__(self, kind, at=0.0):
+        self.kind = kind
+
+class Plan:
+    def crash(self):
+        self.faults.append(Fault(FaultKind.CRASH))
+
+    def due(self):
+        return [f for f in self.faults if f.kind == FaultKind.CRASH]
+""",
+        0),
+)
+
+
+def run_selftest(rules=None, out=print) -> int:
+    """Run the fixtures (optionally a rule subset); 0 = all green.
+
+    Each fixture lints ALONE in a fresh temp tree, so table-style rules
+    (op-table, fault-pairing) see exactly the fixture's protocol."""
+    wanted = set(rules) if rules else None
+    ran = failed = 0
+    for fx in FIXTURES:
+        if wanted is not None and fx.rule not in wanted:
+            continue
+        ran += 1
+        with tempfile.TemporaryDirectory(prefix="platform-lint-st-") as td:
+            target = os.path.join(td, fx.rel)
+            os.makedirs(os.path.dirname(target), exist_ok=True)
+            with open(target, "w", encoding="utf-8") as fh:
+                fh.write(fx.code)
+            report = run_lint(td, paths=[target], rules=[fx.rule])
+        n = len(report.findings)
+        ok = (n == 0) if fx.expect == 0 else (
+            n >= fx.expect
+            and all(fx.needle in f.message for f in report.findings))
+        if ok:
+            out(f"  ok   {fx.name}")
+        else:
+            failed += 1
+            out(f"  FAIL {fx.name}: expected "
+                f"{'clean' if fx.expect == 0 else f'>={fx.expect} findings'}"
+                f" with {fx.needle!r}, got {n}:")
+            for f in report.findings:
+                out(f"       {f}")
+    out(f"self-test: {ran - failed}/{ran} fixtures green")
+    return 1 if failed else 0

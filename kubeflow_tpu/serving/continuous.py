@@ -46,6 +46,25 @@ TPU-first design (vs vLLM's CUDA paged-attention kernels):
 
 All buffers are donated across dispatches, so the pool cache exists in
 HBM exactly once.
+
+**Thread contract — the mailbox seam.**  Scheduler state (the slot
+table, ``_waiting``, the block allocator and per-slot block tables, the
+donated pool buffers, the ``_migrating`` freeze map) is owned by the
+scheduler thread, full stop.  The ONE blessed path for any other
+thread — HTTP handlers, migration workers, the traffic plane's
+preemptor, resize orchestration — to mutate it is the migration
+mailbox: post an op with ``_post_migration_op`` (or ``_queue.put`` for
+plain submission) and the scheduler services it between dispatches in
+``_service_migrations``, on the thread that owns the state.
+Cross-thread READS are allowed GIL-copy style (``list(engine._slots)``)
+but every decision made from one must be re-validated by the mailbox op
+that acts on it — the snapshot is stale by construction.  The
+analyzer's ``thread-affinity`` rule (analysis/rules_threads.py)
+enforces the write half mechanically: an owned-state write reachable
+from a non-scheduler role fails tier-1 unless pragma'd with a reason.
+The seam needs no allowlist precisely because posting to the queue is
+not a write — ``export_sequence`` never touches the pool, and
+``_mig_export`` is only reachable from ``_loop``.
 """
 
 from __future__ import annotations
@@ -1275,6 +1294,10 @@ class ContinuousEngine:
         self.num_blocks = int(num_blocks)
         self._alloc = (BlockAllocator(self.num_blocks, self.block_size)
                        if self.paged else None)
+        #: optional analysis/runtime.py BlockLedger: shadow-refcount
+        #: audit of the block economy + the kv_blocks_leaked_total
+        #: gauge; attach via attach_block_ledger (tests, chaos, benches)
+        self.block_ledger = None
         #: per-slot block tables (host ints; the dispatch-side arrays are
         #: assembled fresh per dispatch in _block_tables)
         self._slot_blocks: list[list[int]] = [[] for _ in range(num_slots)]
@@ -2218,6 +2241,13 @@ class ContinuousEngine:
                     0.0 if allocated == 0 else round(max(
                         0.0, 1.0 - live_tokens
                         / (allocated * self.block_size)), 4)),
+                # zero-leaked-blocks invariant (analysis/runtime.py
+                # BlockLedger): blocks still referenced at a quiesce
+                # boundary that no live slot holds; 0 without a ledger
+                # attached (nothing audited = nothing claimed)
+                "kv_blocks_leaked_total": (
+                    self.block_ledger.leaked_total
+                    if self.block_ledger is not None else 0),
             }
         else:
             paged = {
@@ -2225,6 +2255,7 @@ class ContinuousEngine:
                 "kv_blocks_free": 0, "kv_blocks_cow_copies_total": 0,
                 "prefix_block_hits_total": 0,
                 "kv_fragmentation_ratio": 0.0,
+                "kv_blocks_leaked_total": 0,
             }
         return {
             **paged,
@@ -2305,6 +2336,11 @@ class ContinuousEngine:
                 req.error = RuntimeError("engine shut down")
                 req.done.set()
         self._fail_migration_waiters(RuntimeError("engine shut down"))
+        if self.block_ledger is not None and self._alloc is not None:
+            # terminal boundary audit: blocks still referenced that no
+            # slot owns are leaks even when the engine dies — the gauge
+            # must say so before the allocator is garbage
+            self._audit_blocks_now()
 
     # -- scheduler loop ----------------------------------------------------
 
@@ -2757,6 +2793,11 @@ class ContinuousEngine:
                         raise
                     continue
             self._slot_blocks[slot] = table
+            if self.block_ledger is not None:
+                # per-sequence ledger attribution: a leak report names
+                # the owning slot + admission path, not just a block id
+                self.block_ledger.annotate(self._alloc, table,
+                                           f"slot{slot}:admit")
             # the shared prefix IS real KV content at [0, start) — the
             # prefix matcher's ground truth from the first chunk on
             self._slot_content[slot] = list(prompt[:start])
@@ -2936,6 +2977,67 @@ class ContinuousEngine:
         prefix-matchable here until its blocks are actually reused."""
         self._post_migration_op("release", req, None, timeout)
 
+    # -- block-ledger audit (analysis/runtime.py BlockLedger) --------------
+
+    def attach_block_ledger(self, ledger) -> None:
+        """Wrap this engine's BlockAllocator with an analysis
+        :class:`~kubeflow_tpu.analysis.runtime.BlockLedger`.
+
+        From then on every alloc/ref/release is conservation-checked as
+        it happens, the scheduler audits the zero-leaked-blocks
+        invariant whenever the pool goes fully idle, and ``stats()``
+        exports the shared ``kv_blocks_leaked_total`` tally (surfaced
+        as a /metrics gauge by the model server).  One ledger may span
+        several engines (migration source+destination, resize
+        old+new) — the tally is the union.  Attach at a QUIESCENT
+        boundary: before traffic for a complete ledger, or while the
+        scheduler is idle (the books open at the current refcounts; an
+        economy op racing the attach itself would slip past the shadow
+        snapshot and later read as spurious drift)."""
+        if not self.paged:
+            raise RuntimeError(
+                "block ledger requires the paged pool (block_size > 0)")
+        ledger.attach(self._alloc)
+        self.block_ledger = ledger
+
+    def audit_blocks(self, timeout: float = 60.0) -> list:
+        """On-demand zero-leak audit at a consistent boundary: runs on
+        the scheduler thread via the migration mailbox (between
+        dispatches, after any in-flight admission/retirement), so the
+        held-block set it audits against cannot be mid-mutation.
+        Returns the leak records (empty = invariant holds).  The tests'
+        per-scenario ad-hoc ``kv_blocks_free == num_blocks`` asserts
+        collapse onto this one call."""
+        if self.block_ledger is None:
+            return []
+        if self._stop.is_set() and (
+                self._thread is None or not self._thread.is_alive()):
+            # post-shutdown boundary (resize retired this engine, a test
+            # audits after stop): no scheduler to race — audit directly
+            return self._audit_blocks_now()
+        return self._post_migration_op("audit", None, None,
+                                       timeout)["leaks"]
+
+    def _held_blocks(self) -> list[int]:
+        """Blocks legitimately referenced right now: live/frozen slot
+        tables.  A frozen migrating slot keeps its blocks by design
+        (copy-then-cutover) and chunked-prefill reservations set
+        ``_slots[slot]`` up front, so the slot table is the complete
+        ownership record."""
+        held: list[int] = []
+        for slot, blocks in enumerate(self._slot_blocks):
+            if blocks and (self._slots[slot] is not None
+                           or slot in self._migrating):
+                held.extend(blocks)
+        return held
+
+    def _audit_blocks_now(self) -> list:
+        """Scheduler-thread audit body (mailbox op + idle hook)."""
+        if self.block_ledger is None or self._alloc is None:
+            return []
+        return self.block_ledger.audit_quiesced(
+            self._alloc, held=self._held_blocks())
+
     def observe_migration_ms(self, ms: float) -> None:
         """Record one completed migration's export->ack latency into
         the kv_migrate_latency_ms histogram."""
@@ -3004,6 +3106,8 @@ class ContinuousEngine:
                     self._mig_resume(a)
                 elif kind == "take_waiting":
                     self._mig_take_waiting(out)
+                elif kind == "audit":
+                    out["leaks"] = self._audit_blocks_now()
                 elif kind == "live_slots":
                     out["reqs"] = [r for r in self._slots
                                    if r is not None
@@ -3210,6 +3314,9 @@ class ContinuousEngine:
                 req.tokens = list(generated)
             self._slots[slot] = req
             self._slot_blocks[slot] = [int(b) for b in table]
+            if self.block_ledger is not None:
+                self.block_ledger.annotate(self._alloc, table,
+                                           f"slot{slot}:import")
             req.slot = slot
             req.admitted_step = self.step_counter
             if phase == "prefill":
@@ -3478,6 +3585,12 @@ class ContinuousEngine:
                         or not self._queue.empty()
                         or not self._migrate_q.empty()):
                     continue  # _process freed slots or work arrived
+                if self.block_ledger is not None and not self._migrating:
+                    # fully idle, nothing frozen: every block still
+                    # referenced outside a slot table is a leak — the
+                    # ledger counts each once, so idle re-audits are
+                    # free
+                    self._audit_blocks_now()
                 self._wake.wait(timeout=0.05)
                 self._wake.clear()
                 continue

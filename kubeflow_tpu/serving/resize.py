@@ -558,6 +558,12 @@ class GangResizer:
             else:
                 new = contlib.ContinuousEngine(
                     src.cfg, new_params, mesh_axes=mesh_axes, **kw)
+            if getattr(src, "block_ledger", None) is not None and new.paged:
+                # the zero-leaked-blocks audit follows the pool across
+                # the resize: one ledger, both degrees' allocators —
+                # kill-mid-resize leaks on EITHER side land in the same
+                # kv_blocks_leaked_total tally
+                new.attach_block_ledger(src.block_ledger)
             self._fail("reshard")
             # rebuild the warmed-program ladder at the new degree: a
             # post-resize dispatch must never compile mid-serving (gang

@@ -190,7 +190,8 @@ def main():
         try:
             r = compile_candidate(devs, cfg, **cand)
             r["topology"] = topo_for[cand["tp"]]
-        except Exception as e:  # keep the sweep going; record the failure
+        except Exception as e:  # noqa: BLE001 — keep the sweep going;
+            # the failure is recorded in the result row, not swallowed
             r = {**cand, "error": f"{type(e).__name__}: {e}"}
         results.append(r)
         print(json.dumps(r), file=sys.stderr)

@@ -245,7 +245,8 @@ def main():
         print(f"compiling {cand} ...", file=sys.stderr)
         try:
             r = compile_candidate(devs, model_cfg=model_cfg, **cand)
-        except Exception as e:  # keep the sweep going; record the failure
+        except Exception as e:  # noqa: BLE001 — keep the sweep going;
+            # the failure is recorded in the result row, not swallowed
             r = {**cand, "error": f"{type(e).__name__}: {e}"}
         results.append(r)
         print(json.dumps(r), file=sys.stderr)
@@ -270,7 +271,8 @@ def main():
             print(f"compiling v5e-32 {cand} ...", file=sys.stderr)
             try:
                 r = compile_candidate(devs32, model_cfg=model_cfg, **cand)
-            except Exception as e:
+            except Exception as e:  # noqa: BLE001 — keep the sweep
+                # going; the failure is recorded in the result row
                 r = {**cand, "error": f"{type(e).__name__}: {e}"}
             r["topology"] = "v5e:4x8 (2 slices over DCN)"
             results.append(r)

@@ -109,7 +109,8 @@ def main() -> None:
         cfg = llamalib.LlamaConfig(**{**base, **overrides})
         try:
             result = measure(cfg, batch, seq)
-        except Exception as e:  # OOM etc. — record and keep sweeping
+        except Exception as e:  # noqa: BLE001 — OOM etc.: the failure
+            # is recorded in the result row and the sweep continues
             result = {"error": f"{type(e).__name__}: {str(e)[:200]}"}
         print(json.dumps({"config": name, "batch": batch, "seq": seq, **result}),
               flush=True)

@@ -9,10 +9,21 @@ next to the other scripts/):
     python scripts/platform_lint.py --update-baseline
     python scripts/platform_lint.py --json
     python scripts/platform_lint.py --all            # list frozen debt too
+    python scripts/platform_lint.py --rule threads   # one concern only
+    python scripts/platform_lint.py --rule protocol  # op-table + fault-pairing
+    python scripts/platform_lint.py --self-test      # rule fixtures, no pytest
 
-Exit 0: no findings above kubeflow_tpu/analysis/baseline.json.
+Exit 0: no findings above kubeflow_tpu/analysis/baseline.json (or
+self-test green).
 Exit 1: NEW findings — fix, pragma (``# analysis: ok <rule> — why``),
-or re-freeze reviewed debt with --update-baseline.
+or re-freeze reviewed debt with --update-baseline; for --self-test, a
+rule stopped firing on its true positive or fired on its near miss.
+Exit 2: usage error.
+
+``--rule`` takes rule names or group aliases (dispatch, hygiene,
+locks, threads, protocol).  ``--self-test`` runs the built-in
+true-positive/near-miss fixture pair per rule (analysis/selftest.py) —
+the lint binary validating itself in tier-1 with no test framework.
 
 The same check runs as tier-1 (tests/test_analysis.py::TestRatchet), so
 every PR inherits it; this script is the fast pre-commit form.

@@ -23,6 +23,7 @@ import pytest
 
 from kubeflow_tpu.analysis import astlint
 from kubeflow_tpu.analysis.runtime import (
+    BlockLedger,
     LockAudit,
     RecompileCounter,
     recompile_guard,
@@ -516,6 +517,422 @@ def pragma():
         assert lint_snippet(tmp_path, code, ["nondaemon-thread"]) == []
 
 
+class TestThreadAffinityRule:
+    """ISSUE 11 tentpole: scheduler-owned state mutates only on the
+    scheduler thread (or through the mailbox seam)."""
+
+    def test_public_api_write_flagged(self, tmp_path):
+        code = """
+class FooEngine:
+    def _loop(self):
+        self._admit()
+
+    def _admit(self):
+        self._waiting.sort()
+
+    def submit(self, req):
+        self._waiting.append(req)
+"""
+        found = lint_snippet(tmp_path, code, ["thread-affinity"])
+        assert len(found) == 1
+        assert found[0].scope == "FooEngine.submit"
+        assert "_waiting" in found[0].message
+        assert "mailbox" in found[0].message
+
+    def test_spawned_thread_write_flagged(self, tmp_path):
+        code = """
+import threading
+
+class FooEngine:
+    def _loop(self):
+        pass
+
+    def _start_worker(self):
+        threading.Thread(target=self._worker, daemon=True).start()
+
+    def _worker(self):
+        self._slots[0] = None
+"""
+        found = lint_snippet(tmp_path, code, ["thread-affinity"])
+        assert {f.scope for f in found} == {"FooEngine._worker"}
+        assert "_slots" in found[0].message
+
+    def test_mailbox_post_is_clean(self, tmp_path):
+        """The blessed seam: external entries that only POST to the
+        queue never touch owned state — the scheduler-side servicer
+        (reachable from _loop) does, and that classifies as scheduler."""
+        code = """
+class FooEngine:
+    def _loop(self):
+        self._service()
+
+    def _service(self):
+        kind, a = self._migrate_q.get_nowait()
+        self._waiting.append(a)
+
+    def submit(self, req):
+        self._migrate_q.put(("admit", req))
+"""
+        assert lint_snippet(tmp_path, code, ["thread-affinity"]) == []
+
+    def test_public_entry_also_called_by_scheduler_flagged(self, tmp_path):
+        """Scheduler reachability does not EXEMPT a public entry: a
+        method the scheduler calls internally that is also invokable
+        cross-thread writes on two threads."""
+        code = """
+class FooEngine:
+    def _loop(self):
+        self.flush()
+
+    def flush(self):
+        self._waiting.clear()
+"""
+        found = lint_snippet(tmp_path, code, ["thread-affinity"])
+        assert len(found) == 1
+        assert found[0].scope == "FooEngine.flush"
+        assert "ALSO scheduler-reachable" in found[0].message
+
+    def test_shared_reachability_flagged(self, tmp_path):
+        """A helper reachable from BOTH the scheduler and a public
+        entry runs on two threads — the write is the race."""
+        code = """
+class FooEngine:
+    def _loop(self):
+        self._retire(0)
+
+    def _retire(self, slot):
+        self._slots[slot] = None
+
+    def evict(self, slot):
+        self._retire(slot)
+"""
+        found = lint_snippet(tmp_path, code, ["thread-affinity"])
+        assert len(found) == 1
+        assert found[0].scope == "FooEngine._retire"
+        assert "ALSO scheduler-reachable" in found[0].message
+
+    def test_lifecycle_and_reads_are_clean(self, tmp_path):
+        code = """
+class FooEngine:
+    def __init__(self):
+        self._waiting = []
+        self._slots = [None] * 4
+
+    def stop(self):
+        self._waiting.clear()
+
+    def stats(self):
+        return {"queue_depth": len(self._waiting)}
+"""
+        assert lint_snippet(tmp_path, code, ["thread-affinity"]) == []
+
+    def test_foreign_write_flagged_and_follow_carved_out(self, tmp_path):
+        code = """
+class Orchestrator:
+    def cutover(self, engine):
+        engine._slots[0] = None
+
+def follow(engine, channel):
+    engine._pool_cache = channel.next()
+"""
+        found = lint_snippet(tmp_path, code, ["thread-affinity"])
+        assert len(found) == 1
+        assert found[0].scope == "Orchestrator.cutover"
+        assert "foreign write" in found[0].message
+
+    def test_pragma_silences(self, tmp_path):
+        code = """
+class FooEngine:
+    def _loop(self):
+        pass
+
+    def drain(self):
+        # analysis: ok thread-affinity — runs post-join in shutdown
+        self._waiting.clear()
+"""
+        assert lint_snippet(tmp_path, code, ["thread-affinity"]) == []
+
+    def test_non_engine_class_out_of_scope(self, tmp_path):
+        code = """
+class Router:
+    def submit(self, req):
+        self._waiting.append(req)
+"""
+        assert lint_snippet(tmp_path, code, ["thread-affinity"]) == []
+
+    def test_outside_serving_ignored(self, tmp_path):
+        code = """
+class FooEngine:
+    def _loop(self):
+        pass
+
+    def submit(self, req):
+        self._waiting.append(req)
+"""
+        assert lint_snippet(tmp_path, code, ["thread-affinity"],
+                            rel="kubeflow_tpu/hpo/_fixture.py") == []
+
+
+class TestOpTableRule:
+    """ISSUE 11 tentpole: leader-publish / follower-replay completeness."""
+
+    DRIFTED = """
+def leader(ch, toks):
+    ch.publish(("alpha", toks))
+    ch.publish(("beta", toks))
+
+def follow(channel):
+    while True:
+        msg = channel.next()
+        op = msg[0]
+        if op == "alpha":
+            continue
+        raise RuntimeError(f"unknown gang op {op!r}")
+"""
+
+    def test_seeded_drift_published_without_arm(self, tmp_path):
+        """The acceptance fixture: a published op whose follow() arm
+        was deleted MUST be caught."""
+        found = lint_snippet(tmp_path, self.DRIFTED, ["op-table"])
+        assert len(found) == 1
+        assert "`beta`" in found[0].message
+        assert "no follower replay arm" in found[0].message
+
+    def test_dead_arm_flagged(self, tmp_path):
+        code = """
+def leader(ch, toks):
+    ch.publish(("alpha", toks))
+
+def follow(channel):
+    while True:
+        msg = channel.next()
+        op = msg[0]
+        if op == "alpha":
+            continue
+        elif op == "ghost":
+            continue
+"""
+        found = lint_snippet(tmp_path, code, ["op-table"])
+        assert len(found) == 1
+        assert "dead replay arm" in found[0].message
+        assert "`ghost`" in found[0].message
+
+    def test_cross_file_pairing(self, tmp_path):
+        """resize.py publishes, gang.py replays — the table is the
+        UNION across the serving layer."""
+        pub = """
+def orchestrate(channel):
+    channel.publish(("resize", {}))
+"""
+        arm = """
+def follow(channel):
+    while True:
+        msg = channel.next()
+        op = msg[0]
+        if op == "resize":
+            continue
+"""
+        root = tmp_path
+        a = root / "kubeflow_tpu/serving/_rz.py"
+        b = root / "kubeflow_tpu/serving/_gg.py"
+        a.parent.mkdir(parents=True, exist_ok=True)
+        a.write_text(pub)
+        b.write_text(arm)
+        report = astlint.run_lint(str(root), paths=[str(a), str(b)],
+                                  rules=["op-table"])
+        assert report.findings == []
+
+    def test_unrelated_op_local_ignored(self, tmp_path):
+        """A local named ``op`` outside a replay loop (no ``op =
+        msg[0]`` binding) contributes no arms."""
+        code = """
+def eval_condition(condition):
+    for op in ("==", "!="):
+        if op == "==":
+            return True
+"""
+        assert lint_snippet(tmp_path, code, ["op-table"]) == []
+
+    def test_pragma_silences_leader_only_op(self, tmp_path):
+        code = """
+def leader(ch, blob):
+    ch.publish(("debug_dump", blob))  # analysis: ok op-table — leader-only
+
+def follow(channel):
+    msg = channel.next()
+    op = msg[0]
+    if op == "stop":
+        return
+    ch2 = None
+
+def leader2(ch):
+    ch.publish(("stop",))
+"""
+        assert lint_snippet(tmp_path, code, ["op-table"]) == []
+
+    def test_pragma_on_any_site_silences_the_op(self, tmp_path):
+        """The table ENTRY is the unit of intent: two files publish the
+        same leader-only op and the pragma sits on the site sorted
+        LAST — the entry must still be silenced (the old anchor-first
+        bookkeeping ignored every pragma but the first site's)."""
+        pub_a = """
+def leader(ch, blob):
+    ch.publish(("debug_dump", blob))
+"""
+        pub_b = """
+def mirror(ch, blob):
+    ch.publish(("debug_dump", blob))  # analysis: ok op-table — leader-only
+
+def follow(channel):
+    msg = channel.next()
+    op = msg[0]
+    if op == "keep":
+        return
+
+def leader2(ch):
+    ch.publish(("keep",))
+"""
+        root = tmp_path
+        a = root / "kubeflow_tpu/serving/_aa.py"  # sorts BEFORE _zz
+        b = root / "kubeflow_tpu/serving/_zz.py"
+        a.parent.mkdir(parents=True, exist_ok=True)
+        a.write_text(pub_a)
+        b.write_text(pub_b)
+        report = astlint.run_lint(str(root), paths=[str(a), str(b)],
+                                  rules=["op-table"])
+        assert report.findings == []
+
+    def test_path_scoped_lint_sees_whole_table(self):
+        """The pre-commit fast path — linting ONE changed file — must
+        not report cross-file pairings as drift: resize.py alone
+        publishes resize/resize_abort/resize_commit whose arms live in
+        gang.py, and the table is built from the whole scope."""
+        rz = os.path.join(REPO_ROOT, "kubeflow_tpu", "serving",
+                          "resize.py")
+        report = astlint.run_lint(REPO_ROOT, paths=[rz],
+                                  rules=["op-table"])
+        assert report.findings == []
+        # same shape for the chaos pairing: net.py alone consumes
+        # nothing plan.py doesn't produce
+        net = os.path.join(REPO_ROOT, "kubeflow_tpu", "chaos", "net.py")
+        report = astlint.run_lint(REPO_ROOT, paths=[net],
+                                  rules=["fault-pairing"])
+        assert report.findings == []
+
+    def test_real_gang_protocol_is_complete(self):
+        """The live contract: every op gang.py/resize.py publishes has
+        a follow() arm and vice versa (the rule sees 24 real ops)."""
+        paths = [os.path.join(REPO_ROOT, "kubeflow_tpu", "serving", f)
+                 for f in ("gang.py", "resize.py")]
+        report = astlint.run_lint(REPO_ROOT, paths=paths,
+                                  rules=["op-table"])
+        assert report.findings == []
+        from kubeflow_tpu.analysis import rules_protocol as rp
+
+        ctx = astlint.parse_paths(REPO_ROOT, paths)
+        pub = {op for pf in ctx.files.values()
+               for op, _ in rp._published_ops(pf)}
+        assert len(pub) >= 20  # the table is genuinely populated
+
+
+class TestFaultPairingRule:
+    COMPLETE = """
+class FaultKind:
+    CRASH = "crash"
+
+class Fault:
+    def __init__(self, kind, at=0.0):
+        self.kind = kind
+
+class Plan:
+    def crash(self):
+        self.faults.append(Fault(FaultKind.CRASH))
+
+    def due(self):
+        return [f for f in self.faults if f.kind == FaultKind.CRASH]
+"""
+
+    def test_unconsumed_kind_flagged(self, tmp_path):
+        code = self.COMPLETE.replace(
+            'CRASH = "crash"', 'CRASH = "crash"\n    GHOST = "ghost"'
+        ).replace(
+            "def due(self):",
+            "def ghost(self):\n"
+            "        self.faults.append(Fault(FaultKind.GHOST))\n\n"
+            "    def due(self):")
+        found = lint_snippet(tmp_path, code, ["fault-pairing"],
+                             rel="kubeflow_tpu/chaos/_fixture.py")
+        assert len(found) == 1
+        assert "GHOST" in found[0].message
+        assert "never fire" in found[0].message
+
+    def test_dead_actuator_arm_flagged(self, tmp_path):
+        code = self.COMPLETE.replace(
+            "if f.kind == FaultKind.CRASH",
+            "if f.kind in (FaultKind.CRASH, FaultKind.PHANTOM)")
+        found = lint_snippet(tmp_path, code, ["fault-pairing"],
+                             rel="kubeflow_tpu/chaos/_fixture.py")
+        assert len(found) == 1
+        assert "PHANTOM" in found[0].message
+
+    def test_paired_is_clean_and_scope_is_chaos_only(self, tmp_path):
+        assert lint_snippet(tmp_path, self.COMPLETE, ["fault-pairing"],
+                            rel="kubeflow_tpu/chaos/_fixture.py") == []
+        # the same drifted code OUTSIDE chaos/ is not this rule's business
+        drifted = self.COMPLETE.replace(
+            'CRASH = "crash"', 'CRASH = "crash"\n    GHOST = "ghost"')
+        assert lint_snippet(tmp_path, drifted, ["fault-pairing"],
+                            rel="kubeflow_tpu/serving/_fixture.py") == []
+
+    def test_real_fault_plan_is_paired(self):
+        plan = os.path.join(REPO_ROOT, "kubeflow_tpu", "chaos", "plan.py")
+        report = astlint.run_lint(REPO_ROOT, paths=[plan],
+                                  rules=["fault-pairing"])
+        assert report.findings == []
+
+
+class TestLockGraphCoverage:
+    """ISSUE 11 satellite: resize.py/traffic.py's PR 8/9 locks and
+    Conditions are IN the nesting graph, and it stays acyclic."""
+
+    def test_cv_suffix_is_lockish(self, tmp_path):
+        """``_ack_cv`` (resize.py's reshard Condition) now matches the
+        lexical lock matcher — a blocking call under it is seen."""
+        code = """
+import threading
+import time
+
+class ReshardServer:
+    def run(self):
+        with self._ack_cv:
+            time.sleep(1.0)
+"""
+        found = lint_snippet(tmp_path, code, ["lock-order"],
+                             rel="kubeflow_tpu/serving/_rz.py")
+        assert len(found) == 1
+        assert "ReshardServer._ack_cv" in found[0].message
+
+    def test_repo_lock_graph_acyclic_and_covers_new_modules(self):
+        from kubeflow_tpu.analysis.rules_locks import (
+            _iter_with_locks,
+            collect_lock_graph,
+            find_cycles,
+        )
+
+        ctx = astlint.parse_paths(REPO_ROOT, astlint.discover(REPO_ROOT))
+        edges, _blocking = collect_lock_graph(ctx)
+        assert find_cycles(edges) == []
+        # the scan actually SEES the PR 8/9 synchronization: resize.py's
+        # _ack_cv Condition and traffic.py's plane lock register as
+        # with-acquisitions
+        rz = ctx.files["kubeflow_tpu/serving/resize.py"]
+        tf = ctx.files["kubeflow_tpu/serving/traffic.py"]
+        rz_locks = {name for name, _ in _iter_with_locks(rz)}
+        tf_locks = {name for name, _ in _iter_with_locks(tf)}
+        assert any("_ack_cv" in n for n in rz_locks), rz_locks
+        assert any("_lock" in n or "cond" in n for n in tf_locks), tf_locks
+
+
 class TestRatchet:
     """The tier-1 gate: the repo must lint clean against its baseline."""
 
@@ -532,10 +949,13 @@ class TestRatchet:
 
     def test_baseline_shrank_from_prefix_count(self):
         """The rules landed with the debt burned down, not frozen: 33
-        findings pre-fix (18 swallowed-exception, 11 host-sync, 4
-        lock-order blocking-under-lock), <= 8 frozen after."""
+        findings pre-fix at PR 3 (18 swallowed-exception, 11 host-sync,
+        4 lock-order blocking-under-lock), <= 8 frozen after; ISSUE 11
+        justified the last 4 sweep-recorder sites (`# noqa: BLE001 —
+        <reason>`), so the whole platform now lints CLEAN under all
+        seven rules — the ratchet floor is zero and must stay there."""
         baseline = astlint.load_baseline(astlint.baseline_path(REPO_ROOT))
-        assert 0 < sum(baseline.values()) <= 8
+        assert sum(baseline.values()) == 0
 
     def test_key_is_line_number_free(self):
         f1 = astlint.Finding("r", "p.py", 10, "S.f", "msg")
@@ -559,11 +979,15 @@ class TestCli:
         assert main(["--json"]) == 0
         out = jsonlib.loads(capsys.readouterr().out)
         assert out["new"] == []
-        assert out["total"] == out["baseline_total"]
-        # against an EMPTY baseline the frozen debt is "new" -> 1
-        empty = tmp_path / "empty.json"
-        empty.write_text('{"findings": {}}')
-        assert main(["--baseline", str(empty)]) == 1
+        assert out["total"] == out["baseline_total"] == 0
+        # a seeded violation against the (empty) baseline -> exit 1
+        bad = tmp_path / "kubeflow_tpu" / "serving" / "_drift.py"
+        bad.parent.mkdir(parents=True)
+        bad.write_text("class XEngine:\n"
+                       "    def _loop(self):\n"
+                       "        return self.buf.item()\n")
+        assert main(["--root", str(tmp_path)]) == 1
+        capsys.readouterr()
 
     def test_update_baseline_roundtrip(self, tmp_path):
         from kubeflow_tpu.analysis.__main__ import main
@@ -572,6 +996,121 @@ class TestCli:
         assert main(["--update-baseline", "--baseline", str(bl)]) == 0
         # immediately after freezing, the ratchet is green
         assert main(["--baseline", str(bl)]) == 0
+
+    def test_rule_group_aliases(self, capsys):
+        from kubeflow_tpu.analysis.__main__ import main, resolve_rules
+
+        assert resolve_rules(["threads"]) == ["thread-affinity"]
+        assert resolve_rules(["protocol"]) == ["op-table", "fault-pairing"]
+        assert resolve_rules(["op-table", "protocol"]) == [
+            "op-table", "fault-pairing"]
+        # the aliases are real argv: a subset lint over a clean repo
+        assert main(["--rule", "threads", "--rule", "protocol"]) == 0
+        capsys.readouterr()
+
+    def test_self_test_green_and_rule_filterable(self, capsys):
+        from kubeflow_tpu.analysis.__main__ import main
+
+        assert main(["--self-test"]) == 0
+        out = capsys.readouterr().out
+        assert "op-table/true-positive" in out
+        assert "FAIL" not in out
+        assert main(["--self-test", "--rule", "protocol"]) == 0
+        out = capsys.readouterr().out
+        assert "host-sync" not in out  # filtered down to the group
+
+    def test_self_test_rejects_lint_flags(self, capsys):
+        """--self-test never honors the lint contract (--json output,
+        baseline writes), so combining them is a usage error (exit 2),
+        not a silent success with the wrong stdout."""
+        from kubeflow_tpu.analysis.__main__ import main
+
+        for argv in (["--self-test", "--json"],
+                     ["--self-test", "--update-baseline"],
+                     ["--self-test", "somefile.py"]):
+            with pytest.raises(SystemExit) as ei:
+                main(argv)
+            assert ei.value.code == 2
+            capsys.readouterr()
+
+    def test_self_test_catches_a_broken_rule(self, capsys, monkeypatch):
+        """The self-test is a real check, not a rubber stamp: gut a
+        fixture's expectation and the binary exits 1."""
+        from kubeflow_tpu.analysis import selftest
+
+        broken = tuple(
+            selftest.Fixture(fx.rule, fx.name, fx.rel, "x = 1\n",
+                             fx.expect, fx.needle)
+            if fx.name == "op-table/true-positive" else fx
+            for fx in selftest.FIXTURES)
+        monkeypatch.setattr(selftest, "FIXTURES", broken)
+        assert selftest.run_selftest(rules=["op-table"],
+                                     out=lambda *_: None) == 1
+
+
+class TestRatchetRoundTripNewRules:
+    """ISSUE 11: the two new rule modules ride the same ratchet — a
+    seeded drift is a NEW finding against any baseline that froze the
+    clean state."""
+
+    def test_thread_affinity_drift_fails_ratchet(self, tmp_path):
+        clean = """
+class FooEngine:
+    def _loop(self):
+        pass
+
+    def submit(self, req):
+        self._migrate_q.put(("admit", req))
+"""
+        drifted = clean.replace(
+            'self._migrate_q.put(("admit", req))',
+            "self._waiting.append(req)")
+        target = tmp_path / "kubeflow_tpu/serving/_eng.py"
+        target.parent.mkdir(parents=True)
+        target.write_text(clean)
+        report = astlint.run_lint(str(tmp_path), paths=[str(target)],
+                                  rules=["thread-affinity"])
+        baseline = {k: v for k, v in report.counts().items()}
+        assert baseline == {}  # clean state froze empty
+        target.write_text(drifted)
+        report2 = astlint.run_lint(str(tmp_path), paths=[str(target)],
+                                   rules=["thread-affinity"])
+        new = astlint.compare_to_baseline(report2, baseline)
+        assert len(new) == 1 and "_waiting" in new[0].message
+
+    def test_op_table_drift_fails_ratchet(self, tmp_path):
+        """The acceptance bar end to end: freeze a complete protocol,
+        delete one follow() arm, the ratchet goes red."""
+        complete = """
+def leader(ch, toks):
+    ch.publish(("alpha", toks))
+    ch.publish(("beta", toks))
+
+def follow(channel):
+    while True:
+        msg = channel.next()
+        op = msg[0]
+        if op == "alpha":
+            continue
+        elif op == "beta":
+            continue
+"""
+        drifted = complete.replace("        elif op == \"beta\":\n"
+                                   "            continue\n", "")
+        target = tmp_path / "kubeflow_tpu/serving/_gang.py"
+        target.parent.mkdir(parents=True)
+        target.write_text(complete)
+        report = astlint.run_lint(str(tmp_path), paths=[str(target)],
+                                  rules=["op-table"])
+        assert report.findings == []
+        baseline = report.counts()
+        target.write_text(drifted)
+        report2 = astlint.run_lint(str(tmp_path), paths=[str(target)],
+                                   rules=["op-table"])
+        new = astlint.compare_to_baseline(report2, baseline)
+        assert len(new) == 1
+        assert "`beta`" in new[0].message
+        assert "no follower replay arm" in new[0].message
 
 
 class TestRecompileGuard:
@@ -669,3 +1208,130 @@ class TestLockAudit:
             t.join(timeout=30)
         assert audit.inversions() == []
         assert "Store._lock" in audit.report()["locks"]
+
+
+class TestBlockLedger:
+    """ISSUE 11 tentpole: the block-economy runtime auditor."""
+
+    def _alloc(self, n=8, bs=4):
+        from kubeflow_tpu.serving.paged import BlockAllocator
+
+        return BlockAllocator(n, bs)
+
+    def test_conservation_through_alloc_ref_release(self):
+        a = self._alloc()
+        led = BlockLedger()
+        led.attach(a, name="unit")
+        t = a.alloc(3)
+        led.annotate(a, t, "seqA")
+        a.ref(t[:1])          # prefix share
+        a.release(t[:1])      # sharer retires
+        a.release(t)          # owner retires
+        assert led.conservation_errors == []
+        assert led.audit_quiesced(a) == []
+        assert led.verify(a) == []
+        assert led.leaked_total == 0
+
+    def test_leak_detected_once_with_attribution(self):
+        a = self._alloc()
+        led = BlockLedger()
+        led.attach(a, name="unit")
+        t = a.alloc(2)
+        led.annotate(a, t, "seq7")
+        leaks = led.audit_quiesced(a)          # nothing held -> leaks
+        assert [d["block"] for d in leaks] == sorted(int(b) for b in t)
+        assert all(d["owner"] == "seq7" for d in leaks)
+        assert led.leaked_total == 2
+        # re-audit of the SAME leak is free (gauge, not a treadmill)
+        led.audit_quiesced(a)
+        assert led.leaked_total == 2
+        # a held block is not a leak
+        assert led.audit_quiesced(a, held=t) == []
+        a.release(t)
+        assert led.audit_quiesced(a) == []
+
+    def test_resurrection_and_double_grant_detection(self):
+        a = self._alloc()
+        led = BlockLedger()
+        led.attach(a, name="unit")
+        t = a.alloc(2)
+        a.release(t)
+        a.ref(t)              # resurrect out of the free list
+        assert led.conservation_errors == []
+        assert sorted(led.live(a)) == sorted(int(b) for b in t)
+        a.release(t)
+        # bypassing the wrapped verbs IS the drift the ledger exists
+        # to catch: fake an unbalanced release
+        a._refs[int(t[0])] = 1
+        led.verify(a)
+        assert led.conservation_errors  # shadow/real drift recorded
+
+    def test_attach_is_idempotent_and_books_preexisting(self):
+        a = self._alloc()
+        pre = a.alloc(2)
+        led = BlockLedger()
+        led.attach(a)
+        led.attach(a)          # no double wrap
+        assert sorted(led.live(a)) == sorted(int(b) for b in pre)
+        a.release(pre)
+        assert led.audit_quiesced(a) == []
+
+    def test_engine_end_to_end_seeded_leak_is_caught(self):
+        """The acceptance fixture: a deliberate leak in a LIVE engine
+        is caught by the automatic idle audit and surfaces on the
+        kv_blocks_leaked_total stats gauge."""
+        import time
+
+        import jax
+        import jax.numpy as jnp
+
+        from kubeflow_tpu.models import llama as llamalib
+        from kubeflow_tpu.serving.continuous import ContinuousEngine
+
+        cfg = llamalib.tiny()
+        params = llamalib.Llama(cfg).init(
+            jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32))["params"]
+        eng = ContinuousEngine(cfg, params, num_slots=2, block_size=16,
+                               decode_chunk=2, prefix_cache=False)
+        ledger = BlockLedger()
+        eng.attach_block_ledger(ledger)
+        try:
+            req = eng.submit([1, 2, 3, 4, 5], max_new_tokens=6)
+            req.wait(180)
+            assert len(req.tokens) == 6
+            # clean run: boundary audit + gauge both at zero
+            assert eng.audit_blocks() == []
+            assert eng.stats()["kv_blocks_leaked_total"] == 0
+            assert ledger.conservation_errors == []
+            # seed the leak: grab blocks and "forget" them
+            eng._alloc.alloc(2)
+            deadline = time.time() + 15
+            while (time.time() < deadline
+                   and eng.stats()["kv_blocks_leaked_total"] == 0):
+                eng._wake.set()
+                time.sleep(0.05)
+            assert eng.stats()["kv_blocks_leaked_total"] == 2
+            leaks = eng.audit_blocks()
+            assert len(leaks) == 2
+        finally:
+            eng.stop()
+
+    def test_stop_runs_terminal_audit(self):
+        import jax
+        import jax.numpy as jnp
+
+        from kubeflow_tpu.models import llama as llamalib
+        from kubeflow_tpu.serving.continuous import ContinuousEngine
+
+        cfg = llamalib.tiny()
+        params = llamalib.Llama(cfg).init(
+            jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32))["params"]
+        eng = ContinuousEngine(cfg, params, num_slots=2, block_size=16,
+                               decode_chunk=2, prefix_cache=False)
+        ledger = BlockLedger()
+        eng.attach_block_ledger(ledger)
+        eng._alloc.alloc(1)    # leak, never audited while running
+        eng.stop()             # terminal boundary audit fires here
+        assert ledger.leaked_total == 1
+        # post-shutdown audit_blocks answers without a scheduler
+        assert len(eng.audit_blocks()) == 1

@@ -26,6 +26,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from kubeflow_tpu.analysis.runtime import BlockLedger
 from kubeflow_tpu.models import llama as llamalib
 from kubeflow_tpu.serving.continuous import (
     ContinuousEngine,
@@ -51,7 +52,22 @@ def make_engine(tiny_llama, **kw):
     kw.setdefault("decode_chunk", 2)
     kw.setdefault("prefix_cache", False)
     kw.setdefault("block_size", 16)
-    return ContinuousEngine(cfg, params, **kw)
+    eng = ContinuousEngine(cfg, params, **kw)
+    # every engine in this suite runs under the analyzer's block-economy
+    # audit (ISSUE 11): conservation checked per op, leaks counted into
+    # the kv_blocks_leaked_total gauge the tests assert
+    eng.attach_block_ledger(BlockLedger())
+    return eng
+
+
+def assert_no_leaks(*engines):
+    """The ONE zero-leak assert (replaces the suite's ad-hoc free-count
+    bookkeeping): a consistent-boundary audit on each engine, plus the
+    gauge and the per-op conservation record."""
+    for eng in engines:
+        assert eng.audit_blocks() == []
+        assert eng.stats()["kv_blocks_leaked_total"] == 0
+        assert eng.block_ledger.conservation_errors == []
 
 
 @pytest.fixture(scope="module")
@@ -86,7 +102,6 @@ class TestMigrationParity:
         src.warmup()
         dst.warmup()
         try:
-            base_free = src.stats()["kv_blocks_free"]
             req = src.submit(LONG, max_new_tokens=40)
             snap = _export_after(src, req, 3)
             assert snap is not None and snap["phase"] == "decode"
@@ -96,12 +111,10 @@ class TestMigrationParity:
             # zero recompiles on BOTH ends (warmed kv programs)
             assert src.stats()["jit_recompiles_total"] == 0
             assert dst.stats()["jit_recompiles_total"] == 0
-            # the source freed everything; the destination retires on
-            # completion (poll: retirement happens at a chunk boundary)
-            deadline = time.time() + 10
-            while src.stats()["kv_blocks_free"] != base_free:
-                assert time.time() < deadline
-                time.sleep(0.01)
+            # zero leaked blocks on BOTH ends: the ledger audit runs on
+            # each scheduler thread at a consistent boundary (replaces
+            # the old free-count bookkeeping + poll)
+            assert_no_leaks(src, dst)
             # one migration counts ONCE, on the importing side; the
             # source's outbound view is bytes + the latency histogram
             assert src.kv_migrations_total == 0
@@ -215,7 +228,9 @@ class TestMigrationSafety:
             assert snap is not None
             with pytest.raises(RuntimeError, match="blocks"):
                 dst.import_sequence(snap, req=req)
-            # nothing leaked on the destination, nothing held
+            # nothing leaked on the destination, nothing held — the
+            # ledger audit checks refcounts, not just the free count
+            assert_no_leaks(dst)
             assert dst.stats()["kv_blocks_free"] == 2
             src.resume_sequence(req)
             assert req.wait(120) == oracle["long40"]
@@ -283,17 +298,20 @@ class TestMigrationSafety:
         requests, migrating or not)."""
         src = make_engine(tiny_llama)
         try:
-            base_free = src.stats()["kv_blocks_free"]
             req = src.submit(LONG, max_new_tokens=40)
             snap = _export_after(src, req, 2)
             assert snap is not None
             req.cancel()
             # resume of a cancelled request is a no-op, never an error
             src.resume_sequence(req)
+            # the cancel sweep retires the slot at the next boundary;
+            # the ledger audit (mailbox-serviced AFTER that sweep's
+            # cycle) replaces the free-count poll
             deadline = time.time() + 10
-            while src.stats()["kv_blocks_free"] != base_free:
+            while any(r is not None for r in src._slots):
                 assert time.time() < deadline
                 time.sleep(0.01)
+            assert_no_leaks(src)
         finally:
             src.stop()
 
@@ -396,7 +414,6 @@ class TestDrainRebalance:
         src = make_engine(tiny_llama)
         dst = make_engine(tiny_llama)
         try:
-            base_free = src.stats()["kv_blocks_free"]
             r1 = src.submit(LONG, max_new_tokens=40)
             r2 = src.submit([7, 8, 9], max_new_tokens=12)
             deadline = time.time() + 120
@@ -407,7 +424,9 @@ class TestDrainRebalance:
             assert failed == 0 and moved >= 1
             assert r1.wait(120) == oracle["long40"]
             assert r2.wait(120) == oracle["short12"]
-            assert src.stats()["kv_blocks_free"] == base_free
+            # the drained source leaked nothing (ledger audit at a
+            # scheduler boundary, replacing the free-baseline compare)
+            assert_no_leaks(src, dst)
             assert src.stats()["kv_migrate_latency_ms_count"] == moved
             # defrag-for-free: the destination packed the sequences
             # into fresh blocks; nothing fragmented remains on src

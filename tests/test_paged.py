@@ -347,6 +347,10 @@ class TestPagedEdgeCases:
         eng = make_engine(tiny_llama, num_slots=2, decode_chunk=1,
                           prefix_cache=True, min_prefix=8,
                           prefill_budget=16)
+        from kubeflow_tpu.analysis.runtime import BlockLedger
+
+        ledger = BlockLedger()
+        eng.attach_block_ledger(ledger)
         inner_c, inner_f = eng._paged_chunk_for, eng._paged_fused_for
 
         def slow(getter):
@@ -375,9 +379,12 @@ class TestPagedEdgeCases:
                 time.sleep(0.01)
             assert all(r is None for r in eng._slots)
             assert eng.stats()["prefill_tokens_inflight"] == 0
-            # blocks returned: everything allocated is free again
-            st = eng.stats()
-            assert st["kv_blocks_free"] == st["kv_blocks_total"]
+            # blocks returned: the ledger audit replaces the ad-hoc
+            # free == total compare — it also catches refcount drift
+            # the count equality could mask
+            assert eng.audit_blocks() == []
+            assert eng.stats()["kv_blocks_leaked_total"] == 0
+            assert ledger.conservation_errors == []
             # ... and the >= 3 written chunks are still matchable
             got = eng.generate(LONG, max_new_tokens=6)
             assert eng.prefix_hits >= 1

@@ -32,6 +32,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from kubeflow_tpu.analysis.runtime import BlockLedger
 from kubeflow_tpu.models import llama as llamalib
 from kubeflow_tpu.serving.continuous import ContinuousEngine
 from kubeflow_tpu.serving.resize import (
@@ -62,7 +63,12 @@ PROMPT = list(range(1, 25))
 def make_engine(tiny_llama, mesh_axes=None, **kw):
     cfg, params = tiny_llama
     merged = {**KW, **kw}
-    return ContinuousEngine(cfg, params, mesh_axes=mesh_axes, **merged)
+    eng = ContinuousEngine(cfg, params, mesh_axes=mesh_axes, **merged)
+    # analyzer block-economy audit (ISSUE 11): GangResizer re-attaches
+    # the same ledger to every new-degree engine it builds, so "zero
+    # leaked blocks on both allocators" is ONE gauge across the resize
+    eng.attach_block_ledger(BlockLedger())
+    return eng
 
 
 @pytest.fixture(scope="module")
@@ -91,6 +97,13 @@ def _wait_all_free(eng, timeout=15):
     while eng.stats()["kv_blocks_free"] != eng.num_blocks:
         assert time.time() < deadline, eng.stats()
         time.sleep(0.01)
+    # the ledger audit is the leak oracle (the free-count poll above is
+    # only retirement synchronization): zero blocks referenced outside
+    # live slot tables, zero conservation drift, gauge at 0
+    if eng.block_ledger is not None:
+        assert eng.audit_blocks() == []
+        assert eng.stats()["kv_blocks_leaked_total"] == 0
+        assert eng.block_ledger.conservation_errors == []
 
 
 class TestReshardPlan:
@@ -173,14 +186,16 @@ class TestResizeParity:
             extras = [src.submit([7, 8, 9], max_new_tokens=12)
                       for _ in range(KW["num_slots"] + 1)]
             _wait_tokens(req, 4)
-            base_free = src.num_blocks
             new = rz.resize({"model": 1})
             assert new.mesh is None  # degree 1 IS the unmeshed engine
             assert req.wait(300) == oracle["long40"]
             for e in extras:
                 assert e.wait(300) == oracle["short12"]
-            # the SOURCE released everything before it stopped
-            assert src.stats()["kv_blocks_free"] == base_free
+            # the SOURCE released everything before it stopped — the
+            # shared ledger audits the retired allocator directly (the
+            # old free-count compare could not see a refcount drift)
+            assert src.audit_blocks() == []
+            assert src.stats()["kv_blocks_leaked_total"] == 0
             assert new.stats()["jit_recompiles_total"] == 0
             # grow back with a live conversation aboard
             req2 = new.submit(PROMPT, max_new_tokens=40)
