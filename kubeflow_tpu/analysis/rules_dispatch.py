@@ -47,6 +47,12 @@ ROOT_METHODS = ("_loop", "_loop_inner", "_admit", "_process", "step",
 
 _MAKE_PROGRAM = re.compile(r"^make_\w*_program$")
 
+#: KV-tier classes (ISSUE 12): any class named *Tier*/*Spill*/
+#: *Hibernat* joins the dispatch-hygiene walk (KvSpillStore,
+#: SessionHibernator-style orchestrators) — substring, not suffix,
+#: because the tier vocabulary composes into names freely
+_TIER_CLASS = re.compile(r"Tier|Spill|Hibernat")
+
 
 def _dotted(node: ast.AST) -> Optional[str]:
     """'a.b.c' for Name/Attribute chains, else None."""
@@ -272,12 +278,23 @@ def host_sync_in_dispatch(ctx: LintContext) -> Iterable[Finding]:
         # KvMigrationServer convention instead: dedicated worker
         # threads whose whole job is socket I/O, never reachable from
         # an engine dispatch loop — suffix matching leaves them out on
-        # purpose, exactly like the kv_migrate server.
+        # purpose, exactly like the kv_migrate server.  The KV TIER
+        # classes (ISSUE 12: ``*BlockPool`` suffix plus anything named
+        # *Tier*/*Spill*/*Hibernat*) are rooted the same way:
+        # HostBlockPool's match/take run ON the scheduler thread at
+        # admission (host dict walks only), and the spill/hibernate
+        # store's device fetches + file I/O are deliberate
+        # off-scheduler tier transitions — every such site carries a
+        # declaring pragma, so an UNdeclared fetch creeping into tier
+        # bookkeeping fails tier-1 (spill I/O never on the scheduler;
+        # the mailbox seam is the only crossing).
         roots += [
             qual
             for cls, methods in graph.by_class.items()
             if cls.endswith(("Allocator", "TrafficPlane", "Admission",
-                             "Preemptor", "Resizer", "Reshard"))
+                             "Preemptor", "Resizer", "Reshard",
+                             "BlockPool"))
+            or _TIER_CLASS.search(cls)
             for qual in methods.values()
         ]
         if not roots:

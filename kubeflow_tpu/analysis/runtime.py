@@ -539,6 +539,83 @@ class BlockLedger:
                     self.leaked_total += 1
         return leaks
 
+    # -- host tier (ISSUE 12) ----------------------------------------------
+
+    def attach_host_pool(self, pool: Any, name: str = "host") -> Any:
+        """Extend the shadow count to a ``HostBlockPool`` (the host-RAM
+        KV tier): ``put`` and the LRU eviction are wrapped so the
+        pool's ``blocks_held``/``bytes_held`` gauges are conservation-
+        checked against the actual entry map after every op — a tier
+        transition that loses or double-counts blocks surfaces at the
+        op that exposed it, exactly like the HBM books.  Idempotent."""
+        with self._mu:
+            key = ("host", id(pool))
+            if key in self._books:
+                return pool
+            books = _Books(pool, name)
+            self._books[key] = books  # type: ignore[index]
+
+            orig_put, orig_evict = pool.put, pool._evict_oldest
+
+            def put_wrapped(tokens, blocks, nbytes=None):
+                out = orig_put(tokens, blocks, nbytes)
+                self.ops_total += 1
+                with pool._lock:
+                    # put has RETURNED: the eviction loop converged,
+                    # so the capacity bound may be enforced here
+                    self._check_host(books, pool, check_capacity=True)
+                return out
+
+            def evict_wrapped():
+                # runs inside put/put_wrapped with pool._lock HELD
+                # (the only eviction site) — check without re-locking,
+                # and WITHOUT the capacity bound: mid-loop the pool is
+                # legitimately still over capacity (put keeps evicting
+                # until it converges)
+                orig_evict()
+                self._check_host(books, pool)
+
+            pool.put = put_wrapped
+            pool._evict_oldest = evict_wrapped
+        return pool
+
+    def _check_host(self, books: _Books, pool: Any,
+                    check_capacity: bool = False) -> None:
+        # under pool._lock on put paths; eviction only runs inside put.
+        # Recount is O(entries) — the pool is bounded by capacity.
+        actual = sum(len(e["blocks"]) for e in pool._seqs.values())
+        if actual != pool.blocks_held:
+            self._error(
+                books, f"host tier holds {actual} blocks but the gauge "
+                f"says {pool.blocks_held} — a spill/evict path mutates "
+                "the tier around the wrapped verbs")
+            pool.blocks_held = actual  # resync: one drift reports once
+        if check_capacity and pool.blocks_held > pool.capacity_blocks:
+            self._error(
+                books, f"host tier over capacity: {pool.blocks_held} > "
+                f"{pool.capacity_blocks} — eviction did not converge")
+
+    def audit_host(self, pool: Any) -> list[str]:
+        """Boundary check for the host tier: re-run the conservation
+        count and return NEW error lines (empty = gauges honest,
+        occupancy within capacity).
+
+        LOCK ORDER: pool._lock BEFORE self._mu — the wrapped put/evict
+        verbs hold pool._lock when their checks reach ``_error`` (which
+        takes ``_mu``), so an audit taking ``_mu`` first and THEN
+        pool._lock would be the classic ABBA inversion: the ledger
+        would deadlock the engine exactly when it detects the drift it
+        exists to report."""
+        with self._mu:
+            books = self._books.get(("host", id(pool)))  # type: ignore
+        if books is None:
+            return []
+        with pool._lock:
+            with self._mu:
+                before = len(self.conservation_errors)
+                self._check_host(books, pool, check_capacity=True)
+                return self.conservation_errors[before:]
+
     def report(self) -> dict:
         """JSON-ready summary (chaos/bench artifacts)."""
         with self._mu:

@@ -53,6 +53,9 @@ class FaultKind(str, enum.Enum):
     REPLICA_KILL = "replica_kill"    # serving replica dies mid-storm
     GANG_MEMBER_LOSS = "gang_member_loss"  # gang member dies, maybe forever
     RESIZE_KILL = "resize_kill"      # elastic resize dies at a phase
+    SPILL_TORN = "spill_torn"        # published spill file loses its tail
+    SPILL_KILL = "spill_kill"        # process dies mid-spill-write
+    TIER_IO_STALL = "tier_io_stall"  # storage-tier I/O wedges for a window
 
 
 @dataclass
@@ -337,6 +340,103 @@ class FaultPlan:
                         raise RuntimeError(
                             f"chaos: resize killed mid-{phase}")
         return fp
+
+    # -- storage-tier faults (ISSUE 12: crash-safe KV tiering) -------------
+    #
+    # The spill path (serving/storage.py KvSpillStore) has three failure
+    # shapes the hibernate/thaw contract must absorb: the writer dies
+    # mid-spill (nothing may publish — the session resumes in place),
+    # a PUBLISHED spill loses bytes at rest (torn write / bit rot — the
+    # thaw must detect it via the manifest hashes and re-prefill, never
+    # serve corrupt KV), and the tier's I/O wedges (a hung NFS mount —
+    # bounded stall, not a scheduler hang).  Each has a builder here and
+    # a ``due_*`` actuator the store polls at its phase boundaries.
+
+    SPILL_PHASES = ("payload", "meta", "publish")
+
+    def spill_kill_mid_write(self, phase: Optional[str] = None,
+                             times: int = 1) -> "FaultPlan":
+        """The spilling process dies at a seeded write phase (payload
+        bytes / manifest / publish rename).  Consumed by
+        ``KvSpillStore(chaos=plan)``: the write raises after the chosen
+        phase's bytes hit the staging dir, so nothing is ever published
+        — a half-written spill is a stale staging dir, and the source
+        engine resumes the sequence in place (copy-then-cutover,
+        lifted to the storage tier)."""
+        if phase is None:
+            phase = self.SPILL_PHASES[
+                self.rng.randrange(len(self.SPILL_PHASES))]
+        self.faults.append(Fault(FaultKind.SPILL_KILL, role=str(phase),
+                                 times=times))
+        return self
+
+    def spill_torn(self, torn_bytes: Optional[int] = None,
+                   times: int = 1) -> "FaultPlan":
+        """A PUBLISHED spill file loses its last ``torn_bytes`` bytes
+        (torn write at the device layer, the PR 5 WAL-tail shape one
+        tier down; None = seeded draw).  Consumed by
+        ``KvSpillStore(chaos=plan)`` right after publish: the entry
+        exists and its manifest is intact, but a payload hash no longer
+        matches — thaw must detect it and re-prefill from the manifest's
+        token record instead of serving wrong KV."""
+        if torn_bytes is None:
+            torn_bytes = self.rng.choice((1, 7, 64, 4096))
+        self.faults.append(Fault(FaultKind.SPILL_TORN,
+                                 torn_bytes=int(torn_bytes), times=times))
+        return self
+
+    def tier_io_stall(self, seconds: float = 0.2,
+                      times: int = 1) -> "FaultPlan":
+        """Storage-tier I/O wedges for ``seconds`` on the next
+        ``times`` spill/thaw operations (a hung remote mount).
+        Consumed by ``KvSpillStore(chaos=plan)`` at operation start —
+        the stall lands on the HIBERNATION WORKER thread by
+        construction (spill I/O never runs on an engine scheduler: the
+        analyzer roots ``*Tier``/``*Spill``/``*Hibernate`` classes),
+        so live decode traffic keeps flowing through the window."""
+        self.faults.append(Fault(FaultKind.TIER_IO_STALL,
+                                 delay=float(seconds), times=times))
+        return self
+
+    def due_spill_kills(self) -> list[str]:
+        """Spill-write phases whose seeded kill is due — polled by the
+        store ONCE per write.  At most ONE kill is drawn per call: a
+        write dies at a single phase, and draining every seeded kill
+        into one doomed write would consume later-phase kills without
+        ever firing them (two seeded kills = two killed writes)."""
+        out: list[str] = []
+        with self._lock:
+            for f in self.faults:
+                if (f.kind == FaultKind.SPILL_KILL
+                        and f.fired < f.times):
+                    f.fired += 1
+                    out.append(f.role)
+                    break
+        return out
+
+    def due_spill_torn(self) -> list[int]:
+        """Byte counts to tear off the just-published spill's payload
+        tail — polled by the store after each publish."""
+        out: list[int] = []
+        with self._lock:
+            for f in self.faults:
+                if (f.kind == FaultKind.SPILL_TORN
+                        and f.fired < f.times):
+                    f.fired += 1
+                    out.append(int(f.torn_bytes))
+        return out
+
+    def due_tier_stalls(self) -> list[float]:
+        """Seconds of storage-tier stall due for the next I/O op —
+        polled by the store at operation start."""
+        out: list[float] = []
+        with self._lock:
+            for f in self.faults:
+                if (f.kind == FaultKind.TIER_IO_STALL
+                        and f.fired < f.times):
+                    f.fired += 1
+                    out.append(float(f.delay))
+        return out
 
     def socket_delay(self, role: str = "leader", delay: float = 0.01,
                      times: int = 1) -> "FaultPlan":

@@ -6,8 +6,21 @@ from .model import Model
 from .runtimes import EchoModel, JaxFunctionModel, LlamaGenerator
 from .server import MicroBatcher, ModelServer
 from .resize import ElasticGangSupervisor, GangResizer
-from .storage import StorageError, download, fetch_mem, register_mem
-from .traffic import QosClass, TrafficPlane, validate_qos
+from .storage import (
+    KvSpillStore,
+    SpillCorrupt,
+    StorageError,
+    download,
+    fetch_mem,
+    register_mem,
+)
+from .traffic import (
+    KvBlockRegistry,
+    QosClass,
+    SessionAffinity,
+    TrafficPlane,
+    validate_qos,
+)
 from .transformer import Transformer
 
 __all__ = [
@@ -16,12 +29,16 @@ __all__ = [
     "GangResizer",
     "InferenceServiceController",
     "JaxFunctionModel",
+    "KvBlockRegistry",
+    "KvSpillStore",
     "LlamaGenerator",
     "MicroBatcher",
     "Model",
     "ModelServer",
     "QosClass",
     "Router",
+    "SessionAffinity",
+    "SpillCorrupt",
     "StorageError",
     "TrafficPlane",
     "Transformer",

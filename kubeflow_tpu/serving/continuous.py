@@ -87,10 +87,12 @@ from . import sharded as shardedlib
 from .model import Model
 from .paged import (
     BlockAllocator,
+    HostBlockPool,
     gather_block_view,
     scatter_block_view,
     write_window_tables,
 )
+from .paged import block_keys as _block_keys
 from .paged import lcp as _lcp  # noqa: F401 — the one LCP implementation
 from .storage import fetch_mem
 
@@ -1214,6 +1216,8 @@ class ContinuousEngine:
         draft_proposer: Optional[DraftProposer] = None,
         block_size: int = 0,
         num_blocks: int = 0,
+        host_blocks: int = 0,
+        host_watermark: float = 0.25,
         admission_policy=None,
         role: str = "mixed",
     ):
@@ -1233,6 +1237,14 @@ class ContinuousEngine:
             raise ValueError("block_size must be >= 0 (0 = slot pool)")
         if num_blocks < 0:
             raise ValueError("num_blocks must be >= 0 (0 = derived)")
+        if host_blocks < 0:
+            raise ValueError("host_blocks must be >= 0 (0 = no host tier)")
+        if host_blocks > 0 and block_size <= 0:
+            raise ValueError(
+                "the host KV tier requires the paged pool "
+                "(block_size > 0): the spill unit is the block")
+        if not (0.0 <= float(host_watermark) <= 1.0):
+            raise ValueError("host_watermark must be in [0, 1]")
         if block_size > 0 and int(prefix_segments) > 0:
             raise ValueError(
                 "prefix_segments is superseded by the paged pool: "
@@ -1294,6 +1306,35 @@ class ContinuousEngine:
         self.num_blocks = int(num_blocks)
         self._alloc = (BlockAllocator(self.num_blocks, self.block_size)
                        if self.paged else None)
+        #: host-RAM KV tier (ISSUE 12): a bounded numpy mirror of
+        #: retired sequences' block bytes.  The HBM free-list-as-cache
+        #: only retains a prefix until its blocks are REALLOCATED; under
+        #: pressure (free list below the watermark) retiring sequences
+        #: spill their full blocks host-side so the hot prefix set can
+        #: exceed the HBM pool.  The SCHEDULER only dispatches the
+        #: gathers; a host-tier worker thread materializes them
+        #: (device->host fetch must never run on the scheduler — the
+        #: analyzer's *Tier/*Spill roots pin the inverse for the pool).
+        self.host_blocks = int(host_blocks)
+        self._host_pool = (HostBlockPool(self.host_blocks, self.block_size)
+                           if self.paged and self.host_blocks > 0 else None)
+        #: free-block count below which retirement spills to host RAM
+        self._host_watermark_blocks = int(self.num_blocks
+                                          * float(host_watermark))
+        self._spill_q: "queue.Queue" = queue.Queue()
+        self._spill_thread: Optional[threading.Thread] = None
+        #: storage tier (KvSpillStore) for hibernate/thaw — attached by
+        #: the runtime (attach_spill_store); counters surface the ISSUE
+        #: 12 gauge set whether or not a store is attached
+        self.spill_store = None
+        #: spill/thaw counters tick from the host-tier worker, from
+        #: hibernating caller threads AND from the scheduler (restore/
+        #: install) — bare += across threads loses increments (the r12
+        #: bench-probe lesson), so they share one small lock
+        self._tier_mu = threading.Lock()
+        self.kv_spills_total = 0
+        self.kv_thaws_total = 0
+        self.kv_thaws_degraded_total = 0
         #: optional analysis/runtime.py BlockLedger: shadow-refcount
         #: audit of the block economy + the kv_blocks_leaked_total
         #: gauge; attach via attach_block_ledger (tests, chaos, benches)
@@ -1481,6 +1522,11 @@ class ContinuousEngine:
             self._thread = threading.Thread(
                 target=self._loop, name="continuous-engine", daemon=True)
             self._thread.start()
+        if self._host_pool is not None and self._spill_thread is None:
+            self._spill_thread = threading.Thread(
+                target=self._host_tier_loop, name="kv-host-tier",
+                daemon=True)
+            self._spill_thread.start()
 
     # -- compiled programs -------------------------------------------------
 
@@ -2232,8 +2278,30 @@ class ContinuousEngine:
             live_tokens = sum(
                 len(self._slot_content[s]) for s in range(self.num_slots)
                 if self._slot_blocks[s])
+            host = (self._host_pool.stats() if self._host_pool is not None
+                    else {"kv_blocks_host_tier": 0, "kv_host_bytes": 0,
+                          "kv_host_capacity_blocks": 0,
+                          "kv_host_spills_total": 0,
+                          "kv_host_restores_total": 0,
+                          "kv_host_evictions_total": 0})
             paged = {
                 **a.stats(),
+                # hierarchical KV tiers (ISSUE 12): host-RAM mirror
+                # occupancy + spill/thaw traffic across ALL downward/
+                # upward tier transitions (host AND storage), the
+                # storage tier's verify failures (a torn spill detected
+                # at thaw — re-prefilled, never served), and the
+                # cluster-visible hibernated-session census
+                **host,
+                "kv_spills_total": self.kv_spills_total,
+                "kv_thaws_total": self.kv_thaws_total,
+                "kv_thaws_degraded_total": self.kv_thaws_degraded_total,
+                "kv_spill_verify_failures_total": (
+                    self.spill_store.verify_failures_total
+                    if self.spill_store is not None else 0),
+                "kv_sessions_hibernated": (
+                    self.spill_store.session_count()
+                    if self.spill_store is not None else 0),
                 # reserved-but-unwritten span across live tables: the
                 # block economy's internal fragmentation + upfront
                 # worst-case commitment, as a ratio of allocated bytes
@@ -2256,6 +2324,14 @@ class ContinuousEngine:
                 "prefix_block_hits_total": 0,
                 "kv_fragmentation_ratio": 0.0,
                 "kv_blocks_leaked_total": 0,
+                "kv_blocks_host_tier": 0, "kv_host_bytes": 0,
+                "kv_host_capacity_blocks": 0, "kv_host_spills_total": 0,
+                "kv_host_restores_total": 0,
+                "kv_host_evictions_total": 0,
+                "kv_spills_total": 0, "kv_thaws_total": 0,
+                "kv_thaws_degraded_total": 0,
+                "kv_spill_verify_failures_total": 0,
+                "kv_sessions_hibernated": 0,
             }
         return {
             **paged,
@@ -2319,6 +2395,12 @@ class ContinuousEngine:
         self._wake.set()
         if self._thread is not None:
             self._thread.join(timeout=10)
+        if self._spill_thread is not None:
+            # the host-tier worker drains its queue then exits (a spill
+            # dispatched before stop still lands in the pool — tests
+            # audit the tier at this boundary)
+            self._spill_thread.join(timeout=10)
+            self._spill_thread = None
         while True:
             try:
                 req = self._queue.get_nowait()
@@ -2713,7 +2795,7 @@ class ContinuousEngine:
                 f"prompt + max_new_tokens = {total} at block_size {bs})")
             req.done.set()
             return None
-        start, shared, cow_src = 0, [], None
+        start, shared, cow_src, restore = 0, [], None, None
         if self.prefix_cache:
             blocks, lcp = self._paged_match(prompt)
             lcp = min(lcp, len(prompt) - 1)
@@ -2727,6 +2809,20 @@ class ContinuousEngine:
                     # only from the true divergence point
                     cow_src = int(blocks[nfull])
                     start = lcp
+            if self._host_pool is not None:
+                # host-tier restore (ISSUE 12): a DEEPER prefix than
+                # any HBM-resident match may survive in host RAM —
+                # scattering it back (~memcpy) beats re-prefilling the
+                # same tokens.  Full blocks only; the restored blocks
+                # are fresh allocations the admission scatter fills.
+                hid, hlcp = self._host_pool.match(
+                    # analysis: ok host-sync-in-dispatch — host token list, no device value
+                    np.asarray(prompt, np.int64), len(prompt) - 1)
+                hstart = (hlcp // bs) * bs
+                if hstart > start and hstart >= self.min_prefix:
+                    shared, cow_src = [], None
+                    start = hstart
+                    restore = (hid, hstart // bs)
         # pin shared blocks OUT of the free list before allocating —
         # alloc must never hand a block we are about to share
         self._alloc.ref(shared)
@@ -2736,7 +2832,7 @@ class ContinuousEngine:
             return None
         if shared:
             self._alloc.prefix_block_hits_total += len(shared)
-        return prompt, start, shared + fresh, cow_src, len(shared)
+        return prompt, start, shared + fresh, cow_src, len(shared), restore
 
     def _paged_match(self, prompt: list[int]) -> tuple[tuple, int]:
         """(blocks, lcp): the best block-backed prefix source for this
@@ -2773,7 +2869,18 @@ class ContinuousEngine:
         had_live = bool(self._active.any())
         dispatched = False
         for (req, slot), plan in zip(taken, plans):
-            prompt, start, table, cow_src, shared_n = plan
+            prompt, start, table, cow_src, shared_n, restore = plan
+            if restore is not None:
+                hid, nfull = restore
+                host_blk = self._host_pool.take(hid, nfull)
+                if host_blk is None or len(host_blk) < nfull:
+                    # evicted between match and take: prefill everything
+                    start = 0
+                else:
+                    self._scatter_host_blocks(table[:nfull], host_blk)
+                    with self._tier_mu:
+                        self.kv_thaws_total += 1
+                    dispatched = True
             if cow_src is not None:
                 try:
                     self._pool_cache = self._block_copy(
@@ -2841,8 +2948,347 @@ class ContinuousEngine:
             blocks = self._slot_blocks[slot]
             if self.prefix_cache:
                 self._alloc.register(self._slot_content[slot], blocks)
+                # host-tier spill (ISSUE 12): under free-list pressure
+                # this retirement's registration is about to be
+                # cannibalized — DISPATCH the gathers now (device
+                # ordering guarantees they read today's bytes even if
+                # the blocks are reallocated before the fetch lands);
+                # the host-tier worker materializes off-thread
+                self._maybe_spill_host(slot, blocks)
             self._alloc.release(blocks)
             self._slot_blocks[slot] = []
+
+    # -- hierarchical KV tiers (ISSUE 12) ----------------------------------
+    #
+    # HBM -> host RAM -> manifest-verified storage.  The spill unit is
+    # the PR 6 block; the spill wire format is the PR 7 export_sequence
+    # snapshot.  Thread contract (the mailbox seam, mechanically pinned
+    # by the analyzer's *Tier/*Spill/*Hibernate roots): the SCHEDULER
+    # only dispatches gathers/scatters and walks host dicts; every
+    # device->host fetch and every byte of file/socket I/O runs on a
+    # host-tier worker or the hibernating caller's thread.
+
+    def _maybe_spill_host(self, slot: int, blocks: list) -> None:
+        """Scheduler-side spill decision + gather DISPATCH for a
+        retiring sequence's full blocks (host-tier admission runs on
+        the worker thread)."""
+        hp = self._host_pool
+        if hp is None:
+            return
+        if self._alloc.free_blocks >= self._host_watermark_blocks:
+            return  # no pressure: the HBM free-list cache retains it
+        content = self._slot_content[slot]
+        nfull = min(len(content) // self.block_size, len(blocks))
+        if nfull == 0:
+            return
+        toks = list(content[: nfull * self.block_size])
+        if hp.contains_prefix(toks, min_tokens=len(toks)):
+            return  # already held: re-spilling would churn the LRU
+        ids = [int(b) for b in blocks[:nfull]]
+        groups = []
+        for i in range(0, len(ids), KV_MIGRATE_GROUP):
+            grp = ids[i:i + KV_MIGRATE_GROUP]
+            bt = np.full((KV_MIGRATE_GROUP, 1), self._alloc.pad_block,
+                         np.int32)
+            bt[:len(grp), 0] = grp
+            groups.append((self._kv_export(self._pool_cache, bt),
+                           len(grp)))
+        self._spill_q.put((toks, groups))
+
+    def _host_tier_loop(self) -> None:
+        """Host-tier worker: materialize dispatched spill gathers
+        (device->host fetch OFF the scheduler thread) and admit them to
+        the HostBlockPool."""
+        while not (self._stop.is_set() and self._spill_q.empty()):
+            try:
+                toks, groups = self._spill_q.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            try:
+                host_blocks = []
+                for leaves, valid in groups:
+                    host = [np.asarray(x) for x in jax.device_get(leaves)]
+                    for j in range(valid):
+                        host_blocks.append([x[j:j + 1] for x in host])
+                if self._host_pool.put(toks, host_blocks) >= 0:
+                    with self._tier_mu:
+                        self.kv_spills_total += 1
+            except Exception as e:  # noqa: BLE001 — a failed spill only
+                # costs the cache entry (the HBM registry still holds
+                # the prefix until reallocation); the tier must never
+                # take the engine down
+                log.debug("host-tier spill failed: %s", e)
+
+    def attach_spill_store(self, store) -> None:
+        """Attach the storage tier (:class:`~.storage.KvSpillStore`) —
+        hibernate/thaw default to it and ``stats()`` surfaces its
+        verify-failure and hibernated-session gauges."""
+        self.spill_store = store
+
+    def hibernate_sequence(self, req: Request, session_id: str,
+                           store=None, timeout: float = 60.0) -> bool:
+        """Spill a live sequence to the storage tier and retire it
+        (ISSUE 12): the PR 7 export snapshot is written through the
+        manifest-verified :class:`~.storage.KvSpillStore` (atomic
+        tmp+fsync+rename, per-file hashes), then the slot is released —
+        its blocks return to the free list still prefix-registered.
+        The request HANDLE stays unresolved (the session is parked
+        durable); ``thaw_sequence`` — on THIS engine, or on any replica
+        sharing the store — resumes it bit-identically.
+
+        Crash discipline is copy-then-cutover lifted to the storage
+        tier: a spill that dies mid-write publishes nothing and the
+        sequence resumes decoding in place.  Runs on the CALLER's
+        thread (device fetch + file I/O) — never call from scheduler
+        context.  Returns False when the request already finished."""
+        store = store or self.spill_store
+        if store is None:
+            raise RuntimeError("no spill store attached "
+                               "(attach_spill_store)")
+        snap = self.export_sequence(req, timeout)
+        if snap is None:
+            return False
+        toks = [int(t) for t in snap["prompt"]] + \
+            [int(t) for t in snap.get("generated", ())]
+        try:
+            store.write(session_id, snap,
+                        block_keys=_block_keys(toks, self.block_size))
+        except Exception:
+            # nothing published (atomic rename never ran): the source
+            # still owns the sequence — resume in place, exactly-once
+            try:
+                self.resume_sequence(req, timeout)
+            except (RuntimeError, TimeoutError):
+                pass
+            raise
+        self.release_sequence(req, timeout)
+        with self._tier_mu:
+            self.kv_spills_total += 1
+        return True
+
+    def thaw_sequence(self, session_id: str, store=None,
+                      req: Optional[Request] = None,
+                      timeout: float = 60.0) -> tuple[Request, dict]:
+        """Resume a hibernated session from the storage tier (any
+        replica sharing the store).  Returns ``(req, info)``:
+
+        - verified payload -> ``import_sequence`` scatters the spilled
+          blocks and decoding resumes at the exact position,
+          bit-identical greedy to the uninterrupted run;
+        - torn/corrupt payload (manifest hash mismatch) -> NEVER
+          scattered: the session re-prefills from the manifest's token
+          record (``info["degraded"] = True``, same greedy tokens, KV
+          recomputed; a pending stochastic spec-ban is dropped);
+        - unreadable manifest -> :class:`~.storage.SpillCorrupt`.
+
+        ``info["tokens"]`` carries the tokens generated BEFORE
+        hibernation (the session transcript the API handle already
+        delivered).  The spill entry is consumed on success."""
+        store = store or self.spill_store
+        if store is None:
+            raise RuntimeError("no spill store attached "
+                               "(attach_spill_store)")
+        snap, ok = store.read(session_id)
+        prior = [int(t) for t in snap.get("generated", ())]
+        if ok:
+            new_req = self.import_sequence(snap, req=req, timeout=timeout)
+        else:
+            prompt = [int(t) for t in snap["prompt"]]
+            remaining = int(snap["remaining"]) \
+                if snap.get("phase") == "decode" \
+                else int(snap["max_new_tokens"])
+            # the handle's budget counts DELIVERED tokens (delivery
+            # retires at len(req.tokens) >= max_new_tokens), and the
+            # prior transcript rides the handle — so the budget is
+            # prior + remainder, while the SNAPSHOT's max_new_tokens
+            # below stays the remainder (it sizes the block span on
+            # top of the re-prefilled prompt)
+            if req is None:
+                req = Request(
+                    prompt=prompt,
+                    max_new_tokens=len(prior) + remaining,
+                    temperature=snap.get("temperature"),
+                    top_p=snap.get("top_p"), top_k=snap.get("top_k"),
+                    priority=int(snap.get("priority", 1)))
+                req.tokens = list(prior)
+            else:
+                # nothing else owns the parked handle while hibernated:
+                # _occupy reads req.max_new_tokens at activation
+                req.max_new_tokens = len(prior) + remaining
+            re_snap = {
+                "v": 1, "phase": "prefill",
+                "block_size": self.block_size,
+                # prompt + prior generation re-prefill as one prompt:
+                # recomputing their KV from tokens is the same math the
+                # chunked-prefill parity bar pins, so the continuation
+                # stays greedy bit-identical
+                "prompt": prompt + prior, "generated": [],
+                "position": 0, "remaining": remaining,
+                "max_new_tokens": remaining,
+                "temperature": snap.get("temperature"),
+                "top_p": snap.get("top_p"), "top_k": snap.get("top_k"),
+                "priority": int(snap.get("priority", 1)),
+                "spec_ban": -1, "blocks": [],
+            }
+            new_req = self.import_sequence(re_snap, req=req,
+                                           timeout=timeout)
+            with self._tier_mu:
+                self.kv_thaws_degraded_total += 1
+        store.delete(session_id)
+        with self._tier_mu:
+            self.kv_thaws_total += 1
+        return new_req, {"degraded": not ok, "tokens": prior,
+                         "session": session_id}
+
+    def export_prefix_blocks(self, tokens: list[int],
+                             timeout: float = 60.0
+                             ) -> tuple[list[int], list]:
+        """(covered_tokens, host block leaf-lists) for the longest
+        full-block prefix of ``tokens`` this engine's pool holds (live
+        slots or the free-list-as-cache registry) — the serving side of
+        the cluster block registry's peer fetch (a cold replica imports
+        this instead of recomputing a hot prefix).  Gathers are
+        dispatched on the scheduler; the fetch happens HERE on the
+        caller's thread."""
+        if not self.paged:
+            raise RuntimeError("prefix export requires the paged pool")
+        out = self._post_migration_op("export_prefix",
+                                      [int(t) for t in tokens], None,
+                                      timeout)
+        blocks = []
+        for leaves, valid in out.get("blocks_dev", ()):
+            host = [np.asarray(x) for x in jax.device_get(leaves)]
+            for j in range(valid):
+                blocks.append([x[j:j + 1] for x in host])
+        return out.get("covered", []), blocks
+
+    def install_prefix(self, tokens: list[int], blocks: list,
+                       timeout: float = 60.0) -> bool:
+        """Install a fetched prefix (host block leaf-lists, one per
+        FULL block of ``tokens``) into this pool's registry: alloc,
+        scatter, register, release — the blocks land on the free list
+        content-registered, so the next same-prefix admission shares
+        them instead of prefilling (prefill-once-per-cluster).  False
+        when the pool has no room (never evicts live sequences)."""
+        if not self.paged:
+            raise RuntimeError("prefix install requires the paged pool")
+        out = self._post_migration_op(
+            "install_prefix", [int(t) for t in tokens], blocks, timeout)
+        return bool(out.get("ok"))
+
+    def _mig_export_prefix(self, tokens: list[int], out: dict) -> None:
+        """Scheduler body: match + dispatch grouped gathers (no fetch)."""
+        blocks, lcp_n = self._paged_match_full(tokens)
+        nfull = lcp_n // self.block_size
+        ids = [int(b) for b in blocks[:nfull]]
+        groups = []
+        for i in range(0, len(ids), KV_MIGRATE_GROUP):
+            grp = ids[i:i + KV_MIGRATE_GROUP]
+            bt = np.full((KV_MIGRATE_GROUP, 1), self._alloc.pad_block,
+                         np.int32)
+            bt[:len(grp), 0] = grp
+            groups.append((self._kv_export(self._pool_cache, bt),
+                           len(grp)))
+        out["covered"] = tokens[: nfull * self.block_size]
+        out["blocks_dev"] = groups
+
+    def _paged_match_full(self, tokens: list[int]) -> tuple[tuple, int]:
+        """Like _paged_match but UNCAPPED (a prefix export may cover
+        the whole token record — there is no suffix to prefill here)."""
+        cap = len(tokens)
+        if cap == 0:
+            return (), 0
+        # analysis: ok host-sync-in-dispatch — host token list, no device value
+        p = np.asarray(tokens, np.int64)
+        best_blocks: tuple = ()
+        best = 0
+        for s in range(self.num_slots):
+            content, blocks = self._slot_content[s], self._slot_blocks[s]
+            if not blocks or min(len(content), cap) <= best:
+                continue
+            n = _lcp(content, p, cap)
+            if n > best:
+                best_blocks, best = tuple(blocks), n
+        reg_blocks, reg_n = self._alloc.match(p, cap)
+        if reg_n > best:
+            best_blocks, best = reg_blocks, reg_n
+        return best_blocks, best
+
+    def _scatter_host_blocks(self, ids: list, blocks: list) -> None:
+        """Grouped scatter of host block leaf-lists into pool blocks
+        ``ids`` (scheduler thread; pure dispatch — the leaves are
+        already host numpy).  Shared by the host-tier restore, the
+        registry prefix install, and nothing else: one write path, one
+        warmed program (``_kv_import``)."""
+        G = KV_MIGRATE_GROUP
+        for i in range(0, len(blocks), G):
+            grp = blocks[i:i + G]
+            bt = np.full((G, 1), self._alloc.num_blocks, np.int32)
+            bt[:len(grp), 0] = [int(ids[i + j])
+                                for j in range(len(grp))]
+            leaves = []
+            for li in range(len(grp[0])):
+                # analysis: ok host-sync-in-dispatch — host numpy leaves
+                parts = [np.asarray(b[li]) for b in grp]
+                stack = np.concatenate(parts, axis=0)
+                if len(grp) < G:
+                    stack = np.concatenate(
+                        [stack, np.zeros(
+                            (G - len(grp),) + stack.shape[1:],
+                            stack.dtype)], axis=0)
+                leaves.append(stack)
+            self._pool_cache = self._kv_import(
+                self._pool_cache, bt, tuple(leaves))
+
+    def prefix_census(self, timeout: float = 30.0) -> list:
+        """Copies of every block-registered token record (live slots +
+        the free-list registry), taken at a scheduler boundary — the
+        /metrics block-registry probe hashes these OFF-thread into
+        ``kft_kv_prefix_key`` rows (paged.prefix_digest).  Empty when
+        the scheduler has not started (no traffic = no content; a
+        metrics scrape must not start the pool)."""
+        if not self.paged or self._thread is None:
+            return []
+        try:
+            out = self._post_migration_op("prefix_census", None, None,
+                                          timeout)
+        except (RuntimeError, TimeoutError):
+            return []
+        return out.get("tokens", [])
+
+    def _mig_prefix_census(self, out: dict) -> None:
+        records = []
+        for s in range(self.num_slots):
+            content = self._slot_content[s]
+            if self._slot_blocks[s] and len(content) >= self.block_size:
+                # analysis: ok host-sync-in-dispatch — host token list copy
+                records.append(np.asarray(content, np.int64))
+        for toks, blocks in self._alloc._seqs.values():
+            # analysis: ok host-sync-in-dispatch — registry token copy, host numpy
+            records.append(np.asarray(
+                toks[: len(blocks) * self.block_size], np.int64))
+        out["tokens"] = records
+
+    def _mig_install_prefix(self, tokens: list[int], blocks: list,
+                            out: dict) -> None:
+        """Scheduler body: alloc + grouped scatter + register/release."""
+        n = min(len(blocks), len(tokens) // self.block_size)
+        if n == 0:
+            out["ok"] = False
+            return
+        table = self._alloc.alloc(n)
+        if table is None:
+            out["ok"] = False  # no room: never evict live sequences
+            return
+        self._scatter_host_blocks(table, blocks[:n])
+        if self.block_ledger is not None:
+            self.block_ledger.annotate(self._alloc, table,
+                                       "registry:install_prefix")
+        self._alloc.register(tokens[: n * self.block_size], table)
+        self._alloc.release(table)
+        with self._tier_mu:
+            self.kv_thaws_total += 1
+        out["ok"] = True
 
     # -- live KV migration (ISSUE 8) ---------------------------------------
     #
@@ -2998,6 +3444,10 @@ class ContinuousEngine:
             raise RuntimeError(
                 "block ledger requires the paged pool (block_size > 0)")
         ledger.attach(self._alloc)
+        if self._host_pool is not None:
+            # the host tier joins the audit: spill/evict gauge drift is
+            # conservation-checked like the HBM refcounts (ISSUE 12)
+            ledger.attach_host_pool(self._host_pool)
         self.block_ledger = ledger
 
     def audit_blocks(self, timeout: float = 60.0) -> list:
@@ -3035,6 +3485,8 @@ class ContinuousEngine:
         """Scheduler-thread audit body (mailbox op + idle hook)."""
         if self.block_ledger is None or self._alloc is None:
             return []
+        if self._host_pool is not None:
+            self.block_ledger.audit_host(self._host_pool)
         return self.block_ledger.audit_quiesced(
             self._alloc, held=self._held_blocks())
 
@@ -3108,6 +3560,12 @@ class ContinuousEngine:
                     self._mig_take_waiting(out)
                 elif kind == "audit":
                     out["leaks"] = self._audit_blocks_now()
+                elif kind == "export_prefix":
+                    self._mig_export_prefix(a, out)
+                elif kind == "prefix_census":
+                    self._mig_prefix_census(out)
+                elif kind == "install_prefix":
+                    self._mig_install_prefix(a, b, out)
                 elif kind == "live_slots":
                     out["reqs"] = [r for r in self._slots
                                    if r is not None
@@ -4554,6 +5012,8 @@ def engine_kwargs(config: dict, *, default_eos=None,
         spec_ngram=int(config.get("spec_ngram", 3)),
         block_size=int(config.get("block_size", 0)),
         num_blocks=int(config.get("num_blocks", 0)),
+        host_blocks=int(config.get("host_blocks", 0)),
+        host_watermark=float(config.get("host_watermark", 0.25)),
         role=str(config.get("role", "mixed")),
         default_max_new_tokens=int(
             config.get("max_new_tokens", default_max_new_tokens)),

@@ -291,7 +291,8 @@ class Router:
                 explain = self.path.endswith(":explain")
                 length = int(self.headers.get("Content-Length", "0"))
                 body = self.rfile.read(length) if length else None
-                keys, tenant = router._request_context(body, self.headers)
+                keys, tenant, session = router._request_context(
+                    body, self.headers)
                 plane = router.traffic
                 ticket = None
                 # the QoS door gates INFERENCE POSTs only: readiness /
@@ -327,20 +328,21 @@ class Router:
                         return
                 try:
                     self._route_and_forward(
-                        explain, body, keys, tenant, ticket)
+                        explain, body, keys, tenant, ticket, session)
                 finally:
                     if ticket is not None:
                         plane.release(ticket)
 
             def _route_and_forward(self, explain, body, keys, tenant,
-                                   ticket) -> None:
-                backend = router._pick(explain, keys)
+                                   ticket, session=None) -> None:
+                backend = router._pick(explain, keys, session=session)
                 if backend is None:
                     router._activate()
                     deadline = time.time() + ACTIVATION_TIMEOUT
                     while backend is None and time.time() < deadline:
                         time.sleep(0.05)
-                        backend = router._pick(explain, keys)
+                        backend = router._pick(explain, keys,
+                                               session=session)
                 tried: set[str] = set()
                 while backend is not None:
                     headers = {"Content-Type": "application/json"}
@@ -409,7 +411,8 @@ class Router:
                         router._backend_down(backend)
                         tried.add(backend)
                         backend = router._pick(explain, keys,
-                                               exclude=tried)
+                                               exclude=tried,
+                                               session=session)
                 router.no_backend_total += 1
                 self._respond(
                     503, json.dumps({
@@ -450,13 +453,17 @@ class Router:
         self.traffic = plane
 
     def _request_context(self, body: Optional[bytes],
-                         headers) -> tuple[list, str]:
-        """(affinity keys, tenant) for one request.  The tenant comes
-        from the ``X-KFT-Tenant`` header or the OpenAI ``user`` field;
-        the affinity keys hash the prompt's prefix in block quanta
-        (byte-token ids — exactly the block-content identity for the
-        byte tokenizer, a stable content proxy for any other)."""
+                         headers) -> tuple[list, str, str]:
+        """(affinity keys, tenant, session) for one request.  The
+        tenant comes from the ``X-KFT-Tenant`` header or the OpenAI
+        ``user`` field; the affinity keys hash the prompt's prefix in
+        block quanta (byte-token ids — exactly the block-content
+        identity for the byte tokenizer, a stable content proxy for
+        any other); the session id (``X-KFT-Session`` header or
+        payload ``session``, ISSUE 12) routes a durable conversation
+        back to the replica still holding its KV."""
         tenant = headers.get("X-KFT-Tenant") or ""
+        session = str(headers.get("X-KFT-Session") or "")
         keys: list = []
         plane = self.traffic
         if body and plane is not None:
@@ -466,6 +473,7 @@ class Router:
                 payload = None
             if isinstance(payload, dict):
                 tenant = tenant or str(payload.get("user") or "")
+                session = session or str(payload.get("session") or "")
                 prompt = payload.get("prompt")
                 if prompt is None and isinstance(
                         payload.get("messages"), list):
@@ -476,7 +484,7 @@ class Router:
                     prompt = prompt[0] if prompt else ""
                 if isinstance(prompt, str) and prompt:
                     keys = plane.prefix_keys(list(prompt.encode("utf-8")))
-        return keys, tenant or "default"
+        return keys, tenant or "default", session
 
     def _note(self, backend: str, delta: int, error: bool = False) -> None:
         with self._lock:
@@ -491,6 +499,9 @@ class Router:
     def _backend_down(self, backend: str) -> None:
         if self.traffic is not None:
             self.traffic.affinity.forget(backend)
+            # its hibernated/live sessions' KV died with it: resumes
+            # re-route and thaw from the shared storage tier instead
+            self.traffic.sessions.forget(backend)
 
     def _inflight(self, backend: str) -> int:
         with self._lock:
@@ -580,7 +591,8 @@ class Router:
             self._backend_down(u)
 
     def _pick(self, explain: bool = False, keys: Optional[list] = None,
-              exclude: Optional[set] = None) -> Optional[str]:
+              exclude: Optional[set] = None,
+              session: Optional[str] = None) -> Optional[str]:
         with self._lock:
             use_explain = explain and self._explain_pools
             pools = self._explain_pools if use_explain else self._pools
@@ -614,18 +626,21 @@ class Router:
                     if not pool:
                         return None
             plane = self.traffic
-            if plane is None or not keys:
+            if plane is None or not (keys or session):
                 # round-robin WITHIN the chosen pool, cursor per pool — a
                 # shared cursor lets a 1-backend pool reset it and starve
                 # backends of the other pool during a canary split
                 rrs[best] = (rrs[best] + 1) % len(pool)
                 return pool[rrs[best]]
-        # prefix-affinity pick (outside the WRR lock: the plane has its
-        # own): the replica already holding this prompt's prefix blocks
-        # wins unless it is overloaded vs its peers; least-inflight
-        # otherwise, and the choice is recorded so the NEXT same-prefix
-        # request sticks
-        backend, _depth = plane.route(keys, pool, load=self._inflight)
+        # session/prefix-affinity pick (outside the WRR lock: the plane
+        # has its own): a durable session resumes at the replica still
+        # holding its KV (ISSUE 12); otherwise the replica already
+        # holding this prompt's prefix blocks wins unless it is
+        # overloaded vs its peers; least-inflight otherwise, and the
+        # choice is recorded so the NEXT same-prefix request sticks
+        backend, _depth = plane.route(keys or [], pool,
+                                      load=self._inflight,
+                                      session=session)
         return backend
 
     def stop(self) -> None:
@@ -715,7 +730,7 @@ class InferenceServiceController(Controller):
     #: engine knobs validated at conf-freeze (value below floor -> Failed)
     _ENGINE_KNOBS = ("num_slots", "decode_chunk", "pipeline_depth",
                      "prefill_budget", "spec_k", "spec_ngram",
-                     "block_size", "num_blocks")
+                     "block_size", "num_blocks", "host_blocks")
 
     def _new_revision(self, isvc, dep: _Deployment, fingerprint: str) -> _Revision:
         runtime_cls, cfg = self._resolve(isvc)
@@ -731,7 +746,7 @@ class InferenceServiceController(Controller):
             from .continuous import engine_kwargs
 
             zero_ok = ("prefill_budget", "spec_k", "block_size",
-                       "num_blocks")
+                       "num_blocks", "host_blocks")
             bad = {k: v for k, v in engine_kwargs(cfg).items()
                    if k in self._ENGINE_KNOBS
                    and v < (0 if k in zero_ok else 1)}
@@ -798,6 +813,40 @@ class InferenceServiceController(Controller):
             raise ValueError(
                 f"invalid engine knobs: affinity_block {ab} (must be "
                 ">= 1)")
+        # hierarchical KV / durable-session knobs (ISSUE 12) freeze
+        # here too — the PR 4/7/8 convention: a mistyped tier config is
+        # ONE Failed status, not a replica exploding at load
+        if "host_blocks" in cfg and int(cfg.get("host_blocks") or 0) > 0 \
+                and int(cfg.get("block_size", 0) or 0) <= 0:
+            raise ValueError(
+                "invalid engine knobs: host_blocks requires the paged "
+                "pool (block_size > 0)")
+        hw = cfg.get("host_watermark")
+        if hw is not None:
+            try:
+                ok = 0.0 <= float(hw) <= 1.0
+            except (TypeError, ValueError):
+                ok = False
+            if not ok:
+                raise ValueError(
+                    f"invalid engine knobs: host_watermark {hw!r} "
+                    "(must be a number in [0, 1])")
+        hib = cfg.get("hibernation")
+        if hib is not None:
+            if not isinstance(hib, dict) or not str(hib.get("root", "")):
+                raise ValueError(
+                    "invalid engine knobs: hibernation must be "
+                    '{"root": dir[, "fsync": bool]}')
+            unknown = set(hib) - {"root", "fsync"}
+            if unknown:
+                raise ValueError(
+                    f"invalid engine knobs: hibernation keys "
+                    f"{sorted(unknown)} unknown")
+            if int(cfg.get("block_size", 0) or 0) <= 0:
+                raise ValueError(
+                    "invalid engine knobs: hibernation requires the "
+                    "paged pool (block_size > 0): the spill wire "
+                    "format is the block-granular export snapshot")
         # elastic resize knobs (ISSUE 10) freeze here too — the PR 4/7/8
         # convention: a mistyped min_degree is ONE Failed status, not N
         # crash-looping gang pods (or a supervisor exploding at runtime).

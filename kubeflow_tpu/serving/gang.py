@@ -790,6 +790,60 @@ def migrate_sequence(snapshot: dict, host: str, port: int, *,
             pass
 
 
+def fetch_kv_prefix(host: str, port: int, tokens, *, token: str = "",
+                    timeout: float = 30.0,
+                    sock_wrap=None) -> tuple[list, list]:
+    """Cluster prefix fetch (ISSUE 12): ask a peer replica's
+    :class:`KvMigrationServer` for its longest block-registered prefix
+    of ``tokens`` — live slots or the free-list-as-cache registry.
+    Returns ``(covered_tokens, host block leaf-lists)``; ``([], [])``
+    on any miss or failure (the caller just prefills — a registry
+    fetch is an optimization, never a correctness dependency).
+
+    The cold side installs the result with
+    ``engine.install_prefix(covered, blocks)`` so the next same-prefix
+    admission shares it: prefill-once-per-cluster, the vLLM free-list
+    economy lifted to fleet scope.  Same trust shape as kv_migrate
+    (token hmac, length-framed JSON + raw numpy, never pickle); runs on
+    router/worker threads, never an engine scheduler."""
+    try:
+        raw = socket.create_connection((host, port), timeout=timeout)
+    except OSError:
+        return [], []
+    c = (sock_wrap or (lambda s: s))(raw)
+    try:
+        try:
+            c.settimeout(timeout)
+        except OSError:
+            pass
+        _kv_send(c, {"t": "kv_hello", "token": token, "mid": None})
+        ready, _ = _kv_recv(c, KV_HELLO_MAX)
+        if ready.get("t") != "kv_ready":
+            return [], []
+        _kv_send(c, {"t": "kv_fetch",
+                     "tokens": [int(t) for t in tokens]})
+        head, _ = _kv_recv(c)
+        if head.get("t") != "kv_prefix":
+            return [], []
+        specs = list(head.get("leaves") or [])
+        nblocks = int(head.get("nblocks", 0))
+        covered = int(head.get("covered", 0))
+        blocks = []
+        for _i in range(nblocks):
+            hdr, payload = _kv_recv(c)
+            if hdr.get("t") != "kv_block":
+                return [], []
+            blocks.append(_unpack_leaves(payload, specs))
+        return [int(t) for t in tokens][:covered], blocks
+    except (OSError, ChannelClosed, ValueError, struct.error):
+        return [], []
+    finally:
+        try:
+            c.close()
+        except OSError:
+            pass
+
+
 class KvMigrationServer:
     """Destination side of the kv_migrate message family: authenticated
     acceptor that assembles streamed snapshots and installs them through
@@ -814,6 +868,7 @@ class KvMigrationServer:
         self._closing = threading.Event()
         self.imports_total = 0
         self.rejects_total = 0
+        self.prefix_serves_total = 0
         srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         # loopback by DEFAULT: a cross-host deployment opts into
@@ -879,6 +934,32 @@ class KvMigrationServer:
                     if meta is None or logits_spec is None:
                         raise ChannelClosed("unexpected kv_logits")
                     logits = _unpack_leaves(payload, [logits_spec])[0]
+                elif t == "kv_fetch":
+                    # cluster prefix fetch (ISSUE 12): serve the
+                    # longest block-registered prefix of the peer's
+                    # tokens — the engine dispatches gathers on its
+                    # scheduler, the fetch materializes HERE on this
+                    # connection thread, then streams kv_block frames
+                    toks = [int(x) for x in (header.get("tokens") or [])]
+                    try:
+                        covered, pblocks = \
+                            self.engine.export_prefix_blocks(toks)
+                    except (RuntimeError, TimeoutError):
+                        # stopping/wedged engine: a registry fetch is
+                        # an optimization — answer "nothing" instead of
+                        # killing the connection thread
+                        covered, pblocks = [], []
+                    _kv_send(c, {
+                        "t": "kv_prefix", "covered": len(covered),
+                        "nblocks": len(pblocks),
+                        "leaves": ([
+                            {"dtype": str(np.asarray(x).dtype),
+                             "shape": list(np.shape(x))}
+                            for x in pblocks[0]] if pblocks else [])})
+                    for i, blk in enumerate(pblocks):
+                        _kv_send(c, {"t": "kv_block", "i": i},
+                                 _pack_leaves(blk))
+                    self.prefix_serves_total += 1
                 elif t == "kv_commit":
                     break
                 else:

@@ -148,6 +148,32 @@ def block_keys(tokens, block_size: int, max_blocks: int = 64) -> list[int]:
     return keys
 
 
+def prefix_digest(token_records, block_size: int,
+                  max_entries: int = 64) -> dict[str, int]:
+    """``{hex key: block depth}`` for the deepest chained content key of
+    each token record — the replica's block-registry digest (ISSUE 12).
+
+    Exported at ``/metrics`` as ``kft_kv_prefix_key{key="..."}`` rows;
+    a :class:`~.traffic.KvBlockRegistry` probing rank-0 metrics learns
+    which replica holds which hot prefix, so a cold replica can fetch
+    the KV over the ``kv_fetch`` wire instead of recomputing it.  The
+    WHOLE chain publishes per record (a query sharing only the first i
+    blocks probes ``key[i-1]``, which must be present), deduped across
+    records and bounded at ``max_entries`` deepest-first.  Stdlib
+    hashing on the caller's (HTTP scrape) thread — the engine hands
+    out token copies via ``prefix_census``, never hashes on its
+    scheduler."""
+    depths: dict[str, int] = {}
+    for toks in token_records:
+        for i, k in enumerate(block_keys(toks, block_size)):
+            kh = f"{k:016x}"
+            depths[kh] = max(depths.get(kh, 0), i + 1)
+    if len(depths) > max_entries:
+        deepest = sorted(depths.items(), key=lambda kv: -kv[1])
+        depths = dict(deepest[:max_entries])
+    return depths
+
+
 def resize_block_budget(num_blocks: int, src_degree: int, dst_degree: int,
                         *, reserved: int = 0) -> int:
     """Block count for a pool rebuilt at a new TP degree (ISSUE 10).
@@ -332,3 +358,128 @@ class BlockAllocator:
             "kv_blocks_cow_copies_total": self.cow_copies_total,
             "prefix_block_hits_total": self.prefix_block_hits_total,
         }
+
+
+class HostBlockPool:
+    """Host-RAM tier of the paged-KV economy (ISSUE 12, ROADMAP 3).
+
+    The HBM free-list-as-cache keeps a retired conversation's KV only
+    until its blocks are REALLOCATED — at production load the hot
+    prefix set outlives that window by hours.  This pool is the next
+    rung down: a bounded numpy mirror of spilled sequences' block bytes
+    (host RAM is ~10x the HBM pool and a restore-scatter is ~100x
+    cheaper than re-prefilling the same tokens), content-addressed by
+    token prefix exactly like the allocator registry, LRU-evicted at
+    ``capacity_blocks``.
+
+    Thread contract (the analyzer's ``*Tier``/``*Spill`` roots pin the
+    inverse): everything here is host numpy under one flat lock.  The
+    ENGINE dispatches spill gathers on its scheduler thread (pure
+    dispatch, no fetch) and a tier worker thread materializes + ``put``s
+    them here; admission-time ``match``/``take`` run on the scheduler
+    thread and are dict walks over host arrays — no device value ever
+    enters this class, and no method of it may block on I/O.
+    """
+
+    def __init__(self, capacity_blocks: int, block_size: int):
+        if capacity_blocks < 1:
+            raise ValueError("capacity_blocks must be >= 1")
+        from threading import Lock
+
+        self.capacity_blocks = int(capacity_blocks)
+        self.block_size = int(block_size)
+        self._lock = Lock()
+        #: hid -> {"tokens": np.int64[], "blocks": [leaf-list per block],
+        #: "nbytes": int} — insertion/touch-ordered (LRU eviction)
+        self._seqs: "OrderedDict[int, dict]" = OrderedDict()
+        self._next = 0
+        self.blocks_held = 0
+        self.bytes_held = 0
+        self.spills_total = 0
+        self.restores_total = 0
+        self.evictions_total = 0
+
+    def put(self, tokens, blocks: list, nbytes: Optional[int] = None) -> int:
+        """Admit one spilled sequence (``blocks`` = host leaf-lists, one
+        per FULL block of ``tokens``); LRU-evicts older entries to fit.
+        Returns the entry id.  A sequence wider than the whole pool is
+        truncated to the capacity prefix — the hot part of a prefix is
+        its head."""
+        blocks = list(blocks)[: self.capacity_blocks]
+        n = len(blocks)
+        if n == 0:
+            return -1
+        if nbytes is None:
+            # analysis: ok host-sync-in-dispatch — leaves are host numpy (tier worker)
+            nbytes = sum(int(np.asarray(x).nbytes)
+                         for blk in blocks for x in blk)
+        # analysis: ok host-sync-in-dispatch — host token list, no device value
+        toks = np.asarray(list(tokens)[: n * self.block_size], np.int64)
+        with self._lock:
+            hid = self._next
+            self._next += 1
+            self._seqs[hid] = {"tokens": toks, "blocks": blocks,
+                               "nbytes": int(nbytes)}
+            self.blocks_held += n
+            self.bytes_held += int(nbytes)
+            self.spills_total += 1
+            # the truncation above bounds any single entry at capacity,
+            # so evicting older entries always converges
+            while self.blocks_held > self.capacity_blocks:
+                self._evict_oldest()
+            return hid if hid in self._seqs else -1
+
+    def _evict_oldest(self) -> None:
+        _hid, entry = self._seqs.popitem(last=False)
+        self.blocks_held -= len(entry["blocks"])
+        self.bytes_held -= entry["nbytes"]
+        self.evictions_total += 1
+
+    def match(self, prompt_arr: np.ndarray, cap: int
+              ) -> tuple[int, int]:
+        """(hid, lcp tokens) of the deepest host-tier prefix of the
+        prompt; (-1, 0) on a miss.  Same contract as
+        :meth:`BlockAllocator.match`, one tier down."""
+        best_hid, best = -1, 0
+        with self._lock:
+            for hid, entry in self._seqs.items():
+                toks = entry["tokens"]
+                lim = min(len(toks), cap)
+                if lim <= best:
+                    continue
+                n = lcp(toks, prompt_arr, lim)
+                if n > best:
+                    best_hid, best = hid, n
+        return best_hid, best
+
+    def take(self, hid: int, nblocks: int) -> Optional[list]:
+        """The first ``nblocks`` host leaf-lists of entry ``hid`` (a
+        restore reads only the matched full blocks), LRU-touched; None
+        when the entry was evicted between match and take."""
+        with self._lock:
+            entry = self._seqs.get(hid)
+            if entry is None:
+                return None
+            self._seqs.move_to_end(hid)
+            self.restores_total += 1
+            return entry["blocks"][:nblocks]
+
+    def contains_prefix(self, tokens, min_tokens: int = 1) -> bool:
+        """True when some entry already covers >= min_tokens of
+        ``tokens`` — the spill path's dedup probe (re-spilling a hot
+        shared prefix on every retirement would churn the LRU)."""
+        # analysis: ok host-sync-in-dispatch — host token list, no device value
+        arr = np.asarray(list(tokens), np.int64)
+        _hid, n = self.match(arr, len(arr))
+        return n >= max(int(min_tokens), 1)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "kv_blocks_host_tier": self.blocks_held,
+                "kv_host_bytes": self.bytes_held,
+                "kv_host_capacity_blocks": self.capacity_blocks,
+                "kv_host_spills_total": self.spills_total,
+                "kv_host_restores_total": self.restores_total,
+                "kv_host_evictions_total": self.evictions_total,
+            }

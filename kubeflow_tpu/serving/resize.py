@@ -424,6 +424,13 @@ class GangResizer:
             prefix_cache=src.prefix_cache, min_prefix=src.min_prefix,
             spec_k=src.spec_k, spec_ngram=src.spec_ngram,
             draft_proposer=src._proposer, block_size=src.block_size,
+            # host KV tier (ISSUE 12): the mirror is rebuilt empty at
+            # the new degree (its bytes are shaped for the old pool);
+            # the watermark policy carries over.  num_blocks is scaled
+            # separately, so the host budget just carries verbatim.
+            host_blocks=src.host_blocks,
+            host_watermark=(src._host_watermark_blocks
+                            / max(src.num_blocks, 1)),
             admission_policy=orig_policy, role=src.role,
         )
 
@@ -564,6 +571,11 @@ class GangResizer:
                 # kill-mid-resize leaks on EITHER side land in the same
                 # kv_blocks_leaked_total tally
                 new.attach_block_ledger(src.block_ledger)
+            if getattr(src, "spill_store", None) is not None:
+                # durable sessions (ISSUE 12) survive a degree change:
+                # the storage tier re-attaches so hibernated entries
+                # stay thaw-able and the session gauges keep reporting
+                new.attach_spill_store(src.spill_store)
             self._fail("reshard")
             # rebuild the warmed-program ladder at the new degree: a
             # post-resize dispatch must never compile mid-serving (gang

@@ -428,6 +428,29 @@ class ModelServer:
                         if isinstance(v, (int, float)):
                             families.setdefault(f"kft_engine_{k}", []).append(
                                 f'kft_engine_{k}{{model="{name}"}} {v}')
+                # block-registry digest (ISSUE 12): the replica's hot
+                # prefixes as chained content keys — the cluster
+                # KvBlockRegistry probes these rows (rank-0 for gangs)
+                # to route a cold replica's kv_fetch at a peer that
+                # already holds the KV
+                census = getattr(engine, "prefix_census", None)
+                if callable(census) and getattr(engine, "paged", False):
+                    from .paged import prefix_digest
+                    from .traffic import prom_label
+
+                    try:
+                        digest = prefix_digest(census(),
+                                               engine.block_size)
+                    except Exception as e:  # noqa: BLE001 — a wedged
+                        # scheduler must degrade the scrape, not 500 it
+                        log.debug("prefix census failed: %s", e)
+                        digest = {}
+                    for key, depth in sorted(digest.items()):
+                        families.setdefault(
+                            "kft_kv_prefix_key", []).append(
+                            f'kft_kv_prefix_key{{model='
+                            f'"{prom_label(name)}",key="{key}"}} '
+                            f'{depth}')
                 # traffic-plane gauges (QoS admission/shed/preemption
                 # accounting — serving/traffic.py) ride the same
                 # export; per-class counters carry the class as a

@@ -48,6 +48,12 @@ class StorageError(RuntimeError):
     pass
 
 
+class SpillCorrupt(StorageError):
+    """A spill entry's MANIFEST is unreadable or self-inconsistent: the
+    session cannot be reconstructed from this tier (payload corruption
+    is softer — the manifest's token record still re-prefills)."""
+
+
 def register_mem(key: str, value: Any) -> str:
     """Publish an object under ``mem://<key>`` (test/bench convenience)."""
     _MEM_REGISTRY[key] = value
@@ -438,6 +444,387 @@ def _stale_staging_dirs(cache_dir: str, key: str) -> list[str]:
         except OSError:
             continue
     return out
+
+
+# ---------------------------------------------------------------------------
+# KV spill store: the storage tier of the paged-KV economy (ISSUE 12)
+# ---------------------------------------------------------------------------
+
+
+SPILL_MANIFEST = "spill.json"
+
+
+def _np_spill_dtype(name: str):
+    import numpy as np
+
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes  # bf16/f8 dtype names register through ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def _pack_spill_leaves(leaves) -> bytes:
+    import numpy as np
+
+    # analysis: ok host-sync-in-dispatch — snapshot leaves are host numpy (spill worker)
+    return b"".join(np.ascontiguousarray(np.asarray(x)).tobytes()
+                    for x in leaves)
+
+
+def _unpack_spill_leaves(payload: bytes, specs: list) -> list:
+    import numpy as np
+
+    out, off = [], 0
+    for s in specs:
+        dt = _np_spill_dtype(s["dtype"])
+        n = int(np.prod(s["shape"], dtype=np.int64)) * dt.itemsize
+        out.append(np.frombuffer(
+            payload[off:off + n], dtype=dt).reshape(s["shape"]).copy())
+        off += n
+    if off != len(payload):
+        raise SpillCorrupt(
+            f"spill payload {len(payload)}B != leaf specs {off}B")
+    return out
+
+
+def _fsync_file(path: str) -> None:
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _fsync_dir(path: str) -> None:
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return  # platforms without dir-fd fsync: rename is still atomic
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+class KvSpillStore:
+    """Manifest-verified storage tier for hibernated sessions (ISSUE 12).
+
+    The spill wire format IS the PR 7 ``export_sequence`` snapshot:
+    scheduler meta (tokens, position, budget, sampling knobs) in a JSON
+    manifest, block leaf bytes + the next-token logits row in packed
+    binary payloads.  Crash-safety is the PR 5 discipline one tier down:
+
+    - WRITE: everything lands in a hidden ``.staging-`` dir (payloads
+      fsync'd, then the manifest, then the dir), published by ONE atomic
+      ``rename``.  A writer that dies mid-spill leaves a stale staging
+      dir (garbage-collected later) and NO entry — the source engine
+      still owns the sequence and resumes in place.
+    - READ: the manifest records every payload file's size + sha256 AND
+      the sequence's chained ``paged.block_keys`` content index.  A torn
+      or corrupted payload is detected at thaw — the caller re-prefills
+      from the manifest's token record instead of serving wrong KV
+      (``kv_spill_verify_failures_total``).  An unreadable manifest
+      raises :class:`SpillCorrupt`: that session is not recoverable from
+      this tier.
+
+    ``chaos`` takes a :class:`~kubeflow_tpu.chaos.plan.FaultPlan`: the
+    store polls its ``due_spill_kills`` / ``due_spill_torn`` /
+    ``due_tier_stalls`` actuators at the matching phase boundaries.
+    All I/O here runs on hibernation worker threads — the analyzer
+    roots ``*Spill`` classes so a path onto an engine scheduler thread
+    fails tier-1.
+    """
+
+    def __init__(self, root: str, *, fsync: bool = True, chaos=None):
+        import threading
+
+        self.root = root
+        self.fsync = bool(fsync)
+        self.chaos = chaos
+        os.makedirs(root, exist_ok=True)
+        #: ONE store is shared by every engine behind a runtime and
+        #: hibernations run on arbitrary caller threads — counters are
+        #: locked (bare += across threads loses increments) and the
+        #: per-write chaos kill set is threaded through LOCALS, never
+        #: instance state (a concurrent write's cleanup would clear
+        #: another write's drawn fault)
+        self._mu = threading.Lock()
+        self.writes_total = 0
+        self.reads_total = 0
+        self.verify_failures_total = 0
+
+    # -- chaos seams -------------------------------------------------------
+
+    def _stall(self) -> None:
+        if self.chaos is not None:
+            for s in self.chaos.due_tier_stalls():
+                time.sleep(s)
+
+    @staticmethod
+    def _maybe_kill(phase: str, due: set) -> None:
+        if phase in due:
+            raise StorageError(f"chaos: spill writer killed mid-{phase}")
+
+    # -- paths -------------------------------------------------------------
+
+    def _entry_dir(self, session_id: str) -> str:
+        key = hashlib.sha256(session_id.encode()).hexdigest()[:24]
+        return os.path.join(self.root, key)
+
+    def sessions(self) -> list[str]:
+        """Session ids of every published spill entry."""
+        out = []
+        try:
+            names = sorted(os.listdir(self.root))
+        except OSError:
+            return out
+        for name in names:
+            if name.startswith("."):
+                continue
+            mpath = os.path.join(self.root, name, SPILL_MANIFEST)
+            try:
+                with open(mpath) as f:
+                    out.append(json.load(f)["session"])
+            except (OSError, json.JSONDecodeError, KeyError):
+                continue
+        return out
+
+    def contains(self, session_id: str) -> bool:
+        return os.path.exists(
+            os.path.join(self._entry_dir(session_id), SPILL_MANIFEST))
+
+    def session_count(self) -> int:
+        """Published entries (cheap dir scan — the ``/metrics`` gauge
+        ``kv_sessions_hibernated`` reads this per scrape)."""
+        try:
+            names = os.listdir(self.root)
+        except OSError:
+            return 0
+        return sum(
+            1 for name in names
+            if not name.startswith(".") and os.path.exists(
+                os.path.join(self.root, name, SPILL_MANIFEST)))
+
+    # -- write (spill) -----------------------------------------------------
+
+    def write(self, session_id: str, snapshot: dict,
+              block_keys: Optional[list] = None) -> str:
+        """Persist one exported snapshot atomically; returns the entry
+        dir.  Overwrites an existing entry for the session (the newest
+        hibernation wins — the rename replaces nothing in place, the
+        old entry is removed only after the new one published)."""
+        import numpy as np
+
+        self._stall()
+        # the drawn kill is LOCAL to this write: a concurrent write's
+        # completion must not clear it before the phase boundary fires
+        due = set(self.chaos.due_spill_kills()) if self.chaos else set()
+        entry_dir = self._entry_dir(session_id)
+        key = os.path.basename(entry_dir)
+        for leftover in _stale_staging_dirs(self.root, key):
+            shutil.rmtree(leftover, ignore_errors=True)
+        # displaced-entry debris: a crash between the two publish
+        # renames below leaves a superseded copy under a hidden
+        # ``.old-<key>-`` name — by construction garbage (the replace
+        # only runs after the NEW entry staged fully), so any age GCs
+        try:
+            for name in os.listdir(self.root):
+                if name.startswith(f".old-{key}-"):
+                    shutil.rmtree(os.path.join(self.root, name),
+                                  ignore_errors=True)
+        except OSError:
+            pass
+        tmp_dir = os.path.join(
+            self.root, f".staging-{key}-{os.getpid()}-{uuid.uuid4().hex[:8]}")
+        os.makedirs(tmp_dir)
+        try:
+            blocks = snapshot.get("blocks", [])
+            logits = snapshot.get("logits")
+            # analysis: ok host-sync-in-dispatch — snapshot leaves are host numpy (spill worker)
+            leaves = ([{"dtype": str(np.asarray(x).dtype),
+                        "shape": list(np.shape(x))} for x in blocks[0]]
+                      if blocks else [])
+            files = []
+            payload = b"".join(_pack_spill_leaves(blk) for blk in blocks)
+            ppath = os.path.join(tmp_dir, "blocks.bin")
+            with open(ppath, "wb") as f:
+                f.write(payload)
+                if self.fsync:
+                    f.flush()
+                    os.fsync(f.fileno())
+            files.append({"path": "blocks.bin", "size": len(payload),
+                          "sha256": hashlib.sha256(payload).hexdigest()})
+            self._maybe_kill("payload", due)
+            logits_spec = None
+            if logits is not None:
+                # analysis: ok host-sync-in-dispatch — logits row is host numpy (spill worker)
+                row = np.asarray(logits)
+                logits_spec = {"dtype": str(row.dtype),
+                               "shape": list(row.shape)}
+                lpay = _pack_spill_leaves([row])
+                lpath = os.path.join(tmp_dir, "logits.bin")
+                with open(lpath, "wb") as f:
+                    f.write(lpay)
+                    if self.fsync:
+                        f.flush()
+                        os.fsync(f.fileno())
+                files.append({"path": "logits.bin", "size": len(lpay),
+                              "sha256": hashlib.sha256(lpay).hexdigest()})
+            meta = {k: v for k, v in snapshot.items()
+                    if k not in ("blocks", "logits", "blocks_dev",
+                                 "logits_dev")}
+            manifest = {
+                "session": session_id, "created": time.time(),
+                "meta": meta, "leaves": leaves, "nblocks": len(blocks),
+                "logits": logits_spec,
+                #: chained content keys (paged.block_keys) — the
+                #: cluster-scope content-addressed index of this spill
+                "block_keys": [int(k) for k in (block_keys or [])],
+                "files": files,
+            }
+            mpath = os.path.join(tmp_dir, SPILL_MANIFEST)
+            with open(mpath, "w") as f:
+                json.dump(manifest, f)
+                if self.fsync:
+                    f.flush()
+                    os.fsync(f.fileno())
+            self._maybe_kill("meta", due)
+            if self.fsync:
+                _fsync_dir(tmp_dir)
+            self._maybe_kill("publish", due)
+            old = None
+            if os.path.exists(entry_dir):
+                # replace: move the old entry to a HIDDEN .old- name
+                # (session listings skip dotted dirs; a crash before
+                # the rmtree leaves debris the next same-key write
+                # GCs above), then rename the staged copy in.  The
+                # gap between the two renames is a brief no-manifest
+                # window — only a concurrent reader of the SAME
+                # session could see it, and a session has one owner.
+                old = os.path.join(
+                    self.root, f".old-{key}-{uuid.uuid4().hex[:8]}")
+                os.rename(entry_dir, old)
+            os.rename(tmp_dir, entry_dir)
+            if self.fsync:
+                _fsync_dir(self.root)
+            if old is not None:
+                shutil.rmtree(old, ignore_errors=True)
+        except BaseException:
+            # a chaos kill (or real I/O error) publishes NOTHING; the
+            # staging dir stays for the stale-GC, exactly as a kill -9
+            # would leave it
+            raise
+        with self._mu:
+            self.writes_total += 1
+        if self.chaos is not None:
+            for torn in self.chaos.due_spill_torn():
+                self._tear(entry_dir, torn)
+        return entry_dir
+
+    @staticmethod
+    def _tear(entry_dir: str, torn_bytes: int) -> None:
+        """Chaos actuator: drop the last ``torn_bytes`` of the payload
+        (a torn write at the device layer — the manifest survives, the
+        hash check must catch the loss)."""
+        p = os.path.join(entry_dir, "blocks.bin")
+        try:
+            size = os.path.getsize(p)
+            with open(p, "r+b") as f:
+                f.truncate(max(size - max(int(torn_bytes), 1), 0))
+        except OSError:
+            pass
+
+    # -- read (thaw) -------------------------------------------------------
+
+    def read(self, session_id: str) -> tuple[dict, bool]:
+        """(snapshot, payload_ok) for a hibernated session.
+
+        The snapshot always carries the manifest's scheduler meta —
+        enough to RE-PREFILL the session from tokens.  ``payload_ok``
+        is True only when every payload file matched its recorded
+        size + sha256; then (and only then) ``blocks``/``logits`` are
+        attached and the thaw may scatter them.  Raises
+        :class:`SpillCorrupt` when the manifest itself is missing or
+        unreadable."""
+        self._stall()
+        entry_dir = self._entry_dir(session_id)
+        mpath = os.path.join(entry_dir, SPILL_MANIFEST)
+        try:
+            with open(mpath) as f:
+                manifest = json.load(f)
+            meta = dict(manifest["meta"])
+            nblocks = int(manifest["nblocks"])
+            specs = list(manifest["leaves"])
+        except (OSError, json.JSONDecodeError, KeyError, TypeError,
+                ValueError) as e:
+            raise SpillCorrupt(
+                f"session {session_id!r}: spill manifest unreadable: "
+                f"{e}") from e
+        with self._mu:
+            self.reads_total += 1
+        snapshot = dict(meta)
+        ok = True
+        payloads: dict[str, bytes] = {}
+        for rec in manifest.get("files", []):
+            p = os.path.join(entry_dir, rec["path"])
+            try:
+                with open(p, "rb") as f:
+                    data = f.read()
+                if len(data) != int(rec["size"]) or (
+                        hashlib.sha256(data).hexdigest() != rec["sha256"]):
+                    ok = False
+                    break
+                payloads[rec["path"]] = data
+            except OSError:
+                ok = False
+                break
+        if ok:
+            try:
+                per_block = _unpack_spill_leaves(
+                    payloads.get("blocks.bin", b""),
+                    [s for _ in range(nblocks) for s in specs])
+                step = len(specs)
+                snapshot["blocks"] = [
+                    per_block[i * step:(i + 1) * step]
+                    for i in range(nblocks)]
+                if manifest.get("logits") is not None:
+                    snapshot["logits"] = _unpack_spill_leaves(
+                        payloads.get("logits.bin", b""),
+                        [manifest["logits"]])[0]
+            except SpillCorrupt:
+                ok = False
+                snapshot.pop("blocks", None)
+                snapshot.pop("logits", None)
+        if not ok:
+            with self._mu:
+                self.verify_failures_total += 1
+        return snapshot, ok
+
+    def read_manifest(self, session_id: str) -> dict:
+        """The raw manifest (block_keys index, file records) — the
+        cluster registry's probe surface."""
+        mpath = os.path.join(self._entry_dir(session_id), SPILL_MANIFEST)
+        try:
+            with open(mpath) as f:
+                return json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            raise SpillCorrupt(
+                f"session {session_id!r}: spill manifest unreadable: "
+                f"{e}") from e
+
+    def delete(self, session_id: str) -> None:
+        shutil.rmtree(self._entry_dir(session_id), ignore_errors=True)
+
+    def stats(self) -> dict:
+        return {
+            "kv_spill_writes_total": self.writes_total,
+            "kv_spill_reads_total": self.reads_total,
+            "kv_spill_verify_failures_total": self.verify_failures_total,
+        }
 
 
 def list_cache(cache_dir: str) -> list[dict]:

@@ -275,6 +275,10 @@ class TextGenerator(Model):
         #: load() from config["qos"]; ModelServer consults it on the
         #: OpenAI paths (429 + Retry-After sheds, priority injection)
         self.traffic = None
+        #: durable-session storage tier (ISSUE 12) — built by load()
+        #: from config["hibernation"] and attached to every paged
+        #: engine (hibernate/thaw + the /metrics session gauges)
+        self.spill_store = None
 
     def _build_traffic(self) -> None:
         qos = self.config.get("qos")
@@ -307,6 +311,59 @@ class TextGenerator(Model):
         for eng in engines:
             self.traffic.attach_engine(eng)
 
+    def _build_hibernation(self) -> None:
+        """Attach the manifest-verified spill store (ISSUE 12) to every
+        paged engine behind this runtime: sessions hibernate through it
+        and any replica configured with the same root can thaw them."""
+        hib = self.config.get("hibernation")
+        if not hib:
+            return
+        from .storage import KvSpillStore
+
+        self.spill_store = KvSpillStore(
+            str(hib["root"]), fsync=bool(hib.get("fsync", True)))
+        for eng in self._hibernation_engines():
+            eng.attach_spill_store(self.spill_store)
+
+    def _hibernation_engines(self) -> list:
+        """The paged engines the store is attached to — for a
+        DisaggregatedPool that is prefill AND decode tiers (a live
+        request owns a slot on exactly one of them)."""
+        if getattr(self.engine, "paged", False):
+            return [self.engine]
+        return [e for e in getattr(self.engine, "pools", [])
+                if getattr(e, "paged", False)]
+
+    def hibernate_session(self, req, session_id: str) -> bool:
+        """Park a live request durably (engine.hibernate_sequence via
+        the attached store) — the blocks spill to storage, the slot
+        frees, and ``resume_session`` continues it on ANY replica
+        sharing the store root (bit-identical greedy).  Tries every
+        paged engine behind this runtime: under disaggregation (or
+        after a migration) the sequence may live on any tier, and an
+        engine that does not own it just reports nothing-to-export.
+        False = the request already finished."""
+        if self.spill_store is None:
+            raise RuntimeError("no hibernation store configured")
+        for eng in self._hibernation_engines():
+            if eng.hibernate_sequence(req, session_id):
+                return True
+        return False
+
+    def resume_session(self, session_id: str, req=None):
+        """(req, info): thaw a hibernated session from the store.
+        Prefers a decode-capable engine (a prefill-role engine would
+        hand the sequence off instead of decoding it), most free
+        blocks first."""
+        if self.spill_store is None:
+            raise RuntimeError("no hibernation store configured")
+        engines = self._hibernation_engines()
+        decodable = [e for e in engines
+                     if getattr(e, "role", "mixed") != "prefill"]
+        pool = decodable or engines
+        eng = max(pool, key=lambda e: e._alloc.free_blocks)
+        return eng.thaw_sequence(session_id, req=req)
+
     def load(self) -> None:
         from .continuous import build_engine, resolve_model_source
 
@@ -324,6 +381,7 @@ class TextGenerator(Model):
                 # would stop differently
                 self.engine.eos_id = getattr(self.tokenizer, "eos_id", None)
             self._build_traffic()
+            self._build_hibernation()
             self.ready = True
             return
         cfg, params = resolve_model_source(self.config, name=self.name)
@@ -336,6 +394,7 @@ class TextGenerator(Model):
             cfg, params, self.config, default_eos=eos,
             default_max_new_tokens=32)
         self._build_traffic()
+        self._build_hibernation()
         self.ready = True
 
     def swap_engine(self, engine) -> None:

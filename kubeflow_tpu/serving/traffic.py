@@ -246,6 +246,139 @@ class PrefixAffinity:
         return None, 0
 
 
+class SessionAffinity:
+    """Session id -> backend map: where a durable session's KV lives
+    (ISSUE 12).  A resumed conversation routes to the replica whose
+    pool (HBM/host tier) still holds its blocks — warm resume.  When
+    that replica died, ``forget`` dropped it and the resume routes
+    least-loaded instead: ANY replica can thaw the session from the
+    shared storage tier, which is exactly the durability contract (the
+    affinity is a latency optimization, never a correctness
+    dependency).  Bounded LRU, same shape as :class:`PrefixAffinity`."""
+
+    def __init__(self, capacity: int = 8192):
+        self.capacity = int(capacity)
+        self._map: "collections.OrderedDict[str, str]" = \
+            collections.OrderedDict()
+        self._lock = threading.Lock()
+        self.hits_total = 0
+        self.misses_total = 0
+
+    def observe(self, session: str, backend: str) -> None:
+        if not session:
+            return
+        with self._lock:
+            self._map.pop(session, None)
+            self._map[session] = backend
+            while len(self._map) > self.capacity:
+                self._map.popitem(last=False)
+
+    def forget(self, backend: str) -> None:
+        with self._lock:
+            stale = [s for s, b in self._map.items() if b == backend]
+            for s in stale:
+                del self._map[s]
+
+    def best(self, session: str, candidates) -> Optional[str]:
+        if not session:
+            return None
+        with self._lock:
+            b = self._map.get(session)
+            if b is not None and b in set(candidates):
+                self.hits_total += 1
+                return b
+        self.misses_total += 1
+        return None
+
+
+class KvBlockRegistry:
+    """Cluster-scope content-addressed block registry (ISSUE 12, the
+    r12 gang-affinity residual): chained block-content key -> the
+    replica whose /metrics block-registry digest (rank-0 for gangs)
+    last advertised it, with the advertised chain depth.
+
+    ``probe``/``observe_metrics`` ingest ``kft_kv_prefix_key`` rows
+    (serving/server.py renders them from ``paged.prefix_digest``);
+    ``locate`` answers "which live replica holds the deepest prefix of
+    this prompt" so a cold replica can ``kv_fetch`` the KV from a peer
+    (serving/gang.py) and ``install_prefix`` it instead of recomputing
+    — prefill-once-per-cluster.  Bounded LRU per the PrefixAffinity
+    convention; blocking HTTP probes belong on controller/router
+    threads, never an engine scheduler."""
+
+    def __init__(self, capacity: int = 8192):
+        self.capacity = int(capacity)
+        #: key (int) -> (backend, depth)
+        self._map: "collections.OrderedDict[int, tuple[str, int]]" = \
+            collections.OrderedDict()
+        self._lock = threading.Lock()
+        self.probes_total = 0
+        self.hits_total = 0
+        self.misses_total = 0
+
+    def observe_metrics(self, backend: str, metrics_text: str) -> int:
+        """Ingest one replica's /metrics exposition; returns the number
+        of registry rows seen."""
+        import re
+
+        rows = re.findall(
+            r'^kft_kv_prefix_key\{[^}]*key="([0-9a-f]+)"[^}]*\}\s+'
+            r'(\d+)', metrics_text, re.MULTILINE)
+        with self._lock:
+            for key_hex, depth in rows:
+                k = int(key_hex, 16)
+                self._map.pop(k, None)
+                self._map[k] = (backend, int(depth))
+            while len(self._map) > self.capacity:
+                self._map.popitem(last=False)
+        return len(rows)
+
+    def probe(self, backend: str, url: str, timeout: float = 2.0) -> int:
+        """Scrape ``url``/metrics and ingest its registry rows (the
+        rank-0 probe).  Returns rows seen; 0 on any failure."""
+        import urllib.request
+
+        self.probes_total += 1
+        try:
+            with urllib.request.urlopen(
+                    url.rstrip("/") + "/metrics", timeout=timeout) as r:
+                return self.observe_metrics(backend, r.read().decode())
+        except (OSError, ValueError):
+            return 0
+
+    def forget(self, backend: str) -> None:
+        """A dead replica's KV died with it."""
+        with self._lock:
+            stale = [k for k, (b, _d) in self._map.items()
+                     if b == backend]
+            for k in stale:
+                del self._map[k]
+
+    def locate(self, keys: list[int],
+               exclude=()) -> tuple[Optional[str], int]:
+        """(backend, matched block depth) for the deepest key of this
+        chain any known replica advertises; (None, 0) on a miss."""
+        skip = set(exclude)
+        with self._lock:
+            for depth in range(len(keys), 0, -1):
+                hit = self._map.get(keys[depth - 1])
+                if hit is not None and hit[0] not in skip:
+                    self.hits_total += 1
+                    return hit[0], depth
+        self.misses_total += 1
+        return None, 0
+
+    def stats(self) -> dict:
+        with self._lock:
+            entries = len(self._map)
+        return {
+            "kv_registry_entries": entries,
+            "kv_registry_probes_total": self.probes_total,
+            "kv_registry_hits_total": self.hits_total,
+            "kv_registry_misses_total": self.misses_total,
+        }
+
+
 def prom_label(value) -> str:
     """Escape a Prometheus label VALUE (backslash, quote, newline per
     the text exposition format) — class names and model names are
@@ -431,6 +564,11 @@ class TrafficPlane:
         #: tokenizer / block-economy quanta (block_keys units)
         self.affinity_block = int(affinity_block)
         self.affinity = PrefixAffinity(affinity_capacity)
+        #: durable-session affinity (ISSUE 12): a resume routes to the
+        #: replica still holding the session's KV; a dead replica's
+        #: entries are forgotten with its prefix affinity, and the
+        #: resume then lands anywhere — the storage tier thaws it
+        self.sessions = SessionAffinity(affinity_capacity)
         self.preemptors: list[EnginePreemptor] = []
 
     # -- class resolution --------------------------------------------------
@@ -568,16 +706,29 @@ class TrafficPlane:
         return block_keys(tokens, self.affinity_block)
 
     def route(self, keys: list[int], backends: list[str],
-              load: Optional[Callable[[str], int]] = None
-              ) -> tuple[str, int]:
+              load: Optional[Callable[[str], int]] = None,
+              session: Optional[str] = None) -> tuple[str, int]:
         """(backend, affinity depth): the replica already holding the
         deepest prefix of this request, unless it is overloaded
         relative to its peers (> 2x the mean load + 1 — a hot shared
         prefix must not melt one replica); otherwise least-loaded
         (``load`` callable; index 0 on ties/no signal).  The choice is
-        recorded so the NEXT same-prefix request finds it."""
+        recorded so the NEXT same-prefix request finds it.
+
+        ``session`` (ISSUE 12) outranks prefix affinity: a durable
+        session's resume goes to the replica whose pool still holds
+        its blocks — warm, no thaw.  No overload veto here: moving the
+        resume elsewhere pays a storage thaw, strictly worse than a
+        busy-but-alive replica.  A session whose replica died routes
+        like any fresh request (the storage tier thaws anywhere)."""
         if not backends:
             raise ValueError("route needs at least one backend")
+        if session:
+            sticky = self.sessions.best(session, backends)
+            if sticky is not None:
+                self.affinity.observe(keys, sticky)
+                self.sessions.observe(session, sticky)
+                return sticky, 0
         choice, depth = self.affinity.best(keys, backends)
         if choice is not None and load is not None and len(backends) > 1:
             # overload check against the PEERS' mean: including the
@@ -594,6 +745,8 @@ class TrafficPlane:
             else:
                 choice = backends[0]
         self.affinity.observe(keys, choice)
+        if session:
+            self.sessions.observe(session, choice)
         return choice, depth
 
     # -- preemption --------------------------------------------------------
@@ -627,6 +780,8 @@ class TrafficPlane:
         out: dict[str, Any] = {
             "qos_affinity_hits_total": self.affinity.hits_total,
             "qos_affinity_misses_total": self.affinity.misses_total,
+            "session_affinity_hits_total": self.sessions.hits_total,
+            "session_affinity_misses_total": self.sessions.misses_total,
         }
         with self._lock:
             out["classes"] = {

@@ -348,6 +348,69 @@ def run_drain_trial(i: int, conversations: int = 4) -> dict:
         dst.stop()
 
 
+def run_hibernate_trial(i: int, conversations: int = 4) -> dict:
+    """One session hibernate/resume cycle (ISSUE 12): N live
+    conversations spill to the manifest-verified storage tier (spill =
+    export -> atomic write -> release), their replica DIES, and a
+    fresh replica thaws every session from storage alone.  Measured:
+    spill and thaw wall per session, plus the HBM blocks recovered
+    while the sessions sleep (the free-list headroom hibernation
+    buys)."""
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from kubeflow_tpu.models import llama as llamalib
+    from kubeflow_tpu.serving.continuous import ContinuousEngine
+    from kubeflow_tpu.serving.storage import KvSpillStore
+
+    cfg = llamalib.tiny()
+    params = llamalib.Llama(cfg).init(
+        jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32))["params"]
+    kw = dict(num_slots=conversations, decode_chunk=2,
+              prefix_cache=False, block_size=16)
+    store = KvSpillStore(tempfile.mkdtemp(prefix="kvspill-bench-"))
+    src = ContinuousEngine(cfg, params, **kw)
+    dst = None
+    try:
+        src.warmup()
+        reqs = [src.submit([7 + i, 8, 9, j + 1] * 8, max_new_tokens=64)
+                for j in range(conversations)]
+        while any(len(r.tokens) < 2 for r in reqs):
+            time.sleep(0.002)
+        free_before = src.stats()["kv_blocks_free"]
+        spill_t0 = time.perf_counter()
+        for j, r in enumerate(reqs):
+            src.hibernate_sequence(r, f"sess-{i}-{j}", store=store)
+        spill_s = time.perf_counter() - spill_t0
+        freed = src.stats()["kv_blocks_free"] - free_before
+        src.stop()  # replica death: storage is all that survives
+
+        dst = ContinuousEngine(cfg, params, **kw)
+        dst.warmup()
+        counts = [len(r.tokens) for r in reqs]
+        thaw_t0 = time.perf_counter()
+        thawed = [dst.thaw_sequence(f"sess-{i}-{j}", store=store,
+                                    req=reqs[j])[0]
+                  for j in range(conversations)]
+        while any(len(r.tokens) <= c for r, c in zip(thawed, counts)
+                  if not r.done.is_set()):
+            time.sleep(0.001)
+        thaw_s = time.perf_counter() - thaw_t0
+        for r in thawed:
+            r.cancel()
+        return {"spill_s": spill_s, "thaw_resume_s": thaw_s,
+                "hbm_blocks_recovered": freed,
+                "conversations": conversations,
+                "recompiles": dst.stats()["jit_recompiles_total"],
+                "verify_failures": store.verify_failures_total}
+    finally:
+        src.stop()
+        if dst is not None:
+            dst.stop()
+
+
 def run_resize_trial(i: int, conversations: int) -> dict:
     """One elastic shrink: a TP=2 paged engine with N live
     conversations resizes to the surviving degree; measured = resize
@@ -460,6 +523,38 @@ def main() -> None:
         **_percentiles([r["drain_resume_s"] for r in drain_rows]),
         "moved_total": sum(r["moved"] for r in drain_rows),
         "failed_total": sum(r["failed"] for r in drain_rows),
+    }))
+
+    # session hibernate/resume (ISSUE 12): spill to storage, replica
+    # dies, every session thaws on a fresh replica
+    hib_trials = max(3, trials // 3)
+    hib_rows = []
+    for i in range(hib_trials):
+        row = run_hibernate_trial(i)
+        hib_rows.append(row)
+        print("# hibernate trial", i, json.dumps({
+            k: (round(v, 3) if isinstance(v, float) else v)
+            for k, v in row.items()}), file=sys.stderr)
+    phase_p50 = {}
+    for key in ("spill_s", "thaw_resume_s"):
+        vals = sorted(r[key] for r in hib_rows)
+        phase_p50[key] = round(vals[len(vals) // 2], 3)
+    print(json.dumps({
+        "metric": "session_hibernate_resume_p50_seconds",
+        "unit": (f"s (spill {hib_rows[0]['conversations']} live "
+                 "conversations to storage -> replica death -> all "
+                 "thawed and decoding on a FRESH replica, manifest-"
+                 f"verified KvSpillStore, n={hib_trials}, tiny model "
+                 "CPU stand-in)"),
+        **_percentiles([r["spill_s"] + r["thaw_resume_s"]
+                        for r in hib_rows]),
+        "phase_p50": phase_p50,
+        "hbm_blocks_recovered_p50": sorted(
+            r["hbm_blocks_recovered"]
+            for r in hib_rows)[len(hib_rows) // 2],
+        "recompiles_total": sum(r["recompiles"] for r in hib_rows),
+        "verify_failures_total": sum(
+            r["verify_failures"] for r in hib_rows),
     }))
 
     # elastic gang resize (ISSUE 10): TP shrink with live conversations,

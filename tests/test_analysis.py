@@ -221,6 +221,75 @@ class ResizeReport:
                             ["host-sync-in-dispatch"],
                             rel="kubeflow_tpu/serving/_resize.py") == []
 
+    def test_tier_spill_hibernate_classes_rooted(self, tmp_path):
+        """ISSUE 12 satellite: the KV-tier classes join the walk —
+        ``*BlockPool`` by suffix (its match/take run ON the scheduler
+        thread at admission), anything named *Tier*/*Spill*/*Hibernat*
+        by substring (spill stores, hibernation orchestrators).  An
+        UNdeclared device fetch or blocking socket in tier bookkeeping
+        must surface: spill I/O never runs on the scheduler — the
+        mailbox seam is the only crossing."""
+        code = """
+import jax
+import numpy as np
+
+class HostBlockPool:
+    def match(self, arr):
+        return int(self._depths.max())
+
+class KvSpillStore:
+    def write(self, snap):
+        return [np.asarray(x) for x in snap]
+
+class SessionHibernator:
+    def pump(self):
+        return jax.device_get(self._leaves)
+"""
+        found = lint_snippet(tmp_path, code, ["host-sync-in-dispatch"],
+                             rel="kubeflow_tpu/serving/_tier.py")
+        scopes = {f.scope for f in found}
+        assert "HostBlockPool.match" in scopes
+        assert "KvSpillStore.write" in scopes
+        assert "SessionHibernator.pump" in scopes
+
+    def test_tier_near_miss_other_class(self, tmp_path):
+        """Lookalikes without the tier vocabulary (or the BlockPool
+        suffix) stay unrooted — and a pragma'd tier site is a declared
+        boundary, not a finding."""
+        code = """
+import numpy as np
+
+class PoolBlocks:
+    def render(self):
+        return np.asarray(self._rows)
+
+class HostBlockPoolStats:
+    def rows(self):
+        return self._counts.tolist()
+
+class WarmSpillStore:
+    def write(self, snap):
+        # analysis: ok host-sync-in-dispatch — host wire bytes, worker thread
+        return [np.asarray(x) for x in snap]
+"""
+        assert lint_snippet(tmp_path, code,
+                            ["host-sync-in-dispatch"],
+                            rel="kubeflow_tpu/serving/_tier.py") == []
+
+    def test_storage_tier_faults_paired(self):
+        """The ISSUE 12 chaos faults (spill_torn / spill_kill_mid_write
+        / tier_io_stall) must be seen PAIRED by the fault-pairing
+        analyzer: declared FaultKind members with both a builder and a
+        ``due_*`` consumer in chaos/plan.py."""
+        import kubeflow_tpu.chaos.plan as plan_mod
+
+        report = astlint.run_lint(REPO_ROOT, paths=[plan_mod.__file__],
+                                  rules=["fault-pairing"])
+        bad = [f for f in report.findings
+               if "SPILL" in f.message.upper()
+               or "TIER_IO" in f.message.upper()]
+        assert bad == [], bad
+
     def test_blocking_socket_send_in_scheduler_flagged(self, tmp_path):
         """ISSUE 8 satellite: a blocking socket send reachable from an
         engine's scheduler roots stalls every live request for a

@@ -141,6 +141,51 @@ class TestBlockKeys:
         assert aff.best(a, ["r1", "r2"]) == (None, 0)
 
 
+class TestSessionAffinity:
+    """ISSUE 12 units: the durable-session map and its routing rank."""
+
+    def test_observe_best_forget(self):
+        from kubeflow_tpu.serving.traffic import SessionAffinity
+
+        sa = SessionAffinity(capacity=2)
+        sa.observe("a", "b1")
+        sa.observe("b", "b2")
+        assert sa.best("a", ["b1", "b2"]) == "b1"
+        assert sa.best("a", ["b2"]) is None  # dead candidate filtered
+        sa.observe("c", "b1")  # capacity 2: oldest OBSERVATION evicts
+        assert sa.best("a", ["b1", "b2"]) is None  # "a" rolled off
+        assert sa.best("b", ["b1", "b2"]) == "b2"
+        sa.forget("b2")
+        assert sa.best("b", ["b1", "b2"]) is None
+        assert sa.best("", ["b1"]) is None  # no session id: no claim
+
+    def test_route_session_outranks_prefix_affinity(self):
+        plane = TrafficPlane({})
+        keys = block_keys(LONG, 32)
+        # prefix affinity learned b2; the session lives on b1
+        plane.affinity.observe(keys, "b2")
+        plane.sessions.observe("s", "b1")
+        b, _ = plane.route(keys, ["b1", "b2"], load=lambda x: 0,
+                           session="s")
+        assert b == "b1"
+        # the session route TEACHES the prefix map: its KV (prompt
+        # prefix included) now lives where the session resumed, so
+        # sessionless same-prefix traffic follows it there
+        b2, _ = plane.route(keys, ["b1", "b2"], load=lambda x: 0)
+        assert b2 == "b1"
+
+    def test_route_learns_session_on_first_sight(self):
+        plane = TrafficPlane({})
+        b, _ = plane.route([], ["u1", "u2"],
+                           load=lambda x: {"u1": 3, "u2": 0}[x],
+                           session="fresh")
+        assert b == "u2"  # least-loaded on the miss
+        b2, _ = plane.route([], ["u1", "u2"],
+                            load=lambda x: {"u1": 0, "u2": 9}[x],
+                            session="fresh")
+        assert b2 == "u2"  # sticky even when busier: a thaw costs more
+
+
 class TestPlaneDoor:
     def test_rate_shed_carries_retry_after(self):
         plane = TrafficPlane({"t": {"rate": 1, "burst": 1}})
@@ -598,6 +643,74 @@ class TestRouterDoor:
             router.stop()
             srv.stop()
 
+    def test_session_affinity_sticks_and_survives_replica_death(
+            self, text_ref):
+        """ISSUE 12: a durable session's requests stick to one replica
+        (warm KV) and, when that replica dies, re-route to a survivor
+        instead of hanging — the storage tier makes ANY replica a valid
+        thaw target, so the affinity is latency-only."""
+        from kubeflow_tpu.serving.controller import Router
+
+        s1 = _server(text_ref)
+        s2 = _server(text_ref)
+        router = Router(activate=lambda: None)
+        router.set_backends([s1.url, s2.url])
+        router.set_traffic(TrafficPlane({}))
+        try:
+            for i in range(3):
+                code, _, _ = post(
+                    router.url + "/openai/v1/completions",
+                    {"model": "m", "prompt": f"turn {i}",
+                     "max_tokens": 2, "session": "conv-77"})
+                assert code == 200
+            stats = router.backend_stats()
+            assert [st["requests"] for st in stats.values()] == [3], stats
+            assert router.traffic.sessions.hits_total >= 2
+            # the sticky replica dies: the session re-routes, no hang
+            sticky = next(iter(stats))
+            victim = s1 if s1.url == sticky else s2
+            survivor = s2 if victim is s1 else s1
+            victim.stop()
+            code, _, _ = post(
+                router.url + "/openai/v1/completions",
+                {"model": "m", "prompt": "turn 3", "max_tokens": 2,
+                 "session": "conv-77"}, timeout=30)
+            assert code == 200
+            assert router.backend_stats()[survivor.url]["requests"] == 1
+            # and the map now points at the survivor
+            assert router.traffic.sessions.best(
+                "conv-77", [s1.url, s2.url]) == survivor.url
+        finally:
+            router.stop()
+            for srv in (s1, s2):
+                try:
+                    srv.stop()
+                except Exception:
+                    pass
+
+    def test_session_header_routes_too(self, text_ref):
+        """X-KFT-Session is the header spelling of the payload field."""
+        from kubeflow_tpu.serving.controller import Router
+
+        s1 = _server(text_ref)
+        s2 = _server(text_ref)
+        router = Router(activate=lambda: None)
+        router.set_backends([s1.url, s2.url])
+        router.set_traffic(TrafficPlane({}))
+        try:
+            for i in range(3):
+                code, _, _ = post(
+                    router.url + "/openai/v1/completions",
+                    {"model": "m", "prompt": f"t {i}", "max_tokens": 2},
+                    headers={"X-KFT-Session": "conv-h"})
+                assert code == 200
+            stats = router.backend_stats()
+            assert [st["requests"] for st in stats.values()] == [3], stats
+        finally:
+            router.stop()
+            s1.stop()
+            s2.stop()
+
     def test_affinity_routes_shared_prefix_to_same_replica(
             self, text_ref):
         from kubeflow_tpu.serving.controller import Router
@@ -657,6 +770,11 @@ class TestConfFreeze:
             "bad-tenants": {"qos": {"gold": {"rate": 1}},
                             "qos_tenants": {"team": 7}},
             "bad-affinity": {"affinity_block": 0},
+            # hierarchical-KV / durable-session knobs (ISSUE 12)
+            "bad-hib-shape": {"hibernation": {"fsync": True}},
+            "bad-hib-paged": {"hibernation": {"root": "/tmp/kvspill"}},
+            "bad-host-wm": {"block_size": 16, "host_watermark": 2.5},
+            "bad-host-paged": {"host_blocks": 8},
         }
         with Cluster() as cluster:
             cluster.add_tpu_slice("slice-0", 1, 4)
@@ -682,6 +800,9 @@ class TestConfFreeze:
                     (name, isvc.status)
                 needle = ("qos_tenants" if name == "bad-tenants"
                           else "affinity_block" if name == "bad-affinity"
+                          else "hibernation" if name.startswith("bad-hib")
+                          else "host_watermark" if name == "bad-host-wm"
+                          else "host_blocks" if name == "bad-host-paged"
                           else "gold")
                 assert needle in (isvc.status.message or ""), \
                     (name, isvc.status.message)
