@@ -47,6 +47,7 @@ RULE_GROUPS: dict[str, tuple[str, ...]] = {
     "locks": ("lock-order",),
     "threads": ("thread-affinity",),
     "protocol": ("op-table", "fault-pairing"),
+    "metrics": ("metrics-contract",),
 }
 
 

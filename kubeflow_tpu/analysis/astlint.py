@@ -156,6 +156,7 @@ def _ensure_rules_loaded() -> None:
         rules_dispatch,
         rules_hygiene,
         rules_locks,
+        rules_metrics,
         rules_protocol,
         rules_threads,
     )

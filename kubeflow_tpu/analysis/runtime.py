@@ -41,6 +41,7 @@ handed.
 
 from __future__ import annotations
 
+import re
 import sys
 import threading
 from typing import Any, Callable, Iterable, Optional
@@ -629,3 +630,57 @@ class BlockLedger:
                     for bk in self._books.values()
                 },
             }
+
+
+# ---------------------------------------------------------------------------
+# metrics-contract: the runtime (value-dependent) half
+# ---------------------------------------------------------------------------
+
+_METRIC_NAME = re.compile(r"^[a-zA-Z0-9_]+$")
+
+
+def audit_stats_pair(before: dict, after: dict) -> list[str]:
+    """The ``metrics-contract`` rule's runtime half (the static half —
+    name validity at lint time — is rules_metrics.py): given two engine
+    ``stats()`` snapshots taken around any amount of work, return the
+    contract violations.
+
+    - a ``_total``-suffixed key is an OpenMetrics counter: it must be
+      present in both snapshots and monotonically non-decreasing —
+      scrapes rate() counters, and a "counter" that goes down silently
+      corrupts every rate computed over it;
+    - every numeric key (both snapshots) must render to a valid
+      Prometheus name once the exporter splices ``kft_engine_<key>``.
+
+    Empty list = contract holds.  Pin it in tests around real traffic:
+    ``assert audit_stats_pair(s0, eng.stats()) == []``.
+    """
+    errors: list[str] = []
+    for which, stats in (("before", before), ("after", after)):
+        for k, v in stats.items():
+            if not isinstance(v, (int, float)) or isinstance(v, bool):
+                continue
+            if not _METRIC_NAME.match(str(k)):
+                errors.append(
+                    f"{which}: key `{k}` is not a valid Prometheus "
+                    "name suffix (kft_engine_<key>)")
+    for k, v0 in before.items():
+        if not str(k).endswith("_total"):
+            continue
+        if isinstance(v0, bool) or not isinstance(v0, (int, float)):
+            errors.append(f"counter `{k}` is not numeric: {v0!r}")
+            continue
+        if k not in after:
+            errors.append(
+                f"counter `{k}` vanished from the later snapshot — "
+                "a disappearing series resets every rate() over it")
+            continue
+        v1 = after[k]
+        if isinstance(v1, bool) or not isinstance(v1, (int, float)):
+            errors.append(f"counter `{k}` became non-numeric: {v1!r}")
+        elif v1 < v0:
+            errors.append(
+                f"counter `{k}` went DOWN across the audit pair "
+                f"({v0} -> {v1}): `_total` claims monotonic counter "
+                "semantics")
+    return errors

@@ -262,6 +262,27 @@ def follow(channel):
 """,
         0),
     Fixture(
+        "metrics-contract", "metrics-contract/true-positive",
+        "kubeflow_tpu/serving/_st_metrics.py",
+        """
+class FooEngine:
+    def stats(self):
+        return {"tokens_emitted": 1, "kv-blocks.free": 2}
+""",
+        1, "kv-blocks.free"),
+    Fixture(
+        "metrics-contract", "metrics-contract/near-miss",
+        "kubeflow_tpu/serving/_st_metrics.py",
+        """
+class FooEngine:
+    def stats(self):
+        out = {"tokens_emitted": 1}
+        out["kv_blocks_free"] = 2
+        out.setdefault("queue_depth", 0)
+        return out
+""",
+        0),
+    Fixture(
         "fault-pairing", "fault-pairing/true-positive",
         "kubeflow_tpu/chaos/_st_faults.py",
         """

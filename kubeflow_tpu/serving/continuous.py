@@ -127,6 +127,10 @@ class Request:
     error: Optional[Exception] = None
 
     cancelled: threading.Event = field(default_factory=threading.Event)
+    #: request-lifecycle trace (serving/trace.py), None = unsampled.
+    #: Every instrumentation site guards on this None, so an untraced
+    #: request pays one attribute read per site and allocates nothing.
+    trace: Optional[Any] = None
 
     def cancel(self) -> None:
         """Client-side cancellation (disconnect, timeout): the request
@@ -1339,6 +1343,12 @@ class ContinuousEngine:
         #: audit of the block economy + the kv_blocks_leaked_total
         #: gauge; attach via attach_block_ledger (tests, chaos, benches)
         self.block_ledger = None
+        #: optional serving/trace.py Tracer shared with the runtime
+        #: fronting this engine: engine-level phase durations with no
+        #: request trace (a host-tier spill) observe into its sink, and
+        #: a wire import with a propagated trace context continues the
+        #: trace here (set by text.py / tests; never required)
+        self.tracer = None
         #: per-slot block tables (host ints; the dispatch-side arrays are
         #: assembled fresh per dispatch in _block_tables)
         self._slot_blocks: list[list[int]] = [[] for _ in range(num_slots)]
@@ -2234,7 +2244,7 @@ class ContinuousEngine:
         self, prompt: list[int], max_new_tokens: Optional[int] = None,
         temperature: Optional[float] = None,
         top_p: Optional[float] = None, top_k: Optional[int] = None,
-        priority: Optional[int] = None,
+        priority: Optional[int] = None, trace=None,
     ) -> Request:
         req = Request(
             prompt=list(map(int, prompt)),
@@ -2247,7 +2257,13 @@ class ContinuousEngine:
             top_p=(None if top_p is None else float(top_p)),
             top_k=(None if top_k is None else int(top_k)),
             priority=(1 if priority is None else int(priority)),
+            trace=trace,
         )
+        if trace is not None:
+            # the queue-wait phase opens HERE and closes when the
+            # scheduler reserves a slot (_admit) — the admission queue
+            # is the first engine-side stall cause the trace attributes
+            trace.phase("engine.queue", prompt_tokens=len(req.prompt))
         req.submitted_step = self.step_counter
         with self._gate:
             if self._error is not None:
@@ -2486,6 +2502,10 @@ class ContinuousEngine:
             # reserve immediately so admission_policy / later planning
             # in this same cycle sees the occupancy
             self._slots[slot] = req
+            if req.trace is not None:
+                # queue wait ends at slot reservation; prefill begins
+                req.trace.phase("engine.prefill", slot=slot,
+                                queue_depth=len(self._waiting))
             taken.append((req, slot))
         if deferred:
             self._waiting = deferred + self._waiting
@@ -2655,6 +2675,9 @@ class ContinuousEngine:
                 local_len: Optional[int] = None) -> None:
         self._slots[slot] = req
         self._active[slot] = True
+        if req.trace is not None:
+            # prefill (or import) ends at activation; decode begins
+            req.trace.phase("engine.decode", slot=slot)
         # positions are SLOT-LOCAL: = global for plain slots, suffix
         # length for segment-backed ones
         self._positions[slot] = (
@@ -2887,6 +2910,11 @@ class ContinuousEngine:
                         self._pool_cache, np.int32(cow_src),
                         np.int32(table[shared_n]))
                     self._alloc.cow_copies_total += 1
+                    if req.trace is not None:
+                        # the COW fork is a named cost on the trace
+                        req.trace.begin(
+                            "kv.cow", src=int(cow_src),
+                            dst=int(table[shared_n])).done()
                     dispatched = True
                 except Exception as e:  # noqa: BLE001 — fail THIS
                     # request only (the legacy fail-this-group contract);
@@ -3005,6 +3033,7 @@ class ContinuousEngine:
             except queue.Empty:
                 continue
             try:
+                t0 = time.perf_counter()
                 host_blocks = []
                 for leaves, valid in groups:
                     host = [np.asarray(x) for x in jax.device_get(leaves)]
@@ -3013,6 +3042,13 @@ class ContinuousEngine:
                 if self._host_pool.put(toks, host_blocks) >= 0:
                     with self._tier_mu:
                         self.kv_spills_total += 1
+                    if self.tracer is not None:
+                        # engine-level phase with no request trace: the
+                        # spill happens after retirement, but its cost
+                        # lands in the same phase histograms a scrape
+                        # reads (worker thread — never the scheduler)
+                        self.tracer.sink.observe_phase(
+                            "kv.host_spill", time.perf_counter() - t0)
             except Exception as e:  # noqa: BLE001 — a failed spill only
                 # costs the cache entry (the HBM registry still holds
                 # the prefix until reallocation); the tier must never
@@ -3045,25 +3081,38 @@ class ContinuousEngine:
         if store is None:
             raise RuntimeError("no spill store attached "
                                "(attach_spill_store)")
+        t0 = time.perf_counter()
+        if req.trace is not None:
+            req.trace.phase("kv.hibernate", session=session_id)
         snap = self.export_sequence(req, timeout)
         if snap is None:
             return False
         toks = [int(t) for t in snap["prompt"]] + \
             [int(t) for t in snap.get("generated", ())]
+        wsp = (req.trace.begin("kv.spill_write")
+               if req.trace is not None else None)
         try:
             store.write(session_id, snap,
                         block_keys=_block_keys(toks, self.block_size))
         except Exception:
             # nothing published (atomic rename never ran): the source
             # still owns the sequence — resume in place, exactly-once
+            if wsp is not None:
+                wsp.set(error=True).done()
             try:
                 self.resume_sequence(req, timeout)
             except (RuntimeError, TimeoutError):
                 pass
             raise
+        if wsp is not None:
+            wsp.done()
         self.release_sequence(req, timeout)
         with self._tier_mu:
             self.kv_spills_total += 1
+        if self.tracer is not None:
+            self.tracer.sink.observe_phase(
+                "kv.hibernate", time.perf_counter() - t0,
+                req.trace.trace_id if req.trace is not None else "")
         return True
 
     def thaw_sequence(self, session_id: str, store=None,
@@ -3088,6 +3137,9 @@ class ContinuousEngine:
         if store is None:
             raise RuntimeError("no spill store attached "
                                "(attach_spill_store)")
+        t0 = time.perf_counter()
+        if req is not None and req.trace is not None:
+            req.trace.phase("kv.thaw", session=session_id)
         snap, ok = store.read(session_id)
         prior = [int(t) for t in snap.get("generated", ())]
         if ok:
@@ -3137,6 +3189,11 @@ class ContinuousEngine:
         store.delete(session_id)
         with self._tier_mu:
             self.kv_thaws_total += 1
+        if self.tracer is not None:
+            self.tracer.sink.observe_phase(
+                "kv.thaw", time.perf_counter() - t0,
+                new_req.trace.trace_id
+                if new_req.trace is not None else "")
         return new_req, {"degraded": not ok, "tokens": prior,
                          "session": session_id}
 
@@ -3319,9 +3376,13 @@ class ContinuousEngine:
         if not self.paged:
             raise RuntimeError(
                 "KV migration requires the paged pool (block_size > 0)")
+        xsp = (req.trace.begin("kv.export")
+               if req.trace is not None else None)
         out = self._post_migration_op("export", req, None, timeout)
         snap = out.get("snap")
         if snap is None:
+            if xsp is not None:
+                xsp.set(empty=True).done()
             return None
         # device->host materialization on the CALLER's thread: the
         # scheduler only dispatched the (grouped) gathers.  Each group
@@ -3342,6 +3403,13 @@ class ContinuousEngine:
             nbytes += row.nbytes
             snap["logits"] = row
         self.kv_migrate_bytes_total += nbytes
+        if xsp is not None:
+            xsp.done(blocks=len(blocks), bytes=nbytes)
+            # the context rides the snapshot so a WIRE destination (a
+            # fresh-handle import on another process) can continue the
+            # same trace — in-process imports share the handle and need
+            # nothing
+            snap["trace"] = req.trace.wire_context()
         return snap
 
     def import_sequence(self, snapshot: dict, req: Optional[Request] = None,
@@ -3371,8 +3439,19 @@ class ContinuousEngine:
             raise ValueError(
                 "snapshot is None — the sequence had already finished "
                 "on the source (export_sequence returned None)")
-        out = self._post_migration_op("import", snapshot, (req, hold),
-                                      timeout)
+        isp = (req.trace.begin("kv.import",
+                               blocks=len(snapshot.get("blocks", ())),
+                               hold=hold)
+               if req is not None and req.trace is not None else None)
+        try:
+            out = self._post_migration_op("import", snapshot, (req, hold),
+                                          timeout)
+        except Exception as e:
+            if isp is not None:
+                isp.set(error=str(e)).done()
+            raise
+        if isp is not None:
+            isp.done()
         return out["req"]
 
     def take_waiting(self, timeout: float = 60.0) -> list:
@@ -3399,6 +3478,8 @@ class ContinuousEngine:
         """Enqueue an EXISTING Request handle (resize cutover: waiting
         requests follow the pool to the new-degree engine with their
         handles — and any tokens already streamed — intact)."""
+        if req.trace is not None:
+            req.trace.phase("engine.queue", adopted=True)
         with self._gate:
             if self._error is not None:
                 raise RuntimeError(
@@ -3770,6 +3851,16 @@ class ContinuousEngine:
                     top_p=snap.get("top_p"), top_k=snap.get("top_k"),
                     priority=int(snap.get("priority", 1)))
                 req.tokens = list(generated)
+                if self.tracer is not None and snap.get("trace"):
+                    # cross-process import: continue the propagated
+                    # trace on a fresh handle (the wire `trace` field);
+                    # in-process handoffs share the handle and with it
+                    # the live Trace object.  No door owns this
+                    # trace's finalization — register it for the
+                    # tracer's lazy reap (finish-on-done runs on a
+                    # read surface's thread, never here)
+                    req.trace = self.tracer.adopt(snap["trace"])
+                    self.tracer.watch(req.done, req.trace)
             self._slots[slot] = req
             self._slot_blocks[slot] = [int(b) for b in table]
             if self.block_ledger is not None:
@@ -3791,6 +3882,9 @@ class ContinuousEngine:
                 else:
                     self._prefilling.append(entry)
                     self._prefill_tokens_inflight += len(prompt) - position
+                    if req.trace is not None:
+                        req.trace.phase("engine.prefill", slot=slot,
+                                        imported=True)
             else:
                 # analysis: ok host-sync-in-dispatch — wire bytes are host numpy
                 row = np.asarray(snap["logits"])
@@ -3816,6 +3910,9 @@ class ContinuousEngine:
                                              "logits": row}
                 else:
                     self._active[slot] = not req.done.is_set()
+                    if req.trace is not None:
+                        req.trace.phase("engine.decode", slot=slot,
+                                        imported=True)
             self.kv_migrations_total += 1
             self.kv_migrate_bytes_total += nbytes
             out["req"] = req
@@ -3849,6 +3946,8 @@ class ContinuousEngine:
             # resume at the HEAD: this sequence was mid-admission
             self._prefilling.appendleft(e)
             self._prefill_tokens_inflight += len(e[2]) - e[3]
+            if req.trace is not None:
+                req.trace.phase("engine.prefill", resumed=True)
         else:
             if rec.get("logits") is not None:
                 # reinstall the freeze-time logits row: the live row was
@@ -3857,6 +3956,8 @@ class ContinuousEngine:
                 self._pool_logits = self._logits_set(
                     self._pool_logits, rec["logits"], np.int32(slot))
             self._active[slot] = True
+            if req.trace is not None:
+                req.trace.phase("engine.decode", resumed=True)
 
     def _mig_release(self, req: Request) -> None:
         slot = self._find_req_slot(req)
@@ -4004,6 +4105,11 @@ class ContinuousEngine:
                 # (correctness first, disaggregation second).
                 self._active[slot] = False
                 self._migrating[slot] = {"req": req, "entry": None}
+                if req.trace is not None:
+                    # disaggregation: prefill ends frozen at the chunk
+                    # boundary; the handoff phase runs until the decode
+                    # tier's import activates the sequence there
+                    req.trace.phase("engine.handoff", slot=slot)
                 try:
                     self.on_prefilled(req)
                 except Exception as e:  # noqa: BLE001 — degrade to mixed
@@ -4069,6 +4175,17 @@ class ContinuousEngine:
                 for slot in range(self.num_slots)
                 if self._active[slot] and self._slots[slot] is not None
             ]
+            # any sampled request in this dispatch?  One attribute read
+            # per live slot; stays False (and allocates NOTHING below)
+            # at sample=0 — the zero-overhead contract the trace layer
+            # pins (tests/test_observability.py)
+            traced = False
+            for _s, _r, _t in snapshot:
+                if _r.trace is not None:
+                    traced = True
+                    break
+            family = "decode"  # program family attr for dispatch spans
+            rung = 0
             # pass NUMPY COPIES that are never mutated again: the CPU
             # backend zero-copies numpy buffers across the jit boundary,
             # and the schedule advance below mutates self._positions /
@@ -4106,6 +4223,7 @@ class ContinuousEngine:
                     self._spec_ban[:] = -1
                 # analysis: ok host-sync-in-dispatch — host numpy scheduler state
                 seg_att = int(self._slot_plen[self._active].max())
+                family, rung = "seg_decode", needed
                 plens = np.where(
                     self._active, self._slot_plen, 0).astype(np.int32)
                 self._pool_cache, self._pool_logits, toks = (
@@ -4120,6 +4238,10 @@ class ContinuousEngine:
                 # chunk + the whole pool's decode scan
                 entry, ptoks, take, final, write_slot, p_needed = (
                     self._prefill_chunk_args())
+                psp = (entry[0].trace.begin(
+                    "prefill.chunk", take=take, offset=int(entry[3]),
+                    final=final, fused=True)
+                    if entry[0].trace is not None else None)
                 try:
                     if use_spec:
                         # chunked prefill fuses into the VERIFY dispatch
@@ -4128,6 +4250,7 @@ class ContinuousEngine:
                         a = max(needed, p_needed)
                         if self.paged:
                             a = self._rung(a)
+                            family, rung = "paged_fused_verify", a
                             (self._pool_cache, self._pool_logits, vtoks,
                              vacc) = self._paged_fused_verify_for(a)(
                                 self.params, self._pool_cache,
@@ -4141,6 +4264,7 @@ class ContinuousEngine:
                                 self._top_ps.copy(),
                                 self._top_ks.copy(), key)
                         else:
+                            family, rung = "fused_verify", a
                             (self._pool_cache, self._pool_logits, vtoks,
                              vacc) = self._fused_verify_for(a)(
                                 self.params, self._pool_cache,
@@ -4156,6 +4280,7 @@ class ContinuousEngine:
                         spec_out = (vtoks, vacc)
                     elif self.paged:
                         a = self._rung(max(needed, p_needed))
+                        family, rung = "paged_fused", a
                         self._pool_cache, self._pool_logits, toks = (
                             self._paged_fused_for(a)(
                                 self.params, self._pool_cache,
@@ -4168,6 +4293,7 @@ class ContinuousEngine:
                                 self._temps.copy(), self._top_ps.copy(),
                                 self._top_ks.copy(), key))
                     else:
+                        family, rung = "fused", max(needed, p_needed)
                         self._pool_cache, self._pool_logits, toks = (
                             self._fused_for(max(needed, p_needed))(
                                 self.params, self._pool_cache,
@@ -4187,12 +4313,17 @@ class ContinuousEngine:
                     # engine's _fatal already recorded the error — there
                     # the published op may have reached followers and the
                     # whole gang must restart, not paper over it.
+                    if psp is not None:
+                        psp.set(error=str(e)).done()
                     self._fail_prefill_head(entry, e)
                     continue  # no decode chunk landed this iteration
+                if psp is not None:
+                    psp.done()
                 self._advance_prefill(entry, take, final)
             elif use_spec:
                 if self.paged:
                     a = self._rung(needed)
+                    family, rung = "paged_verify", a
                     self._pool_cache, self._pool_logits, vtoks, vacc = (
                         self._paged_verify_for(a)(
                             self.params, self._pool_cache,
@@ -4202,6 +4333,7 @@ class ContinuousEngine:
                             self._temps.copy(), self._top_ps.copy(),
                             self._top_ks.copy(), key))
                 else:
+                    family, rung = "verify", needed
                     self._pool_cache, self._pool_logits, vtoks, vacc = (
                         self._verify_for(needed)(
                             self.params, self._pool_cache,
@@ -4214,6 +4346,7 @@ class ContinuousEngine:
             elif live:
                 if self.paged:
                     a = self._rung(needed)
+                    family, rung = "paged_decode", a
                     self._pool_cache, self._pool_logits, toks = (
                         self._paged_decode_for(a)(
                             self.params, self._pool_cache,
@@ -4222,6 +4355,7 @@ class ContinuousEngine:
                             self._temps.copy(), self._top_ps.copy(),
                             self._top_ks.copy(), key))
                 else:
+                    family, rung = "decode", needed
                     self._pool_cache, self._pool_logits, toks = (
                         self._decode_for(needed)(
                             self.params, self._pool_cache,
@@ -4247,6 +4381,10 @@ class ContinuousEngine:
                 while self._prefilling:
                     entry, ptoks, take, final, write_slot, p_needed = (
                         self._prefill_chunk_args())
+                    psp = (entry[0].trace.begin(
+                        "prefill.chunk", take=take, offset=int(entry[3]),
+                        final=final, fused=False)
+                        if entry[0].trace is not None else None)
                     try:
                         if self.paged:
                             a = self._rung(p_needed)
@@ -4274,8 +4412,12 @@ class ContinuousEngine:
                     except Exception as e:  # noqa: BLE001 — fail THIS
                         # request (purge reclaims the head entry next
                         # loop top)
+                        if psp is not None:
+                            psp.set(error=str(e)).done()
                         self._fail_prefill_head(entry, e)
                         break
+                    if psp is not None:
+                        psp.done()
                     self._advance_prefill(entry, take, final)
                     if not (self.paged and self.prefill_budget == 0):
                         break  # budgeted chunks: one per dispatch cycle
@@ -4290,6 +4432,17 @@ class ContinuousEngine:
                 while pending:
                     self._process(*pending.pop(0))
                 continue
+            dspans = None
+            if traced:
+                # per-request dispatch spans: enqueue -> fetch-landed,
+                # carrying the program family + warmed rung actually
+                # dispatched (closed by _process after the fetch)
+                dspans = []
+                for _slot, _req, _take in snapshot:
+                    if _req.trace is not None:
+                        dspans.append(_req.trace.begin(
+                            "dispatch", family=family, rung=int(rung),
+                            step=self.step_counter))
             if spec_out is not None:
                 self.spec_dispatches_total += 1
                 # counted HERE, not at plan time: a fused-verify dispatch
@@ -4301,7 +4454,8 @@ class ContinuousEngine:
                 # lengths decide it): no schedule advance here — the
                 # depth-1 drain below lands the fetch before the next
                 # dispatch and _process applies it
-                pending.append((spec_out, snapshot, "verify", drafts))
+                pending.append((spec_out, snapshot, "verify", drafts,
+                                dspans))
             else:
                 # advance the value-independent schedule NOW so the next
                 # chunk can dispatch before this one's tokens are fetched
@@ -4317,7 +4471,7 @@ class ContinuousEngine:
                         # chunk — the slot pool's standing stale-KV
                         # argument, now at block granularity)
                         self._retire_slot(slot)
-                pending.append((toks, snapshot))
+                pending.append((toks, snapshot, "chunk", None, dspans))
             if self.spec_k > 0:
                 # speculation makes the dispatch schedule value-
                 # dependent: the next iteration's positions, proposals
@@ -4387,7 +4541,7 @@ class ContinuousEngine:
         return use, drafts.astype(np.int32), proposed
 
     def _process(self, toks_dev, snapshot, kind: str = "chunk",
-                 drafts=None) -> None:
+                 drafts=None, spans=None) -> None:
         """Fetch one dispatch's device results (blocks) and deliver."""
         # THE declared fetch boundary: sampled tokens (plus, for verify
         # dispatches, per-slot accept lengths) leave the device here,
@@ -4395,6 +4549,13 @@ class ContinuousEngine:
         # analysis: ok host-sync-in-dispatch — the one intended fetch
         fetched = jax.device_get(toks_dev)
         now = time.perf_counter()
+        if spans:
+            # close the dispatch spans at the fetch: enqueue -> landed
+            # is the interval a stalled device queue shows up in.  A
+            # timestamp write, never finalization (the sink runs on the
+            # finishing caller's thread).
+            for sp in spans:
+                sp.done(now)
         if kind == "verify":
             self._deliver_verify(fetched, snapshot, drafts, now)
             return
@@ -4427,6 +4588,11 @@ class ContinuousEngine:
             req.tokens.extend(emitted)
             self.tokens_emitted += len(emitted)
             if done or len(req.tokens) >= req.max_new_tokens:
+                if req.trace is not None:
+                    # decode phase ends at delivery of the last token;
+                    # the root stays open until the serving surface
+                    # finishes the trace (response written)
+                    req.trace.end_phase(tokens=len(req.tokens))
                 req.done.set()
 
     def _deliver_verify(self, fetched, snapshot, drafts, now) -> None:
@@ -4482,6 +4648,8 @@ class ContinuousEngine:
             self.tokens_emitted += len(emitted)
             if done or len(req.tokens) >= req.max_new_tokens \
                     or self._remaining[slot] <= 0:
+                if req.trace is not None:
+                    req.trace.end_phase(tokens=len(req.tokens))
                 req.done.set()
                 done = True
             if done and self._slots[slot] is req:
@@ -4595,10 +4763,10 @@ class TieredEngine:
 
     def submit(self, prompt, max_new_tokens=None,
                temperature=None, top_p=None, top_k=None,
-               priority=None) -> Request:
+               priority=None, trace=None) -> Request:
         return self.engine.submit(
             prompt, max_new_tokens, temperature, top_p=top_p, top_k=top_k,
-            priority=priority)
+            priority=priority, trace=trace)
 
     def generate(self, prompt, max_new_tokens=None,
                  timeout: float = 120.0, temperature=None,
@@ -4712,6 +4880,10 @@ def _migrate_one(src: "ContinuousEngine", req: Request, transfer,
     senders must resolve before returning (_send_wire does).
     Returns True = moved, False = failed, None = nothing to do."""
     t0 = time.perf_counter()
+    if req.trace is not None:
+        # idempotent when the prefill-role freeze already opened it —
+        # the phase spans freeze -> destination activation either way
+        req.trace.phase("engine.handoff")
     try:
         snap = src.export_sequence(req)
     except (RuntimeError, TimeoutError) as e:
@@ -4727,12 +4899,16 @@ def _migrate_one(src: "ContinuousEngine", req: Request, transfer,
         return False
     if snap is None:
         return None  # finished before the transfer could start
+    tsp = (req.trace.begin("kv.transfer")
+           if req.trace is not None else None)
     try:
         ok = transfer(snap)
     except Exception as e:  # noqa: BLE001 — rejection/socket death is
         # a per-sequence failure, not a drain abort: resume in place
         log.debug("migration transfer failed: %s", e)
         ok = False
+    if tsp is not None:
+        tsp.done(ok=bool(ok))
     if ok is None:
         log.warning(
             "kv_migrate transfer returned indeterminate (commit sent, "
@@ -4903,14 +5079,15 @@ class DisaggregatedPool:
 
     def submit(self, prompt, max_new_tokens=None,
                temperature=None, top_p=None, top_k=None,
-               priority=None) -> Request:
+               priority=None, trace=None) -> Request:
         # admissions are role-gated: ONLY prefill engines take traffic
         # (least-loaded by queued + live), decode engines only import
         eng = min(self.prefill,
                   key=lambda e: e._queue.qsize() + len(e._prefilling)
                   + int(e._active.sum()))
         return eng.submit(prompt, max_new_tokens, temperature,
-                          top_p=top_p, top_k=top_k, priority=priority)
+                          top_p=top_p, top_k=top_k, priority=priority,
+                          trace=trace)
 
     def generate(self, prompt, max_new_tokens=None, timeout: float = 120.0,
                  temperature=None, top_p=None, top_k=None) -> list[int]:

@@ -263,6 +263,16 @@ class Router:
         self.last_request_time = 0.0
         #: optional traffic plane (QoS + affinity); None = classic WRR
         self.traffic = None
+        #: optional request-lifecycle tracer (ISSUE 13): the router is
+        #: the path's ROOT sampling decision — its trace context rides
+        #: X-KFT-Trace to the replica, so one sampled request is traced
+        #: end to end.  Installed via configure_tracing.
+        self.tracer = None
+        self._tracing_fp: Optional[str] = None
+        #: optional cluster block-registry poller (ISSUE 13 satellite):
+        #: scrapes replica /metrics prefix rows on a jittered interval
+        #: and exports kft_cluster_prefix_replicas gauges
+        self.prefix_poller = None
         #: per-backend counters: url -> {requests, errors, inflight}
         self._backend_stats: dict[str, dict[str, int]] = {}
         self.no_backend_total = 0
@@ -274,13 +284,48 @@ class Router:
 
             def _proxy(self) -> None:
                 if self.command == "GET" and self.path == "/metrics":
-                    body = router.metrics_text().encode()
+                    # exemplars only under negotiated OpenMetrics —
+                    # the classic parser fails on the trailer
+                    om = "application/openmetrics-text" in str(
+                        self.headers.get("Accept") or "")
+                    body = router.metrics_text(openmetrics=om).encode()
                     self.send_response(200)
-                    self.send_header("Content-Type",
-                                     "text/plain; version=0.0.4")
+                    self.send_header(
+                        "Content-Type",
+                        "application/openmetrics-text; version=1.0.0; "
+                        "charset=utf-8" if om
+                        else "text/plain; version=0.0.4")
                     self.send_header("Content-Length", str(len(body)))
                     self.end_headers()
                     self.wfile.write(body)
+                    return
+                if self.command == "GET" and (
+                        self.path == "/traces"
+                        or self.path.startswith("/traces?")):
+                    # router-side trace view — the SAME query contract
+                    # helper as the replica's /traces (observability
+                    # GETs never tick the idle clock, the /metrics
+                    # rule)
+                    from .trace import parse_slowest, traces_body
+
+                    ok, slowest = parse_slowest(self.path)
+                    if not ok:
+                        self._respond(400, json.dumps(
+                            {"error": "slowest must be an "
+                                      "int"}).encode())
+                        return
+                    body = ""
+                    if router.tracer is not None:
+                        router.tracer.reap()
+                        body = traces_body([router.tracer.sink],
+                                           slowest)
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "application/x-ndjson")
+                    self.send_header("Content-Length",
+                                     str(len(body.encode())))
+                    self.end_headers()
+                    self.wfile.write(body.encode())
                     return
                 # the idle clock ticks AFTER the /metrics early-return:
                 # a monitoring poller scraping faster than
@@ -305,6 +350,20 @@ class Router:
                 infer = (self.path.startswith("/openai/")
                          or self.path.endswith((":predict", ":explain",
                                                 "/infer")))
+                trace = None
+                if (router.tracer is not None and infer
+                        and self.command == "POST"):
+                    from .trace import TRACE_HEADER
+
+                    # the ROOT sampling decision for the whole path: a
+                    # replica honoring X-KFT-Trace inherits it, so one
+                    # decision covers router door -> affinity pick ->
+                    # replica door -> engine -> handoff -> decode
+                    trace = router.tracer.start(
+                        self.headers.get(TRACE_HEADER))
+                    if trace is not None:
+                        trace.meta["tenant"] = tenant
+                        trace.phase("router.door")
                 if plane is not None and self.command == "POST" and infer:
                     from .traffic import shed_http
 
@@ -320,32 +379,63 @@ class Router:
                             "reason": "bad_tenant_credential",
                             "tenant": tenant,
                         }).encode()
+                        if trace is not None:
+                            trace.meta["stall"] = "bad_tenant_credential"
+                            router.tracer.finish(trace)
+                            trace = None
                         self._respond(401, body401)
                         return
                     ticket = plane.acquire(tenant)
                     if not ticket.ok:
+                        if trace is not None:
+                            # shed reason = the stall cause the
+                            # autoscaler summary aggregates
+                            trace.meta["stall"] = \
+                                f"shed:{ticket.reason}"
+                            router.tracer.finish(trace)
+                            trace = None
                         shed_http(self, ticket)
                         return
+                    if trace is not None and ticket.cls is not None:
+                        trace.meta["class"] = ticket.cls.name
                 try:
                     self._route_and_forward(
-                        explain, body, keys, tenant, ticket, session)
+                        explain, body, keys, tenant, ticket, session,
+                        trace=trace)
                 finally:
                     if ticket is not None:
                         plane.release(ticket)
+                    if trace is not None:
+                        router.tracer.finish(trace)
 
             def _route_and_forward(self, explain, body, keys, tenant,
-                                   ticket, session=None) -> None:
+                                   ticket, session=None,
+                                   trace=None) -> None:
+                if trace is not None:
+                    # door wait ends here; the pick (affinity lookup,
+                    # possibly the scale-from-zero activation wait) is
+                    # its own phase
+                    trace.phase("router.route")
                 backend = router._pick(explain, keys, session=session)
                 if backend is None:
+                    if trace is not None:
+                        trace.meta["stall"] = "activation_wait"
                     router._activate()
                     deadline = time.time() + ACTIVATION_TIMEOUT
                     while backend is None and time.time() < deadline:
                         time.sleep(0.05)
                         backend = router._pick(explain, keys,
                                                session=session)
+                if trace is not None:
+                    trace.phase("router.forward",
+                                backend=backend or "")
                 tried: set[str] = set()
                 while backend is not None:
                     headers = {"Content-Type": "application/json"}
+                    if trace is not None:
+                        # propagate the context: the replica's door
+                        # continues THIS trace instead of sampling
+                        headers["X-KFT-Trace"] = trace.header()
                     if self.headers.get("Authorization"):
                         # a replica-side plane may hold its own
                         # qos_tenant_tokens: the credential must
@@ -512,10 +602,12 @@ class Router:
         with self._lock:
             return {b: dict(st) for b, st in self._backend_stats.items()}
 
-    def metrics_text(self) -> str:
+    def metrics_text(self, openmetrics: bool = False) -> str:
         """Router observability in Prometheus text format: per-backend
         request/error/inflight gauges + the no-backend counter + the
-        traffic plane's shed/affinity/preemption gauges."""
+        traffic plane's shed/affinity/preemption gauges.
+        ``openmetrics`` (negotiated by the handler) enables exemplar
+        trailers + the ``# EOF`` terminator."""
         from .traffic import prom_label
 
         lines = []
@@ -534,7 +626,60 @@ class Router:
             for fam in sorted(fams):
                 lines.append(f"# TYPE {fam} gauge")
                 lines.extend(fams[fam])
+        if self.tracer is not None:
+            from .traffic import prom_stat_lines
+
+            fams = prom_stat_lines(self.tracer.stats(), "kft_router_")
+            for fam in sorted(fams):
+                lines.append(f"# TYPE {fam} gauge")
+                lines.extend(fams[fam])
+            # router-side phase histograms (door / route / forward) —
+            # the scrape half of /traces (exemplar trace ids only on
+            # a negotiated OpenMetrics scrape)
+            lines.extend(self.tracer.sink.phase_metrics(
+                exemplars=openmetrics))
+        if self.prefix_poller is not None:
+            # cluster prefix heat (ISSUE 13 satellite): how many
+            # replicas hold each hot prefix chain — the placement
+            # signal the autoscaler loop (ROADMAP item 2) consumes
+            lines.extend(self.prefix_poller.metrics_lines())
+        if openmetrics:
+            lines.append("# EOF")
         return "\n".join(lines) + "\n"
+
+    def configure_tracing(self, spec) -> None:
+        """Install/refresh/clear the router's tracer from the ISvc
+        ``tracing`` config (fingerprinted — the 4 Hz reconcile must not
+        wipe the ring every pass)."""
+        import json as jsonlib
+
+        from .trace import Tracer, validate_tracing
+
+        if not spec:
+            self.tracer = None
+            self._tracing_fp = None
+            return
+        kw = validate_tracing(spec)
+        fp = jsonlib.dumps(kw, sort_keys=True)
+        if fp == self._tracing_fp:
+            return
+        self.tracer = Tracer(**kw)
+        self._tracing_fp = fp
+
+    def start_prefix_poller(self, interval_s: float) -> None:
+        """Start (idempotent) the cluster block-registry poller over
+        this router's live data-plane backends."""
+        if self.prefix_poller is not None:
+            self.prefix_poller.interval_s = float(interval_s)
+            return
+        from .traffic import ClusterPrefixPoller
+
+        def backends() -> list[str]:
+            with self._lock:
+                return [u for us, _w in self._pools for u in us]
+
+        self.prefix_poller = ClusterPrefixPoller(
+            backends, interval_s=float(interval_s))
 
     def set_backends(self, urls: list[str]) -> None:
         self.set_weighted_backends([(list(urls), 100)])
@@ -644,6 +789,9 @@ class Router:
         return backend
 
     def stop(self) -> None:
+        if self.prefix_poller is not None:
+            self.prefix_poller.stop()
+            self.prefix_poller = None
         self._httpd.shutdown()
         self._httpd.server_close()
         self._thread.join(timeout=2)
@@ -847,6 +995,27 @@ class InferenceServiceController(Controller):
                     "invalid engine knobs: hibernation requires the "
                     "paged pool (block_size > 0): the spill wire "
                     "format is the block-granular export snapshot")
+        # tracing knobs (ISSUE 13) freeze here too — the PR 4/7/8
+        # convention: a sample rate of 7 or a zero ring is ONE Failed
+        # status at conf-freeze, not a replica (and the router) failing
+        # at load; validate_tracing is the one shared validator
+        if cfg.get("tracing") is not None:
+            from .trace import validate_tracing
+
+            try:
+                validate_tracing(cfg["tracing"])
+            except ValueError as e:
+                raise ValueError(f"invalid engine knobs: {e}") from e
+        pps = cfg.get("prefix_poll_s")
+        if pps is not None:
+            try:
+                ok = float(pps) > 0
+            except (TypeError, ValueError):
+                ok = False
+            if not ok:
+                raise ValueError(
+                    f"invalid engine knobs: prefix_poll_s {pps!r} "
+                    "(must be a positive number)")
         # elastic resize knobs (ISSUE 10) freeze here too — the PR 4/7/8
         # convention: a mistyped min_degree is ONE Failed status, not N
         # crash-looping gang pods (or a supervisor exploding at runtime).
@@ -1377,6 +1546,20 @@ class InferenceServiceController(Controller):
         if dep.router is None or dep.stable is None:
             return
         cfg = dep.stable.cfg
+        # request tracing (ISSUE 13): the router is the path's root
+        # sampling decision; the same cfg knob builds the replica-side
+        # tracer inside TextGenerator.load.  Validated at conf-freeze;
+        # a racing bad edit here must not stall the reconcile loop.
+        try:
+            dep.router.configure_tracing(cfg.get("tracing"))
+        except ValueError as e:
+            log.debug("router tracing config rejected: %s", e)
+        if cfg.get("prefix_poll_s"):
+            # cluster block-registry poller (ISSUE 13 satellite)
+            try:
+                dep.router.start_prefix_poller(float(cfg["prefix_poll_s"]))
+            except (TypeError, ValueError) as e:
+                log.debug("prefix poller config rejected: %s", e)
         qos = dict(cfg.get("qos") or {})
         tenants = dict(cfg.get("qos_tenants") or {})
         from ..api.platform import KIND_PROFILE

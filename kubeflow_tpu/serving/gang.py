@@ -761,7 +761,11 @@ def migrate_sequence(snapshot: dict, host: str, port: int, *,
             c.settimeout(timeout)
         except OSError:
             pass
-        _kv_send(c, {"t": "kv_hello", "token": token, "mid": mid})
+        # the trace context rides the handshake header too (ISSUE 13):
+        # a destination can correlate even a transfer that dies before
+        # kv_begin with the source's request trace
+        _kv_send(c, {"t": "kv_hello", "token": token, "mid": mid,
+                     "trace": snapshot.get("trace")})
         ready, _ = _kv_recv(c, KV_HELLO_MAX)
         if ready.get("t") != "kv_ready":
             return False
@@ -911,6 +915,12 @@ class KvMigrationServer:
                     str(hello.get("token", "")), self._token):
                 raise ChannelClosed("bad kv_migrate handshake")
             mid = hello.get("mid")
+            if hello.get("trace"):
+                # correlation for operators tailing both sides of a
+                # migration: the hello's trace id matches the source
+                # request's /traces row
+                log.debug("kv_migrate transfer for trace %s",
+                          (hello["trace"] or {}).get("id"))
             _kv_send(c, {"t": "kv_ready"})
             meta: Optional[dict] = None
             specs: list[dict] = []

@@ -154,7 +154,7 @@ class ReshardServer:
     def __init__(self, leaves: list[tuple[str, np.ndarray]],
                  plan: list[dict], *, degree: int, token: str = "",
                  port: Optional[int] = None, host: str = "127.0.0.1",
-                 sock_wrap=None):
+                 sock_wrap=None, trace_ctx: Optional[dict] = None):
         from ..utils.net import allocate_port
 
         if host != "127.0.0.1" and not token:
@@ -162,6 +162,10 @@ class ReshardServer:
                 "a non-loopback ReshardServer requires a token")
         self._leaves = leaves
         self._plan = plan
+        #: resize-trace context (ISSUE 13): rides the rs_plan header so
+        #: a follower's logs/tooling can correlate its rebuild with the
+        #: leader's resize trace
+        self._trace_ctx = trace_ctx
         self._degree = int(degree)
         self._token = token
         self._sock_wrap = sock_wrap or (lambda s: s)
@@ -207,7 +211,8 @@ class ReshardServer:
             _kv_send(c, {"t": "rs_ready"})
             _kv_send(c, {"t": "rs_plan", "degree": self._degree,
                          "nleaves": len(self._leaves),
-                         "leaves": self._plan})
+                         "leaves": self._plan,
+                         "trace": self._trace_ctx})
             for i, (path, arr) in enumerate(self._leaves):
                 _kv_send(c, {"t": "rs_leaf", "i": i, "path": path},
                          np.ascontiguousarray(arr).tobytes())
@@ -372,12 +377,18 @@ class GangResizer:
                  reshard_token: str = "", failpoint: Optional[Callable] = None,
                  on_event: Optional[Callable] = None,
                  warmup_groups: Optional[list] = None, sock_wrap=None,
-                 ack_timeout_s: float = 120.0):
+                 ack_timeout_s: float = 120.0, tracer=None):
         if not getattr(engine, "paged", False):
             raise ValueError(
                 "elastic resize requires the paged pool (block_size > 0):"
                 " the transferable unit of sequence state is the block")
         self.engine = engine
+        #: trace sink (ISSUE 13): every resize records its own trace
+        #: (freeze/reshard/commit/cutover phases) so Tenplex-style cost
+        #: decomposition is a /traces read, not a bench run.  Falls
+        #: back to the engine's attached tracer (text.py wires one).
+        self.tracer = tracer if tracer is not None \
+            else getattr(engine, "tracer", None)
         self._set_engine = set_engine
         self._token = reshard_token
         self._failpoint = failpoint
@@ -489,6 +500,17 @@ class GangResizer:
         phase = "export"
         t0 = time.perf_counter()
         timings: dict[str, float] = {}
+        rtr = None
+        if self.tracer is not None:
+            # one trace PER RESIZE (freeze/reshard/commit/cutover
+            # phases): the Tenplex decomposition as a /traces row, with
+            # the context propagated on the resize replay op and the
+            # rs_plan wire header
+            from .trace import Trace
+
+            rtr = Trace(name="resize", old_degree=old_degree,
+                        new_degree=new_degree)
+            rtr.phase("resize.export")
         orig_policy = src.admission_policy
         exported: list[tuple[Any, dict]] = []
         published = False
@@ -510,6 +532,13 @@ class GangResizer:
                 snap = src.export_sequence(req)
                 if snap is not None:
                     exported.append((req, snap))
+                    if req.trace is not None:
+                        # the sequence's own trace shows the stall
+                        # CAUSE: frozen for a resize until the cutover
+                        # resume re-opens engine.decode
+                        req.trace.phase("resize.frozen",
+                                        resize=(rtr.trace_id
+                                                if rtr else ""))
                 self._fail("export")
             timings["drain_s"] = time.perf_counter() - t0
 
@@ -517,6 +546,8 @@ class GangResizer:
             # plan; tell followers; build the new-degree engine + pool
             phase = "reshard"
             t1 = time.perf_counter()
+            if rtr is not None:
+                rtr.phase("resize.reshard")
             src_mesh = getattr(src, "mesh", None)
             dst_mesh = (shardedlib.build_serving_mesh(mesh_axes)
                         if mesh_axes else None)
@@ -544,12 +575,18 @@ class GangResizer:
                 follower_ranks = channel.follower_ranks()
                 server = ReshardServer(
                     host_leaves, plan, degree=new_degree,
-                    token=self._token, sock_wrap=self._sock_wrap)
+                    token=self._token, sock_wrap=self._sock_wrap,
+                    trace_ctx=(rtr.wire_context() if rtr is not None
+                               else None))
                 channel.publish(("resize", {
                     "mesh_axes": mesh_axes,
                     "kwargs": self._wire_kwargs(kw, nb),
                     "reshard": {"host": "127.0.0.1", "port": server.port,
                                 "token": self._token},
+                    # trace context rides the replay op: follower logs
+                    # correlate their rebuild with the leader's trace
+                    "trace": (rtr.wire_context() if rtr is not None
+                              else None),
                 }))
                 published = True
                 acks = server.await_acks(follower_ranks,
@@ -590,6 +627,8 @@ class GangResizer:
             # may decode, and it is quiesced
             phase = "commit"
             t2 = time.perf_counter()
+            if rtr is not None:
+                rtr.phase("resize.commit")
             for req, snap in exported:
                 new.import_sequence(snap, req=req, hold=True)
                 self._fail("commit")
@@ -621,6 +660,9 @@ class GangResizer:
                     log.warning("resize rollback: resume failed for a "
                                 "sequence", exc_info=True)
             src.admission_policy = orig_policy
+            if rtr is not None:
+                rtr.meta["aborted"] = phase
+                self.tracer.finish(rtr)
             self._emit("ResizeAborted",
                        f"resize {old_degree}->{new_degree} died during "
                        f"{phase}; old degree resumed")
@@ -639,6 +681,8 @@ class GangResizer:
         # without it a follower that resized once would hold two full
         # device copies until the next resize.
         cut_err: Optional[Exception] = None
+        if rtr is not None:
+            rtr.phase("resize.cutover")
         if channel is not None:
             try:
                 channel.publish(("resize_commit",))
@@ -712,6 +756,9 @@ class GangResizer:
         src.stop()
         if cut_err is not None:
             self.resize_failures_total += 1
+            if rtr is not None:
+                rtr.meta["aborted"] = "cutover"
+                self.tracer.finish(rtr)
             self._emit("ResizeAborted",
                        f"cutover completed forward with an error: "
                        f"{cut_err!r}")
@@ -720,6 +767,9 @@ class GangResizer:
         timings["total_s"] = time.perf_counter() - t0
         self.last_timings = timings
         self.resizes_total += 1
+        if rtr is not None:
+            rtr.meta["sequences"] = len(exported)
+            self.tracer.finish(rtr)
         self._emit(
             "GangResized",
             f"TP {old_degree} -> {new_degree}: {len(exported)} live "

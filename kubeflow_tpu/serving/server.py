@@ -123,35 +123,72 @@ class MicroBatcher:
                     p.done.set()
 
 
+#: request-latency histogram bucket upper bounds (seconds), fixed by
+#: contract: dynamic buckets cannot be aggregated across replicas by a
+#: scrape, and p99 regressions are invisible to a count+sum exposition
+#: (the gap this histogram closes — ISSUE 13 satellite)
+REQUEST_LATENCY_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                           1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
+
+
 class ServerMetrics:
     def __init__(self) -> None:
         self.lock = threading.Lock()
         self.request_count: dict[str, int] = {}
         self.error_count: dict[str, int] = {}
         self.latency_sum: dict[str, float] = {}
+        #: model -> per-bucket counts (len(buckets) + 1, last = +Inf)
+        self.latency_buckets: dict[str, list[int]] = {}
         self.inflight = 0
 
     def observe(self, model: str, seconds: float, error: bool) -> None:
         with self.lock:
             self.request_count[model] = self.request_count.get(model, 0) + 1
             self.latency_sum[model] = self.latency_sum.get(model, 0.0) + seconds
+            counts = self.latency_buckets.get(model)
+            if counts is None:
+                counts = self.latency_buckets[model] = \
+                    [0] * (len(REQUEST_LATENCY_BUCKETS) + 1)
+            for i, b in enumerate(REQUEST_LATENCY_BUCKETS):
+                if seconds <= b:
+                    counts[i] += 1
+                    break
+            else:
+                counts[-1] += 1
             if error:
                 self.error_count[model] = self.error_count.get(model, 0) + 1
 
     def prometheus(self) -> str:
+        from .traffic import prom_histogram_lines, prom_label
+
         lines = [
             "# TYPE kft_request_count counter",
-            "# TYPE kft_request_latency_seconds_sum counter",
+            "# TYPE kft_error_count counter",
             "# TYPE kft_requests_inflight gauge",
         ]
         with self.lock:
             for m, c in self.request_count.items():
-                lines.append(f'kft_request_count{{model="{m}"}} {c}')
-            for m, s in self.latency_sum.items():
-                lines.append(f'kft_request_latency_seconds_sum{{model="{m}"}} {s:.6f}')
+                lines.append(
+                    f'kft_request_count{{model="{prom_label(m)}"}} {c}')
             for m, c in self.error_count.items():
-                lines.append(f'kft_error_count{{model="{m}"}} {c}')
+                lines.append(
+                    f'kft_error_count{{model="{prom_label(m)}"}} {c}')
             lines.append(f"kft_requests_inflight {self.inflight}")
+            # request latency as a REAL fixed-bucket histogram
+            # (_bucket/_sum/_count): the previous count+sum exposition
+            # could only answer "mean", so a p99 regression was
+            # invisible to every scrape.  One shared renderer with the
+            # trace layer's phase histograms (traffic.py).
+            if self.latency_buckets:
+                lines.append("# TYPE kft_request_latency_seconds "
+                             "histogram")
+                for m in sorted(self.latency_buckets):
+                    lines.extend(prom_histogram_lines(
+                        "kft_request_latency_seconds",
+                        f'model="{prom_label(m)}"',
+                        REQUEST_LATENCY_BUCKETS,
+                        self.latency_buckets[m],
+                        self.latency_sum.get(m, 0.0)))
         return "\n".join(lines) + "\n"
 
 
@@ -413,7 +450,34 @@ class ModelServer:
             ready = all(m.ready for m in self._models.values())
             h._send(200 if ready else 503, {"ready": ready})
             return
+        if path == "/traces" or path.startswith("/traces?"):
+            # recent completed request traces as JSONL (ISSUE 13):
+            # ?slowest=N returns the N slowest retained traces — N
+            # TOTAL across models, merged through the shared helper
+            # (the router handler uses the same one, so the query
+            # contract cannot drift between the two surfaces)
+            from .trace import parse_slowest, traces_body
+
+            ok, slowest = parse_slowest(path)
+            if not ok:
+                h._send(400, {"error": "slowest must be an int"})
+                return
+            sinks = []
+            for _name, model in sorted(self._models.items()):
+                tracer = getattr(model, "tracer", None)
+                if tracer is not None:
+                    tracer.reap()  # finalize adopted (wire) traces
+                    sinks.append(tracer.sink)
+            h._send(200, None, raw=traces_body(sinks, slowest).encode(),
+                    content_type="application/x-ndjson")
+            return
         if path == "/metrics":
+            # exemplar trace ids are OpenMetrics syntax: attach them
+            # ONLY when the scraper negotiated the format (Accept
+            # header) — the classic text/plain parser reads the
+            # trailer as a malformed timestamp and fails the page
+            openmetrics = "application/openmetrics-text" in str(
+                h.headers.get("Accept") or "")
             text = self.metrics.prometheus()
             # engine-backed models export their scheduler gauges too
             # (slots, queue depth, prefix-cache economy); one TYPE line
@@ -464,11 +528,47 @@ class ModelServer:
                             plane.stats(), "kft_traffic_",
                             f'model="{prom_label(name)}"').items():
                         families.setdefault(fam, []).extend(lines)
+                # trace-layer gauges ride the same export (sampling
+                # accounting); the phase histograms append below as a
+                # pre-rendered block — they carry their own TYPE line
+                tracer = getattr(model, "tracer", None)
+                if tracer is not None:
+                    from .traffic import prom_label, prom_stat_lines
+
+                    for fam, lines in prom_stat_lines(
+                            tracer.stats(), "kft_trace_",
+                            f'model="{prom_label(name)}"').items():
+                        families.setdefault(fam, []).extend(lines)
             for fam in sorted(families):
                 text += f"# TYPE {fam} gauge\n" + \
                     "\n".join(families[fam]) + "\n"
+            # phase-attributed latency histograms
+            # (kft_phase_seconds{phase=...} with exemplar trace ids):
+            # the scrape-side view of the trace layer — p99s per phase,
+            # not just totals (ISSUE 13).  ONE TYPE header across all
+            # models: duplicate TYPE lines are an exposition error the
+            # promtool-style lint test pins.
+            phase_lines: list[str] = []
+            for name, model in sorted(self._models.items()):
+                tracer = getattr(model, "tracer", None)
+                if tracer is not None:
+                    from .traffic import prom_label
+
+                    lines = tracer.sink.phase_metrics(
+                        base_labels=f'model="{prom_label(name)}"',
+                        exemplars=openmetrics)
+                    if lines:
+                        phase_lines.extend(
+                            lines if not phase_lines else lines[1:])
+            if phase_lines:
+                text += "\n".join(phase_lines) + "\n"
+            if openmetrics:
+                text += "# EOF\n"
             h._send(200, None, raw=text.encode(),
-                    content_type="text/plain; version=0.0.4")
+                    content_type=(
+                        "application/openmetrics-text; version=1.0.0; "
+                        "charset=utf-8" if openmetrics
+                        else "text/plain; version=0.0.4"))
             return
         if path.startswith("/v1/models/"):
             name = path[len("/v1/models/"):]
@@ -525,6 +625,22 @@ class ModelServer:
             if m is None or not hasattr(m, call_attr):
                 h._send(404, {"error": f"no completions model {name!r}"})
                 return
+            # request-lifecycle trace (ISSUE 13): continue the router's
+            # context (X-KFT-Trace) or sample fresh at this door.  The
+            # replica.door phase opens HERE so QoS queue wait at this
+            # door is attributed; the engine advances the phase track
+            # from submit on, and the trace finalizes in the finally
+            # below — on THIS HTTP thread, never the scheduler's.
+            tracer = getattr(m, "tracer", None)
+            trace = None
+            if tracer is not None:
+                from .trace import TRACE_HEADER
+
+                trace = tracer.start(h.headers.get(TRACE_HEADER))
+                if trace is not None:
+                    trace.meta["model"] = name
+                    trace.phase("replica.door", stream=bool(
+                        payload.get("stream")))
             if payload.get("priority") is not None:
                 # validate the client field up front: an unknown tier
                 # is a 400 (client mistake), not a mid-generation 500
@@ -534,6 +650,8 @@ class ModelServer:
                 try:
                     priority_tier(payload["priority"])
                 except ValueError as e:
+                    if tracer is not None:
+                        tracer.finish(trace)
                     h._send(400, {"error": str(e)})
                     return
             # per-tenant QoS front door (serving/traffic.py, ISSUE 9):
@@ -556,8 +674,13 @@ class ModelServer:
                 # (X-KFT-Admitted skipping the rate charge remains a
                 # loopback-trust convenience, consistent with the rest
                 # of ModelServer's unauthenticated local surface.)
+                if trace is not None:
+                    trace.meta["tenant"] = tenant
                 if not plane.authenticate(
                         tenant, h.headers.get("Authorization")):
+                    if trace is not None:
+                        trace.meta["stall"] = "bad_tenant_credential"
+                        tracer.finish(trace)
                     h._send(401, {
                         "error": "tenant credential required",
                         "reason": "bad_tenant_credential",
@@ -568,8 +691,15 @@ class ModelServer:
                     tenant,
                     charge_rate=h.headers.get("X-KFT-Admitted") != "1")
                 if not ticket.ok:
+                    if trace is not None:
+                        # the shed REASON is the stall cause the
+                        # autoscaler summary aggregates (ISSUE 13)
+                        trace.meta["stall"] = f"shed:{ticket.reason}"
+                        tracer.finish(trace)
                     shed_http(h, ticket)
                     return
+                if trace is not None and ticket.cls is not None:
+                    trace.meta["class"] = ticket.cls.name
             # the class tier is the CONTRACT: this plane's ticket (or,
             # when this replica has no class for the tenant, the
             # router's X-KFT-Priority cluster classification) bounds
@@ -583,6 +713,13 @@ class ModelServer:
                                header=h.headers.get("X-KFT-Priority"),
                                classed=(plane is not None
                                         and bool(plane.classes())))
+            if trace is not None and hasattr(m, "accept_trace"):
+                # thread-local handoff to the runtime (same HTTP
+                # thread) — NEVER via the payload dict: the async
+                # inference logger serializes that dict off-thread,
+                # and an internal key would leak into (or race) the
+                # CloudEvents log
+                m.accept_trace(trace)
             t0 = time.perf_counter()
             req_id = f"{name}-{time.time_ns()}"
             if self.logger is not None:
@@ -639,6 +776,10 @@ class ModelServer:
                     self.metrics.inflight -= 1
                 if plane is not None and ticket is not None:
                     plane.release(ticket)
+                if tracer is not None:
+                    # finalization (histograms + ring) on this HTTP
+                    # worker thread — the response is on the wire
+                    tracer.finish(trace)
             return
         # V2 repository API: dynamic load/unload + index
         if path == "/v2/repository/index":

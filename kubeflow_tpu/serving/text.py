@@ -279,6 +279,20 @@ class TextGenerator(Model):
         #: from config["hibernation"] and attached to every paged
         #: engine (hibernate/thaw + the /metrics session gauges)
         self.spill_store = None
+        #: request-lifecycle tracer (ISSUE 13) — built by load() from
+        #: config["tracing"] ({"sample": f, "ring": n}); ModelServer
+        #: discovers it here (door spans, /traces, phase histograms)
+        #: and every engine behind this runtime shares its sink
+        self.tracer = None
+        #: the door's trace rides a THREAD-LOCAL from ModelServer's
+        #: accept_trace to the openai_* call on the same HTTP thread —
+        #: never the payload dict: the async inference logger
+        #: serializes that same dict off-thread, and an internal Trace
+        #: object (or a pop racing json.dumps) must not leak into the
+        #: CloudEvents log
+        import threading as _threading
+
+        self._door_trace = _threading.local()
 
     def _build_traffic(self) -> None:
         qos = self.config.get("qos")
@@ -310,6 +324,33 @@ class TextGenerator(Model):
                          and getattr(e, "role", "mixed") == "mixed"])
         for eng in engines:
             self.traffic.attach_engine(eng)
+
+    def accept_trace(self, trace) -> None:
+        """ModelServer door -> runtime handoff for the request trace
+        (same HTTP thread; the openai_* call takes it back)."""
+        self._door_trace.trace = trace
+
+    def _take_trace(self):
+        tr = getattr(self._door_trace, "trace", None)
+        self._door_trace.trace = None
+        return tr
+
+    def _build_tracing(self) -> None:
+        """Build the sampling tracer from config["tracing"] and share
+        it with every engine behind this runtime (engine-level phase
+        observations — spills, wire-import trace adoption — land in
+        the same sink the server scrapes)."""
+        spec = self.config.get("tracing")
+        if not spec:
+            return
+        from .trace import Tracer, validate_tracing
+
+        self.tracer = Tracer(**validate_tracing(spec))
+        engines = ([self.engine]
+                   if not getattr(self.engine, "pools", None)
+                   else list(self.engine.pools))
+        for eng in engines:
+            eng.tracer = self.tracer
 
     def _build_hibernation(self) -> None:
         """Attach the manifest-verified spill store (ISSUE 12) to every
@@ -382,6 +423,7 @@ class TextGenerator(Model):
                 self.engine.eos_id = getattr(self.tokenizer, "eos_id", None)
             self._build_traffic()
             self._build_hibernation()
+            self._build_tracing()
             self.ready = True
             return
         cfg, params = resolve_model_source(self.config, name=self.name)
@@ -395,6 +437,7 @@ class TextGenerator(Model):
             default_max_new_tokens=32)
         self._build_traffic()
         self._build_hibernation()
+        self._build_tracing()
         self.ready = True
 
     def swap_engine(self, engine) -> None:
@@ -405,6 +448,11 @@ class TextGenerator(Model):
         forever) and possibly PARKED snapshots, which must follow the
         pool so an evicted victim re-imports into the LIVE engine."""
         old, self.engine = self.engine, engine
+        if self.tracer is not None and getattr(engine, "tracer",
+                                               None) is None:
+            # the tracer follows the pool like the preemptors below —
+            # phase observations must not silently stop at a resize
+            engine.tracer = self.tracer
         if self.traffic is None:
             return
         carried: list = []
@@ -486,12 +534,18 @@ class TextGenerator(Model):
         temp = payload.get("temperature")
         tp, tk = payload.get("top_p"), payload.get("top_k")
         pr = self._priority(payload.get("priority"))
+        # the door's request trace rides the FIRST engine request of
+        # the fan-out (one trace = one lifecycle; sibling choices share
+        # the HTTP-level phases, not the engine spans)
+        trace = self._take_trace()
         n = max(1, int(payload.get("n", 1)))  # same fan-out as blocking
         reqs = [
             self.engine.submit(self.tokenizer.encode(str(p)), max_tokens,
                                temperature=temp, top_p=tp, top_k=tk,
-                               priority=pr)
-            for p in prompts for _ in range(n)
+                               priority=pr,
+                               trace=(trace if i == 0 else None))
+            for i, p in enumerate(
+                [p for p in prompts for _ in range(n)])
         ]
         sent = [""] * len(reqs)
         finished = [False] * len(reqs)
@@ -578,14 +632,18 @@ class TextGenerator(Model):
         temp = payload.get("temperature")
         tp, tk = payload.get("top_p"), payload.get("top_k")
         pr = self._priority(payload.get("priority"))
+        trace = self._take_trace()
         # OpenAI ``n``: independent samples per prompt — each is its own
-        # engine request, coalescing in the slot pool like any burst
+        # engine request, coalescing in the slot pool like any burst;
+        # the door's trace rides the first (one trace = one lifecycle)
         n = max(1, int(payload.get("n", 1)))
         reqs = [
-            self.engine.submit(self.tokenizer.encode(p), max_tokens,
+            self.engine.submit(self.tokenizer.encode(str(p)), max_tokens,
                                temperature=temp, top_p=tp, top_k=tk,
-                               priority=pr)
-            for p in prompts for _ in range(n)
+                               priority=pr,
+                               trace=(trace if i == 0 else None))
+            for i, p in enumerate(
+                [p for p in prompts for _ in range(n)])
         ]
         try:
             return self._collect_completions(payload, reqs)

@@ -379,6 +379,134 @@ class KvBlockRegistry:
         }
 
 
+class ClusterPrefixPoller:
+    """Router-side block-registry poller (ISSUE 13 satellite, the r16
+    residual): scrape every live replica's ``/metrics``
+    ``kft_kv_prefix_key`` rows on a JITTERED interval (synchronized
+    scrapes across routers would thundering-herd the replicas), feed
+    the :class:`KvBlockRegistry`, and keep a per-key replica census so
+    the router exports cluster prefix-heat gauges
+    (``kft_cluster_prefix_replicas{key=...}``) — placement decisions
+    become observable before the autoscaler exists (ROADMAP item 2
+    consumes exactly this).
+
+    ``backends``: callable returning the live replica URL list (the
+    router's pools are the membership truth).  Blocking HTTP runs on
+    this poller's own daemon thread — never a scheduler or reconcile
+    worker."""
+
+    def __init__(self, backends: Callable[[], list[str]],
+                 registry: Optional[KvBlockRegistry] = None,
+                 interval_s: float = 5.0, jitter: float = 0.25,
+                 capacity: int = 4096):
+        self.interval_s = float(interval_s)
+        if self.interval_s <= 0:
+            raise ValueError("interval_s must be > 0")
+        self.jitter = max(0.0, min(float(jitter), 0.9))
+        self._backends = backends
+        self.registry = registry or KvBlockRegistry()
+        self.capacity = int(capacity)
+        #: key hex -> {backend: depth} — the census behind the gauges
+        self._heat: "collections.OrderedDict[str, dict[str, int]]" = \
+            collections.OrderedDict()
+        self._lock = threading.Lock()
+        self.polls_total = 0
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, name="prefix-poller", daemon=True)
+        self._thread.start()
+
+    def _loop(self) -> None:
+        import random
+
+        while not self._stop.is_set():
+            # jittered sleep FIRST: construction must not scrape before
+            # the router's pools are even wired
+            delay = self.interval_s * (
+                1.0 + random.uniform(-self.jitter, self.jitter))
+            if self._stop.wait(delay):
+                return
+            try:
+                self.poll_once()
+            except Exception as e:  # noqa: BLE001 — a scrape cycle
+                # failing (replica churn mid-walk) costs one interval,
+                # never the poller thread
+                log.debug("prefix poll failed: %s", e)
+
+    def poll_once(self) -> int:
+        """One scrape sweep over the current backends; returns total
+        registry rows seen.  Public for tests and operator tooling."""
+        import re
+        import urllib.request
+
+        self.polls_total += 1
+        urls = list(self._backends() or [])
+        seen: dict[str, dict[str, int]] = {}
+        reached: set[str] = set()
+        rows_total = 0
+        for url in urls:
+            try:
+                with urllib.request.urlopen(
+                        url.rstrip("/") + "/metrics", timeout=2.0) as r:
+                    text = r.read().decode()
+            except (OSError, ValueError):
+                continue  # timed out / down: keep its prior entries
+            reached.add(url)
+            rows_total += self.registry.observe_metrics(url, text)
+            for key_hex, depth in re.findall(
+                    r'^kft_kv_prefix_key\{[^}]*key="([0-9a-f]+)"'
+                    r'[^}]*\}\s+(\d+)', text, re.MULTILINE):
+                seen.setdefault(key_hex, {})[url] = int(depth)
+        with self._lock:
+            # merge rule per (key, backend): a REACHED backend's truth
+            # is this sweep's rows (entries it stopped advertising
+            # drop); a live-but-unreached backend (scrape timeout)
+            # keeps its prior entries (one flaky scrape must not flap
+            # the heat down); a backend no longer in the pool drops
+            # everywhere (its KV died with it — phantom heat forever
+            # was the alternative)
+            live = set(urls)
+            merged: "collections.OrderedDict[str, dict[str, int]]" = \
+                collections.OrderedDict()
+            for key_hex, per_old in self._heat.items():
+                kept = {b: d for b, d in per_old.items()
+                        if b in live and b not in reached}
+                if kept:
+                    merged[key_hex] = kept
+            for key_hex, per in seen.items():
+                cur = merged.pop(key_hex, {})
+                cur.update(per)
+                merged[key_hex] = cur  # freshly seen keys are MRU
+            self._heat = merged
+            while len(self._heat) > self.capacity:
+                self._heat.popitem(last=False)
+        return rows_total
+
+    def heat(self) -> dict[str, int]:
+        """key hex -> number of replicas advertising it."""
+        with self._lock:
+            return {k: len(v) for k, v in self._heat.items()}
+
+    def metrics_lines(self) -> list[str]:
+        """The cluster prefix-heat gauge lines for the router's
+        /metrics (TYPE header included; empty when nothing scraped)."""
+        heat = self.heat()
+        if not heat:
+            return []
+        lines = ["# TYPE kft_cluster_prefix_replicas gauge"]
+        for key_hex in sorted(heat):
+            lines.append(
+                f'kft_cluster_prefix_replicas{{key="{key_hex}"}} '
+                f"{heat[key_hex]}")
+        lines.append("# TYPE kft_cluster_prefix_keys gauge")
+        lines.append(f"kft_cluster_prefix_keys {len(heat)}")
+        return lines
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5)
+
+
 def prom_label(value) -> str:
     """Escape a Prometheus label VALUE (backslash, quote, newline per
     the text exposition format) — class names and model names are
@@ -386,6 +514,34 @@ def prom_label(value) -> str:
     entire /metrics scrape."""
     return (str(value).replace("\\", "\\\\").replace('"', '\\"')
             .replace("\n", "\\n"))
+
+
+def prom_histogram_lines(name: str, labels: str, buckets, counts,
+                         total: float, exemplar=None) -> list[str]:
+    """Render ONE labeled series of a fixed-bucket Prometheus histogram
+    (`_bucket` cumulative + `+Inf`, `_count`, `_sum`).  ``counts`` is
+    per-bucket (len(buckets) + 1, last = overflow); ``exemplar`` is an
+    optional ``(value_seconds, trace_id)`` attached to the +Inf bucket
+    in OpenMetrics syntax.  The ONE histogram renderer — ServerMetrics'
+    request-latency histograms and the trace sink's phase histograms
+    must stay byte-compatible (the prom_stat_lines rule, one shape
+    up)."""
+    lines = []
+    lbl = f"{labels}," if labels else ""
+    cum = 0
+    for b, c in zip(buckets, counts):
+        cum += c
+        lines.append(f'{name}_bucket{{{lbl}le="{b:g}"}} {cum}')
+    cum += counts[len(buckets)]
+    inf = f'{name}_bucket{{{lbl}le="+Inf"}} {cum}'
+    if exemplar is not None:
+        inf += (f' # {{trace_id="{prom_label(exemplar[1])}"}}'
+                f" {exemplar[0]:.6f}")
+    lines.append(inf)
+    tail = f"{{{labels}}}" if labels else ""
+    lines.append(f"{name}_count{tail} {cum}")
+    lines.append(f"{name}_sum{tail} {total:.6f}")
+    return lines
 
 
 def prom_stat_lines(stats: dict, prefix: str,
@@ -962,6 +1118,13 @@ class EnginePreemptor:
             return False  # finished first — the slot is already free
         self.engine.release_sequence(victim)
         tier = getattr(victim, "priority", 1)
+        tr = getattr(victim, "trace", None)
+        if tr is not None:
+            # parked time is its own phase (a stall CAUSE the trace
+            # attributes): ends when the re-import activates the slot
+            tr.begin("preempt.park", tier=tier).done()
+            tr.phase("engine.preempted", tier=tier)
+            tr.meta["stall"] = "preempted"
         with self._lock:
             self._parked.append((tier, time.perf_counter(), victim, snap))
         self.preemptions_total += 1
@@ -996,6 +1159,9 @@ class EnginePreemptor:
             with self._lock:
                 if entry in self._parked:
                     self._parked.remove(entry)
+            tr = getattr(req, "trace", None)
+            if tr is not None:
+                tr.begin("preempt.unpark", tier=tier).done()
             self.resumes_total += 1
             return True
         return False

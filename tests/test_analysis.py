@@ -960,6 +960,71 @@ class Plan:
         assert report.findings == []
 
 
+class TestMetricsContractRule:
+    """metrics-contract (ISSUE 13 satellite): serving stats() keys
+    must render to valid Prometheus names (the exporter splices them
+    into kft_engine_<key>); the monotonic-counter half is runtime
+    (audit_stats_pair, pinned in test_observability.py)."""
+
+    def test_bad_key_in_dict_literal_flagged(self, tmp_path):
+        code = """
+class FooEngine:
+    def stats(self):
+        return {"tokens_emitted": 1, "kv-blocks.free": 2}
+"""
+        found = lint_snippet(tmp_path, code, ["metrics-contract"])
+        assert len(found) == 1
+        assert "kv-blocks.free" in found[0].message
+
+    def test_bad_key_via_subscript_and_setdefault(self, tmp_path):
+        code = """
+class FooEngine:
+    def stats(self):
+        out = {}
+        out["queue depth"] = 1
+        out.setdefault("spec.rate", 0)
+        return out
+"""
+        found = lint_snippet(tmp_path, code, ["metrics-contract"])
+        assert {"queue depth" in f.message or "spec.rate" in f.message
+                for f in found} == {True}
+        assert len(found) == 2
+
+    def test_clean_stats_and_scope(self, tmp_path):
+        code = """
+class FooEngine:
+    def stats(self):
+        out = {"tokens_emitted": 1, "kv_blocks_free": 2}
+        out["queue_depth"] = 0
+        return out
+
+    def not_stats(self):
+        return {"kv-blocks.free": 2}
+"""
+        assert lint_snippet(tmp_path, code, ["metrics-contract"]) == []
+        # outside serving/ is not this rule's business
+        bad = 'class E:\n    def stats(self):\n        return {"a-b": 1}\n'
+        assert lint_snippet(tmp_path, bad, ["metrics-contract"],
+                            rel="kubeflow_tpu/hpo/_fixture.py") == []
+
+    def test_pragma_silences_with_reason(self, tmp_path):
+        code = """
+class FooEngine:
+    def stats(self):
+        # analysis: ok metrics-contract — legacy dashboard key
+        return {"kv-blocks.free": 2}
+"""
+        assert lint_snippet(tmp_path, code, ["metrics-contract"]) == []
+
+    def test_real_serving_stats_are_clean(self):
+        paths = [os.path.join(REPO_ROOT, "kubeflow_tpu", "serving", f)
+                 for f in ("continuous.py", "traffic.py", "trace.py",
+                           "paged.py", "gang.py")]
+        report = astlint.run_lint(REPO_ROOT, paths=paths,
+                                  rules=["metrics-contract"])
+        assert report.findings == []
+
+
 class TestLockGraphCoverage:
     """ISSUE 11 satellite: resize.py/traffic.py's PR 8/9 locks and
     Conditions are IN the nesting graph, and it stays acyclic."""

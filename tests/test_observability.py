@@ -1,0 +1,761 @@
+"""Request-lifecycle tracing (ISSUE 13): phase-attributed latency
+across the whole serving path.
+
+Layers, matching the tentpole:
+
+- TRACE UNITS: Span/Trace/TraceSink/Tracer — the contiguous phase
+  track (phase durations tile the root span), bounded ring + span cap,
+  sampling, X-KFT-Trace header round-trip, the autoscaler summary;
+- ENGINE: a traced request's phases tile its end-to-end latency within
+  5%, dispatch spans carry program family + warmed rung, and
+  ``sample=0`` creates NO spans on the dispatch path (the
+  zero-overhead contract);
+- THE PINNED E2E TRACE: router -> prefill tier -> ``kv_migrate``
+  handoff -> decode tier, one trace id across the router and replica
+  sinks, every phase span parent-linked, phase durations summing to
+  within 5% of the observed end-to-end latency;
+- EXPOSITION: ``kft_phase_seconds`` histograms (with exemplar trace
+  ids) and the ServerMetrics request-latency histogram on /metrics,
+  promtool-style linted (unique series, valid names, escaped label
+  values, no per-tenant metric-NAME suffixes — the PR 8 round-9
+  regression class) on BOTH the server and the router;
+- SATELLITES: the cluster prefix poller's heat gauges, the
+  ``metrics-contract`` runtime audit across a stats pair, and the
+  ``tracing``/``prefix_poll_s`` knobs as ONE Failed status at ISvc
+  conf-freeze.
+"""
+
+import json
+import re
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from kubeflow_tpu.analysis.runtime import audit_stats_pair
+from kubeflow_tpu.models import llama as llamalib
+from kubeflow_tpu.serving.continuous import ContinuousEngine
+from kubeflow_tpu.serving.trace import (
+    MAX_SPANS_PER_TRACE,
+    Trace,
+    Tracer,
+    TraceSink,
+    parse_header,
+    parse_wire_context,
+    validate_tracing,
+)
+
+
+@pytest.fixture(scope="module")
+def tiny_llama():
+    cfg = llamalib.tiny()
+    model = llamalib.Llama(cfg)
+    params = model.init(jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32))
+    return cfg, params["params"]
+
+
+@pytest.fixture(scope="module")
+def text_ref(tiny_llama):
+    from kubeflow_tpu.serving.storage import register_mem
+
+    return register_mem("observability-tests", tiny_llama)
+
+
+def post(url: str, payload: dict, headers=None, timeout: float = 120.0):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, dict(r.headers), json.loads(r.read() or b"{}")
+    except urllib.error.HTTPError as e:
+        try:
+            body = json.loads(e.read() or b"{}")
+        except ValueError:
+            body = {}
+        return e.code, dict(e.headers), body
+
+
+def get_text(url: str, timeout: float = 30.0, headers=None) -> str:
+    req = urllib.request.Request(url, headers=headers or {})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.read().decode()
+
+
+# -- trace units ----------------------------------------------------------
+
+
+class TestTraceUnits:
+    def test_phase_track_tiles_the_root(self):
+        tr = Trace(name="request")
+        tr.phase("a")
+        time.sleep(0.02)
+        tr.phase("b")
+        time.sleep(0.01)
+        with tr.span("detail", k=1) as sp:
+            time.sleep(0.005)
+        tr.phase("c")
+        time.sleep(0.01)
+        tr.finish()
+        d = tr.to_dict()
+        names = [p["name"] for p in d["phases"]]
+        assert names == ["a", "b", "c"]
+        # contiguity: each phase starts exactly where the previous
+        # ended, so the sum tiles the span from first phase to finish
+        total = sum(p["duration_s"] for p in d["phases"])
+        assert abs(total - d["duration_s"]) < 0.005
+        # detail spans parent to the phase active at open
+        assert sp.parent_id == tr.phases[1].span_id
+        assert d["spans"][0]["attrs"]["k"] == 1
+
+    def test_phase_reentry_is_idempotent(self):
+        tr = Trace()
+        p1 = tr.phase("decode")
+        p2 = tr.phase("decode")
+        assert p1 is p2
+        assert len(tr.phases) == 1
+
+    def test_finish_idempotent_and_durations_freeze(self):
+        tr = Trace()
+        tr.phase("x")
+        tr.finish()
+        d1 = tr.duration_s
+        time.sleep(0.01)
+        tr.finish()
+        assert tr.duration_s == d1
+
+    def test_span_cap_counts_drops(self):
+        tr = Trace()
+        for _ in range(MAX_SPANS_PER_TRACE + 10):
+            tr.begin("s").done()
+        assert len(tr.spans) == MAX_SPANS_PER_TRACE
+        assert tr.dropped_spans == 11  # root occupies spans[0]
+
+    def test_header_roundtrip_and_malformed(self):
+        tr = Trace()
+        tid, parent = parse_header(tr.header())
+        assert tid == tr.trace_id and parent == tr.root.span_id
+        assert parse_header(None) is None
+        assert parse_header("") is None
+        assert parse_header("onlyid") is None
+        assert parse_header("a:b:0") is None  # unsampled flag
+        assert parse_wire_context(tr.wire_context()) == (tid, parent)
+        assert parse_wire_context({"id": ""}) is None
+        assert parse_wire_context("nope") is None
+
+    def test_sampling_and_continuation(self):
+        t0 = Tracer(sample=0.0)
+        assert t0.start() is None  # never sampled fresh
+        upstream = Trace()
+        cont = t0.start(header=upstream.header())
+        assert cont is not None and cont.trace_id == upstream.trace_id
+        assert cont.root.parent_id == upstream.root.span_id
+        t1 = Tracer(sample=1.0)
+        assert t1.start() is not None
+
+    def test_ring_bound_and_slowest(self):
+        sink = TraceSink(ring=4)
+        for i in range(8):
+            tr = Trace()
+            tr.phase("p")
+            time.sleep(0.001 * (i + 1))
+            sink.finish(tr)
+        assert len(sink.traces()) == 4
+        assert sink.finished_total == 8
+        slow = sink.slowest(2)
+        assert len(slow) == 2
+        assert slow[0]["duration_s"] >= slow[1]["duration_s"]
+        # jsonl is one object per line
+        rows = [json.loads(ln) for ln in sink.jsonl().splitlines()]
+        assert len(rows) == 4 and all("trace_id" in r for r in rows)
+
+    def test_summary_aggregates_queue_wait_and_stalls(self):
+        sink = TraceSink(ring=16)
+        for _ in range(3):
+            tr = Trace()
+            tr.meta["class"] = "gold"
+            tr.phase("router.door")
+            time.sleep(0.005)
+            tr.phase("engine.decode")
+            sink.finish(tr)
+        shed = Trace()
+        shed.meta["class"] = "gold"
+        shed.meta["stall"] = "shed:rate_limited"
+        sink.finish(shed)
+        s = sink.summary(window_s=60.0)
+        gold = s["classes"]["gold"]
+        assert gold["traces"] == 4
+        assert gold["queue_wait_sum_s"] >= 0.015
+        assert gold["stalls"] == {"shed:rate_limited": 1}
+        assert gold["phases"]["router.door"]["count"] == 3
+        # an expired window is empty
+        assert sink.summary(window_s=0.0)["classes"] == {}
+
+    def test_validate_tracing(self):
+        assert validate_tracing({"sample": 0.5, "ring": 8}) == {
+            "sample": 0.5, "ring": 8}
+        assert validate_tracing({})["sample"] == 0.1
+        for bad in ({"sample": 7}, {"sample": -0.1}, {"ring": 0},
+                    {"ring": "lots"}, {"bogus": 1}, "nope",
+                    {"sample": None}):
+            with pytest.raises(ValueError):
+                validate_tracing(bad)
+
+    def test_phase_metrics_render_through_shared_histograms(self):
+        sink = TraceSink()
+        tr = Trace()
+        tr.phase("engine.decode")
+        time.sleep(0.002)
+        sink.finish(tr)
+        sink.observe_phase("kv.host_spill", 0.5)
+        lines = sink.phase_metrics(base_labels='model="m"',
+                                   exemplars=True)
+        text = "\n".join(lines)
+        assert lines[0] == "# TYPE kft_phase_seconds histogram"
+        assert ('kft_phase_seconds_bucket{model="m",'
+                'phase="engine.decode",le="+Inf"}') in text
+        # the exemplar carries the trace id on the +Inf bucket —
+        # OpenMetrics syntax, so it renders ONLY when asked for
+        assert f'trace_id="{tr.trace_id}"' in text
+        assert 'kft_phase_seconds_count{model="m",phase="kv.host_spill"} 1' \
+            in text
+        assert "trace_id" not in "\n".join(
+            sink.phase_metrics(base_labels='model="m"'))
+
+    def test_adopted_traces_reap_on_read(self):
+        import threading
+
+        tracer = Tracer(sample=1.0, ring=8)
+        upstream = Trace()
+        tr = tracer.adopt(upstream.wire_context())
+        assert tr is not None and tr.trace_id == upstream.trace_id
+        done = threading.Event()
+        tracer.watch(done, tr)
+        assert tracer.reap() == 0  # not finished yet
+        assert tracer.sink.stats()["traces_finished_total"] == 0
+        done.set()
+        assert tracer.reap() == 1  # finalized on the reader's thread
+        assert tracer.sink.stats()["traces_finished_total"] == 1
+        assert tracer.reap() == 0  # idempotent
+
+
+# -- engine ---------------------------------------------------------------
+
+
+LONG = list(range(1, 65))
+
+
+def make_engine(tiny_llama, **kw):
+    cfg, params = tiny_llama
+    kw.setdefault("num_slots", 4)
+    kw.setdefault("decode_chunk", 2)
+    kw.setdefault("block_size", 16)
+    kw.setdefault("prefill_budget", 16)
+    return ContinuousEngine(cfg, params, **kw)
+
+
+@pytest.fixture(scope="class")
+def shared_engine(tiny_llama):
+    """ONE engine for the whole engine-tracing class: the tests vary
+    the tracer (swappable), not the pool — rebuilding per test would
+    pay the compile set three times for nothing."""
+    eng = make_engine(tiny_llama)
+    yield eng
+    eng.stop()
+
+
+class TestEngineTracing:
+    def test_phases_tile_e2e_with_family_and_rung(self, shared_engine):
+        eng = shared_engine
+        tracer = Tracer(sample=1.0, ring=8)
+        eng.tracer = tracer
+        tr = tracer.start(name="request")
+        req = eng.submit(LONG, max_new_tokens=16, trace=tr)
+        req.wait(120)
+        tracer.finish(tr)
+        d = tr.to_dict()
+        names = [p["name"] for p in d["phases"]]
+        assert names[0] == "engine.queue"
+        assert "engine.prefill" in names
+        assert "engine.decode" in names
+        total = sum(p["duration_s"] for p in d["phases"])
+        assert abs(total - d["duration_s"]) <= 0.05 * d["duration_s"]
+        spans = d["spans"]
+        fams = {s["attrs"]["family"] for s in spans
+                if s["name"] == "dispatch"}
+        assert fams & {"paged_decode", "paged_fused"}
+        rungs = {s["attrs"]["rung"] for s in spans
+                 if s["name"] == "dispatch"}
+        assert all(isinstance(r, int) and r > 0 for r in rungs)
+        assert any(s["name"] == "prefill.chunk" for s in spans)
+        # parent links: every span/phase anchors to a known id
+        ids = {d["root"]["span_id"]}
+        ids |= {p["span_id"] for p in d["phases"]}
+        ids |= {s["span_id"] for s in spans}
+        for s in d["phases"] + spans:
+            assert s["parent_id"] in ids, s
+
+    def test_sample_zero_creates_no_spans(self, shared_engine):
+        eng = shared_engine
+        tracer = Tracer(sample=0.0, ring=8)
+        eng.tracer = tracer
+        assert tracer.start() is None
+        req = eng.submit(LONG, max_new_tokens=8)  # untraced
+        req.wait(120)
+        assert req.trace is None
+        assert tracer.sink.stats()["traces_finished_total"] == 0
+        assert tracer.sink.phase_metrics() == []
+
+    def test_stats_pair_honors_metrics_contract(self, shared_engine):
+        """The metrics-contract runtime half (ISSUE 13 satellite):
+        every `_total` stats counter is monotone across real traffic
+        and every numeric key renders to a valid Prometheus name."""
+        eng = shared_engine
+        s0 = eng.stats()
+        eng.generate(LONG, max_new_tokens=8)
+        assert audit_stats_pair(s0, eng.stats()) == []
+
+    def test_audit_stats_pair_catches_violations(self):
+        assert audit_stats_pair({"a_total": 5}, {"a_total": 3}) != []
+        assert audit_stats_pair({"a_total": 5}, {}) != []
+        assert audit_stats_pair({"bad-name": 1}, {"bad-name": 1}) != []
+        assert audit_stats_pair(
+            {"a_total": 1, "g": 2.5}, {"a_total": 1, "g": 0.5}) == []
+
+
+class TestTracingLeavesMechanismsClean:
+    """The acceptance bar: jit_recompiles_total == 0 and BlockLedger
+    audits clean with tracing enabled across migration, resize and
+    hibernate — tracing changes what is OBSERVED, never what is
+    dispatched."""
+
+    @staticmethod
+    def _submit_traced_until(eng, tracer, n_tokens, max_new=120):
+        tr = tracer.start(name="request")
+        req = eng.submit(LONG, max_new_tokens=max_new, trace=tr)
+        deadline = time.time() + 120
+        while len(req.tokens) < n_tokens:
+            assert time.time() < deadline, "no progress"
+            time.sleep(0.01)
+        return req, tr
+
+    @pytest.mark.slow
+    def test_traced_migration_and_hibernate_zero_recompiles(
+            self, tiny_llama, tmp_path):
+        from kubeflow_tpu.analysis.runtime import BlockLedger
+        from kubeflow_tpu.serving.storage import KvSpillStore
+
+        ledger = BlockLedger()
+        tracer = Tracer(sample=1.0, ring=16)
+        src = make_engine(tiny_llama)
+        dst = make_engine(tiny_llama)
+        store = KvSpillStore(str(tmp_path / "spill"))
+        for e in (src, dst):
+            e.attach_block_ledger(ledger)
+            e.tracer = tracer
+            e.attach_spill_store(store)
+        try:
+            # live migration of a TRACED request mid-decode
+            req, tr = self._submit_traced_until(src, tracer, 8)
+            snap = src.export_sequence(req)
+            assert snap is not None and snap.get("trace")
+            dst.import_sequence(snap, req=req)
+            src.release_sequence(req)
+            req.wait(120)
+            tracer.finish(tr)
+            names = [p.name for p in tr.phases]
+            assert "engine.decode" in names
+            spans = {s.name for s in tr.spans}
+            assert {"kv.export", "kv.import"} <= spans
+            # hibernate/thaw a traced request
+            req2, tr2 = self._submit_traced_until(dst, tracer, 8)
+            assert dst.hibernate_sequence(req2, "sess-1")
+            assert not req2.done.is_set()
+            thawed, info = dst.thaw_sequence("sess-1", req=req2)
+            thawed.wait(120)
+            tracer.finish(tr2)
+            assert not info["degraded"]
+            names2 = [p.name for p in tr2.phases]
+            assert "kv.hibernate" in names2 and "kv.thaw" in names2
+            for e in (src, dst):
+                assert e.audit_blocks() == []
+                assert e.stats()["jit_recompiles_total"] == 0
+                assert e.stats()["kv_blocks_leaked_total"] == 0
+            assert ledger.conservation_errors == []
+        finally:
+            src.stop()
+            dst.stop()
+
+    @pytest.mark.slow
+    def test_traced_resize_records_phase_decomposition(self, tiny_llama):
+        from kubeflow_tpu.analysis.runtime import BlockLedger
+        from kubeflow_tpu.serving.resize import GangResizer
+
+        ledger = BlockLedger()
+        tracer = Tracer(sample=1.0, ring=16)
+        eng = make_engine(tiny_llama)
+        eng.attach_block_ledger(ledger)
+        eng.tracer = tracer  # GangResizer picks it up from the engine
+        rz = GangResizer(eng, warmup_groups=[])
+        try:
+            req, tr = self._submit_traced_until(eng, tracer, 8)
+            new = rz.resize(None)
+            req.wait(120)
+            tracer.finish(tr)
+            # the request's own trace shows the stall cause
+            names = [p.name for p in tr.phases]
+            assert "resize.frozen" in names
+            assert names[-1] == "engine.decode"
+            # the per-resize trace decomposes the Tenplex phases
+            resize_traces = [d for d in tracer.sink.traces()
+                             if d["root"]["name"] == "resize"]
+            assert len(resize_traces) == 1
+            rnames = [p["name"] for p in resize_traces[0]["phases"]]
+            assert rnames == ["resize.export", "resize.reshard",
+                              "resize.commit", "resize.cutover"]
+            assert new.audit_blocks() == []
+            assert new.stats()["jit_recompiles_total"] == 0
+            assert ledger.conservation_errors == []
+        finally:
+            rz.engine.stop()
+
+
+# -- the pinned e2e trace -------------------------------------------------
+
+
+def _parse_traces(text: str) -> dict[str, list[dict]]:
+    out: dict[str, list[dict]] = {}
+    for ln in text.splitlines():
+        d = json.loads(ln)
+        out.setdefault(d["trace_id"], []).append(d)
+    return out
+
+
+class TestEndToEndTrace:
+    def test_router_prefill_migrate_decode_trace(self, text_ref):
+        """THE acceptance trace: one sampled request crosses router ->
+        prefill tier -> kv_migrate wire handoff -> decode tier; every
+        phase span parent-links, and the replica-side phase durations
+        sum to within 5% of the observed end-to-end latency."""
+        from kubeflow_tpu.serving.controller import Router
+        from kubeflow_tpu.serving.server import ModelServer
+        from kubeflow_tpu.serving.text import TextGenerator
+
+        srv = ModelServer()
+        srv.register(TextGenerator("m", {
+            "params_ref": text_ref, "tokenizer": "bytes",
+            "num_slots": 2, "decode_chunk": 2, "block_size": 16,
+            "prefill_budget": 16, "max_new_tokens": 24,
+            "prefix_cache": False, "warmup_groups": [],
+            "disaggregation": {"prefill": 1, "decode": 1, "wire": True},
+            "tracing": {"sample": 1.0, "ring": 32},
+        }))
+        srv.start()
+        router = Router(activate=lambda: None)
+        router.set_backends([srv.url])
+        router.configure_tracing({"sample": 1.0, "ring": 32})
+        try:
+            code, _, body = post(
+                router.url + "/openai/v1/completions",
+                {"model": "m", "prompt": "trace me through the tiers",
+                 "max_tokens": 24})
+            assert code == 200
+            assert body["choices"][0]["text"]
+            # finalization runs on the handler threads after the
+            # response bytes hit the wire: poll briefly for both sinks
+            deadline = time.time() + 5
+            rt = st = {}
+            while time.time() < deadline and not (
+                    set(rt) & set(st)):
+                rt = _parse_traces(get_text(router.url + "/traces"))
+                st = _parse_traces(get_text(srv.url + "/traces"))
+                time.sleep(0.02)
+            shared = set(rt) & set(st)
+            assert len(shared) == 1, (set(rt), set(st))
+            tid = shared.pop()
+            router_tr = rt[tid][0]
+            replica_tr = st[tid][0]
+            r_names = [p["name"] for p in router_tr["phases"]]
+            assert r_names == ["router.door", "router.route",
+                               "router.forward"]
+            names = [p["name"] for p in replica_tr["phases"]]
+            assert names[0] == "replica.door"
+            assert "engine.queue" in names
+            assert "engine.prefill" in names
+            assert "engine.handoff" in names
+            # decode happens on the DECODE tier after the wire handoff
+            assert names[-1] == "engine.decode"
+            assert names.index("engine.handoff") > \
+                names.index("engine.prefill")
+            span_names = {s["name"] for s in replica_tr["spans"]}
+            assert {"kv.export", "kv.transfer",
+                    "prefill.chunk", "dispatch"} <= span_names
+            # the replica continued the ROUTER's trace decision
+            assert replica_tr["root"]["parent_id"] == \
+                router_tr["root"]["span_id"]
+            # parent links hold across the whole tree
+            ids = {replica_tr["root"]["span_id"]}
+            ids |= {p["span_id"] for p in replica_tr["phases"]}
+            ids |= {s["span_id"] for s in replica_tr["spans"]}
+            for s in replica_tr["phases"] + replica_tr["spans"]:
+                assert s["parent_id"] in ids, s
+            # THE 5% BAR: phase durations tile the end-to-end latency
+            total = sum(p["duration_s"] for p in replica_tr["phases"])
+            e2e = replica_tr["duration_s"]
+            assert abs(total - e2e) <= 0.05 * e2e, (total, e2e)
+            # handoff actually crossed the kv_migrate wire
+            eng = srv.models()["m"].engine
+            assert eng.stats()["kv_migrations_total"] >= 1
+            assert eng.stats()["jit_recompiles_total"] == 0
+        finally:
+            router.stop()
+            srv.stop()
+
+
+# -- exposition lint (promtool-style) -------------------------------------
+
+
+_SAMPLE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)"          # metric name
+    r"(?:\{([^}]*)\})?"                     # optional labels
+    r" (-?[0-9.eE+-]+|NaN)"                 # value
+    r"(?: # \{.*\} -?[0-9.eE+-]+)?$")       # optional exemplar
+_LABEL = re.compile(
+    r'^[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\\n]|\\\\|\\"|\\n)*"$')
+
+
+def prom_lint(text: str) -> list[str]:
+    """Promtool-style exposition lint: parseable samples, valid names,
+    escaped label values, one TYPE per family, unique (name, labels)
+    series."""
+    errors: list[str] = []
+    types: dict[str, str] = {}
+    series: set[tuple] = set()
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        if line.startswith("#"):
+            if line.startswith("# TYPE "):
+                parts = line.split()
+                if len(parts) != 4:
+                    errors.append(f"bad TYPE line: {line}")
+                    continue
+                if parts[2] in types:
+                    errors.append(f"duplicate TYPE for {parts[2]}")
+                types[parts[2]] = parts[3]
+            continue
+        m = _SAMPLE.match(line)
+        if m is None:
+            errors.append(f"unparseable sample: {line!r}")
+            continue
+        name, labels = m.group(1), m.group(2)
+        if labels:
+            # split on commas OUTSIDE quoted values
+            for pair in re.split(r',(?=[a-zA-Z_][a-zA-Z0-9_]*=")',
+                                 labels):
+                if not _LABEL.match(pair):
+                    errors.append(f"bad label pair {pair!r} in: {line}")
+        key = (name, labels or "")
+        if key in series:
+            errors.append(f"duplicate series: {line}")
+        series.add(key)
+    return errors
+
+
+class TestExposition:
+    def test_latency_histogram_and_traces_endpoint(self, text_ref):
+        """ONE server drives both read surfaces: the request-latency
+        histogram satellite on /metrics (with the phase histograms
+        riding the same scrape) and the /traces JSONL + ?slowest=N
+        view."""
+        from kubeflow_tpu.serving.server import ModelServer
+        from kubeflow_tpu.serving.text import TextGenerator
+
+        srv = ModelServer()
+        srv.register(TextGenerator("m", {
+            "params_ref": text_ref, "tokenizer": "bytes",
+            "num_slots": 2, "decode_chunk": 2, "block_size": 16,
+            "max_new_tokens": 8, "warmup_groups": [],
+            "tracing": {"sample": 1.0, "ring": 8},
+        }))
+        srv.start()
+        try:
+            code, _, _ = post(srv.url + "/openai/v1/completions",
+                              {"model": "m", "prompt": "hi",
+                               "max_tokens": 2})
+            assert code == 200
+            text = get_text(srv.url + "/metrics")
+            assert "# TYPE kft_request_latency_seconds histogram" in text
+            assert ('kft_request_latency_seconds_bucket{model="m",'
+                    'le="+Inf"} 1') in text
+            assert 'kft_request_latency_seconds_count{model="m"} 1' \
+                in text
+            # the phase histograms ride the same scrape with the
+            # sampled request's phases
+            assert 'kft_phase_seconds_bucket{model="m",' \
+                   'phase="engine.decode"' in text
+            assert 'kft_trace_traces_finished_total{model="m"} 1' in text
+            assert prom_lint(text) == [], prom_lint(text)[:5]
+            # exemplars are OpenMetrics syntax: absent on the classic
+            # scrape (a trailer would fail real Prometheus parsers),
+            # present + # EOF-terminated when negotiated
+            assert "trace_id" not in text
+            om = get_text(srv.url + "/metrics", headers={
+                "Accept": "application/openmetrics-text"})
+            assert "trace_id=" in om and om.endswith("# EOF\n")
+            # /traces: a second, slower request; poll briefly —
+            # finalization runs on the handler thread after the
+            # response bytes hit the wire
+            post(srv.url + "/openai/v1/completions",
+                 {"model": "m", "prompt": "hello", "max_tokens": 8})
+            deadline = time.time() + 5
+            rows = []
+            while time.time() < deadline and len(rows) < 2:
+                rows = [json.loads(ln) for ln in get_text(
+                    srv.url + "/traces").splitlines()]
+                time.sleep(0.02)
+            assert len(rows) == 2
+            slow = [json.loads(ln) for ln in get_text(
+                srv.url + "/traces?slowest=1").splitlines()]
+            assert len(slow) == 1
+            assert slow[0]["duration_s"] == max(
+                r["duration_s"] for r in rows)
+        finally:
+            srv.stop()
+
+    def test_scrapes_lint_clean_with_tenant_classes(self, text_ref):
+        """The PR 8 round-9 regression class, now promtool-pinned on
+        BOTH endpoints: hyphenated tenant/class names must appear only
+        as label VALUES, never in metric names."""
+        from kubeflow_tpu.serving.controller import Router
+        from kubeflow_tpu.serving.server import ModelServer
+        from kubeflow_tpu.serving.text import TextGenerator
+        from kubeflow_tpu.serving.traffic import TrafficPlane
+
+        srv = ModelServer()
+        srv.register(TextGenerator("m", {
+            "params_ref": text_ref, "tokenizer": "bytes",
+            "num_slots": 2, "decode_chunk": 2, "block_size": 16,
+            "max_new_tokens": 4, "warmup_groups": [],
+            "qos": {"team-a": {"rate": 100}},
+            "tracing": {"sample": 1.0, "ring": 8},
+        }))
+        srv.start()
+        router = Router(activate=lambda: None)
+        router.set_backends([srv.url])
+        router.set_traffic(TrafficPlane({"team-a": {"rate": 100}}))
+        router.configure_tracing({"sample": 1.0, "ring": 8})
+        try:
+            code, _, _ = post(router.url + "/openai/v1/completions",
+                              {"model": "m", "prompt": "x",
+                               "max_tokens": 4, "user": "team-a"})
+            assert code == 200
+            for url in (srv.url, router.url):
+                text = get_text(url + "/metrics")
+                assert prom_lint(text) == [], (url,
+                                               prom_lint(text)[:5])
+                for line in text.splitlines():
+                    if line.startswith("#") or not line.strip():
+                        continue
+                    name = line.split("{")[0].split(" ")[0]
+                    assert "team-a" not in name, line
+                assert 'class="team-a"' in text
+        finally:
+            router.stop()
+            srv.stop()
+
+
+# -- cluster prefix poller (satellite) ------------------------------------
+
+
+class TestClusterPrefixPoller:
+    def test_poller_exports_cluster_heat(self, text_ref):
+        from kubeflow_tpu.serving.controller import Router
+        from kubeflow_tpu.serving.server import ModelServer
+        from kubeflow_tpu.serving.text import TextGenerator
+
+        srv = ModelServer()
+        srv.register(TextGenerator("m", {
+            "params_ref": text_ref, "tokenizer": "bytes",
+            "num_slots": 2, "decode_chunk": 2, "block_size": 4,
+            "max_new_tokens": 4, "warmup_groups": [],
+        }))
+        srv.start()
+        router = Router(activate=lambda: None)
+        router.set_backends([srv.url])
+        try:
+            # generate so the replica advertises prefix-digest rows
+            code, _, _ = post(srv.url + "/openai/v1/completions",
+                              {"model": "m",
+                               "prompt": "a shared prefix long enough "
+                                         "to fill blocks",
+                               "max_tokens": 4})
+            assert code == 200
+            router.start_prefix_poller(interval_s=999.0)
+            rows = router.prefix_poller.poll_once()
+            assert rows > 0
+            heat = router.prefix_poller.heat()
+            assert heat and all(v == 1 for v in heat.values())
+            text = get_text(router.url + "/metrics")
+            assert "# TYPE kft_cluster_prefix_replicas gauge" in text
+            assert "kft_cluster_prefix_replicas{key=" in text
+            assert f"kft_cluster_prefix_keys {len(heat)}" in text
+            assert prom_lint(text) == [], prom_lint(text)[:5]
+            # the registry learned the same keys (locate answers)
+            assert router.prefix_poller.registry.stats()[
+                "kv_registry_entries"] == len(heat)
+        finally:
+            router.stop()
+            srv.stop()
+
+
+# -- conf-freeze (satellite) ----------------------------------------------
+
+
+class TestConfFreeze:
+    def test_bad_tracing_knobs_are_one_failed_status(self):
+        from kubeflow_tpu.api.common import ObjectMeta
+        from kubeflow_tpu.api.inference import (
+            ComponentSpec,
+            InferenceService,
+            InferenceServicePhase,
+            InferenceServiceSpec,
+            ModelFormat,
+        )
+        from kubeflow_tpu.controlplane.cluster import Cluster
+
+        cases = {
+            "bad-trace-sample": {"tracing": {"sample": 7}},
+            "bad-trace-ring": {"tracing": {"ring": 0}},
+            "bad-trace-shape": {"tracing": {"bogus": 1}},
+            "bad-poll": {"prefix_poll_s": -1},
+        }
+        with Cluster() as cluster:
+            cluster.add_tpu_slice("slice-0", 1, 4)
+            cluster.enable_serving()
+            for name, cfg in cases.items():
+                cluster.store.create(InferenceService(
+                    metadata=ObjectMeta(name=name),
+                    spec=InferenceServiceSpec(predictor=ComponentSpec(
+                        model_format=ModelFormat(name="llama-continuous"),
+                        config={"params_ref": "mem://never-fetched",
+                                **cfg}))))
+            for name in cases:
+                deadline = time.time() + 20
+                isvc = None
+                while time.time() < deadline:
+                    isvc = cluster.store.try_get("InferenceService", name)
+                    if (isvc is not None and isvc.status.phase
+                            == InferenceServicePhase.FAILED):
+                        break
+                    time.sleep(0.05)
+                assert isvc is not None
+                assert isvc.status.phase == InferenceServicePhase.FAILED, \
+                    (name, isvc.status)
+                needle = ("prefix_poll_s" if name == "bad-poll"
+                          else "tracing")
+                assert needle in (isvc.status.message or ""), \
+                    (name, isvc.status.message)
