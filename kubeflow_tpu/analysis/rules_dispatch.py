@@ -287,13 +287,22 @@ def host_sync_in_dispatch(ctx: LintContext) -> Iterable[Finding]:
         # off-scheduler tier transitions — every such site carries a
         # declaring pragma, so an UNdeclared fetch creeping into tier
         # bookkeeping fails tier-1 (spill I/O never on the scheduler;
-        # the mailbox seam is the only crossing).
+        # the mailbox seam is the only crossing).  Autoscaling
+        # ORCHESTRATION classes (ISSUE 15: ``*Autoscaler`` /
+        # ``*Scaler`` / ``*Reaper``) are rooted for the same reason as
+        # resizers: the decision loop's sensor reads run every tick on
+        # the reconcile worker (or its own thread) against live-engine
+        # state — a device fetch or blocking socket inside a sensor or
+        # actuator closure turns every tick into a stall, so sensing
+        # must stay host-side stdlib and heavy actuation must go
+        # through the engines' public cross-thread APIs.
         roots += [
             qual
             for cls, methods in graph.by_class.items()
             if cls.endswith(("Allocator", "TrafficPlane", "Admission",
                              "Preemptor", "Resizer", "Reshard",
-                             "BlockPool"))
+                             "BlockPool", "Autoscaler", "Scaler",
+                             "Reaper"))
             or _TIER_CLASS.search(cls)
             for qual in methods.values()
         ]

@@ -297,8 +297,16 @@ def thread_affinity(ctx: LintContext) -> Iterable[Finding]:
         spawned = set(graph.thread_targets())
 
         # -- check 1: engine methods, classified by role ------------------
+        # Autoscaling/reaper ORCHESTRATION classes (ISSUE 15:
+        # ``*Autoscaler``/``*Scaler``/``*Reaper``) ride the same walk
+        # with an empty scheduler role: they have no scheduler roots,
+        # so EVERY method classifies as an external entry — these
+        # classes run on their own worker thread (or the reconcile
+        # worker) and may touch engines only through public
+        # cross-thread APIs, never by writing owned state directly.
         for cls in sorted(graph.classes):
-            if not cls.endswith("Engine"):
+            if not cls.endswith(("Engine", "Autoscaler", "Scaler",
+                                 "Reaper")):
                 continue
             methods = graph.by_class.get(cls, {})
             sched_set = graph.reachable(

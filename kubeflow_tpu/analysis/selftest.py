@@ -228,6 +228,58 @@ class FooEngine:
 """,
         0),
     Fixture(
+        # ISSUE 15 rooting: autoscaler/reaper orchestration classes are
+        # dispatch-path roots — a device fetch inside a sensor read
+        # stalls every tick
+        "host-sync-in-dispatch", "host-sync-autoscaler/true-positive",
+        "kubeflow_tpu/serving/_st_dispatch_scaler.py",
+        """
+import jax
+
+class FleetAutoscaler:
+    def sense(self):
+        return jax.device_get(self.buf)
+""",
+        1, "host sync"),
+    Fixture(
+        # same body, unrooted class name: planners that never touch the
+        # tick path stay out of scope
+        "host-sync-in-dispatch", "host-sync-autoscaler/near-miss",
+        "kubeflow_tpu/serving/_st_dispatch_scaler.py",
+        """
+import jax
+
+class AutoscalePlanner:
+    def sense(self):
+        return jax.device_get(self.buf)
+""",
+        0),
+    Fixture(
+        # ISSUE 15 rooting: every orchestration-class method is an
+        # external entry — writing scheduler-owned state from the
+        # decision loop is the race the contract forbids
+        "thread-affinity", "thread-affinity-autoscaler/true-positive",
+        "kubeflow_tpu/serving/_st_affinity_scaler.py",
+        """
+class FleetAutoscaler:
+    def tick(self):
+        self._slots.pop()
+""",
+        1, "scheduler-owned"),
+    Fixture(
+        # the blessed shape: GIL-copy reads + the engine's public
+        # cross-thread API for writes
+        "thread-affinity", "thread-affinity-autoscaler/near-miss",
+        "kubeflow_tpu/serving/_st_affinity_scaler.py",
+        """
+class FleetAutoscaler:
+    def tick(self):
+        live = len(list(self.engine.slots_view()))
+        if live == 0:
+            self.engine.submit(None)
+""",
+        0),
+    Fixture(
         # the acceptance bar's seeded drift: op "beta" is published but
         # its follow() arm was deleted
         "op-table", "op-table/true-positive",

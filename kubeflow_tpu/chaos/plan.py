@@ -56,6 +56,7 @@ class FaultKind(str, enum.Enum):
     SPILL_TORN = "spill_torn"        # published spill file loses its tail
     SPILL_KILL = "spill_kill"        # process dies mid-spill-write
     TIER_IO_STALL = "tier_io_stall"  # storage-tier I/O wedges for a window
+    AUTOSCALE_ACTUATOR_FAIL = "autoscale_actuator_fail"  # actuator dies
 
 
 @dataclass
@@ -340,6 +341,65 @@ class FaultPlan:
                         raise RuntimeError(
                             f"chaos: resize killed mid-{phase}")
         return fp
+
+    # -- autoscale actuator faults (ISSUE 15) ------------------------------
+    #
+    # The ClusterAutoscaler's actuators are multi-step live-state moves
+    # (replica drain, TP resize, tier rebalance, scale-to-zero
+    # hibernation) — any of them can fail mid-flight (a wedged drain, a
+    # follower nack, an unreachable new replica).  The loop's contract
+    # under injected failure: exponential backoff, at most
+    # ``max_retries`` attempts per demand episode (then the channel
+    # PARKS), and no flapping — pinned by the seeded sweep in
+    # tests/test_chaos.py.
+
+    AUTOSCALE_ACTUATORS = ("replica_up", "replica_down", "resize",
+                           "tier", "zero")
+
+    def autoscale_actuator_fail(self, actuator: Optional[str] = None,
+                                times: int = 1) -> "FaultPlan":
+        """Seeded failure of one autoscaler actuator channel (None =
+        seeded draw over :data:`AUTOSCALE_ACTUATORS` — a failed
+        placement, failed drain, failed resize, failed rebalance or
+        failed zero).  Consumed by :meth:`autoscale_failpoint`: the
+        loop's next ``times`` firings of that channel raise before the
+        actuator body runs."""
+        if actuator is None:
+            actuator = self.AUTOSCALE_ACTUATORS[
+                self.rng.randrange(len(self.AUTOSCALE_ACTUATORS))]
+        if actuator not in self.AUTOSCALE_ACTUATORS:
+            raise ValueError(
+                f"unknown autoscale actuator {actuator!r} "
+                f"(one of {self.AUTOSCALE_ACTUATORS})")
+        self.faults.append(Fault(FaultKind.AUTOSCALE_ACTUATOR_FAIL,
+                                 role=str(actuator), times=times))
+        return self
+
+    def autoscale_failpoint(self):
+        """A ``callable(channel)`` for
+        ``ClusterAutoscaler(failpoint=...)``: raises when the loop
+        fires the seeded channel, at most ``times`` firings; clean
+        pass-through otherwise."""
+        def fp(channel: str) -> None:
+            with self._lock:
+                for f in self.faults:
+                    if (f.kind == FaultKind.AUTOSCALE_ACTUATOR_FAIL
+                            and f.role == channel and f.fired < f.times):
+                        f.fired += 1
+                        raise RuntimeError(
+                            f"chaos: autoscale {channel} actuator "
+                            "failed")
+        return fp
+
+    def due_autoscale_fails(self) -> list[str]:
+        """Actuator channels whose seeded failures are NOT yet
+        exhausted — the paired read-only probe (tests assert the sweep
+        consumed every injected failure; consuming happens in
+        :meth:`autoscale_failpoint`, once per loop firing)."""
+        with self._lock:
+            return [f.role for f in self.faults
+                    if f.kind == FaultKind.AUTOSCALE_ACTUATOR_FAIL
+                    and f.fired < f.times]
 
     # -- storage-tier faults (ISSUE 12: crash-safe KV tiering) -------------
     #

@@ -131,6 +131,14 @@ class Request:
     #: Every instrumentation site guards on this None, so an untraced
     #: request pays one attribute read per site and allocates nothing.
     trace: Optional[Any] = None
+    #: durable-session binding (ISSUE 15): set, the idle-session reaper
+    #: may hibernate this sequence under this id once it goes quiet
+    session_id: Optional[str] = None
+    #: idle-session accounting: stamped by the scheduler at every token
+    #: delivery (and at admission/resume) — ``idle_sessions`` compares
+    #: it against the reaper's idle clock.  A single float write, so
+    #: the scheduler-side stamp is GIL-safe to read from any thread.
+    last_token_at: float = field(default_factory=time.perf_counter)
 
     def cancel(self) -> None:
         """Client-side cancellation (disconnect, timeout): the request
@@ -2245,6 +2253,7 @@ class ContinuousEngine:
         temperature: Optional[float] = None,
         top_p: Optional[float] = None, top_k: Optional[int] = None,
         priority: Optional[int] = None, trace=None,
+        session_id: Optional[str] = None,
     ) -> Request:
         req = Request(
             prompt=list(map(int, prompt)),
@@ -2258,6 +2267,7 @@ class ContinuousEngine:
             top_k=(None if top_k is None else int(top_k)),
             priority=(1 if priority is None else int(priority)),
             trace=trace,
+            session_id=(None if session_id is None else str(session_id)),
         )
         if trace is not None:
             # the queue-wait phase opens HERE and closes when the
@@ -3060,6 +3070,28 @@ class ContinuousEngine:
         hibernate/thaw default to it and ``stats()`` surfaces its
         verify-failure and hibernated-session gauges."""
         self.spill_store = store
+
+    def idle_sessions(self, idle_s: float,
+                      now: Optional[float] = None) -> list:
+        """Live session-bound sequences whose token stream has been
+        quiet for ``idle_s`` — the idle-session reaper's probe
+        (ISSUE 15).  GIL ``list()``-copy read of the slot table (the
+        EnginePreemptor pattern); the decision is double-checked by
+        ``hibernate_sequence``'s own mailbox export, so a sequence that
+        wakes between probe and export just exports at its current
+        (fresh) position or reports nothing-to-do.  Only sequences with
+        a ``session_id`` qualify: an anonymous request has no durable
+        identity to thaw under."""
+        now = time.perf_counter() if now is None else now
+        out = []
+        for req in list(self._slots):
+            if req is None or req.done.is_set():
+                continue
+            if not getattr(req, "session_id", None):
+                continue
+            if now - req.last_token_at >= float(idle_s):
+                out.append(req)
+        return out
 
     def hibernate_sequence(self, req: Request, session_id: str,
                            store=None, timeout: float = 60.0) -> bool:
@@ -3958,6 +3990,12 @@ class ContinuousEngine:
             self._active[slot] = True
             if req.trace is not None:
                 req.trace.phase("engine.decode", resumed=True)
+        # idle-session accounting: a freeze window (migration, resize,
+        # a held import waiting between turns) is not IDLENESS — the
+        # resume restarts the reaper's clock so a just-thawed or
+        # just-cutover sequence cannot be reaped for time it spent
+        # frozen by an actuator
+        req.last_token_at = time.perf_counter()
 
     def _mig_release(self, req: Request) -> None:
         slot = self._find_req_slot(req)
@@ -4586,6 +4624,8 @@ class ContinuousEngine:
             if emitted and req.first_token_at is None:
                 req.first_token_at = now
             req.tokens.extend(emitted)
+            if emitted:
+                req.last_token_at = now
             self.tokens_emitted += len(emitted)
             if done or len(req.tokens) >= req.max_new_tokens:
                 if req.trace is not None:
@@ -4645,6 +4685,8 @@ class ContinuousEngine:
             if emitted and req.first_token_at is None:
                 req.first_token_at = now
             req.tokens.extend(emitted)
+            if emitted:
+                req.last_token_at = now
             self.tokens_emitted += len(emitted)
             if done or len(req.tokens) >= req.max_new_tokens \
                     or self._remaining[slot] <= 0:
@@ -4763,10 +4805,10 @@ class TieredEngine:
 
     def submit(self, prompt, max_new_tokens=None,
                temperature=None, top_p=None, top_k=None,
-               priority=None, trace=None) -> Request:
+               priority=None, trace=None, session_id=None) -> Request:
         return self.engine.submit(
             prompt, max_new_tokens, temperature, top_p=top_p, top_k=top_k,
-            priority=priority, trace=trace)
+            priority=priority, trace=trace, session_id=session_id)
 
     def generate(self, prompt, max_new_tokens=None,
                  timeout: float = 120.0, temperature=None,
@@ -4973,6 +5015,12 @@ class DisaggregatedPool:
                              seq_buckets=seq_buckets, **kw)
             for _ in range(decode_replicas)]
         self.pools = self.prefill + self.decode
+        #: guards the TIER LISTS (prefill/decode membership) against the
+        #: rebalance actuator (ISSUE 15) racing the handoff worker's and
+        #: submit's tier picks.  Engine internals stay mailbox-guarded
+        #: as ever — this lock only covers which list an engine is on.
+        self._tier_lock = threading.Lock()
+        self.tier_rebalances_total = 0
         self._handoff_q: "queue.Queue" = queue.Queue()
         self._stopping = threading.Event()
         from collections import deque
@@ -5015,16 +5063,21 @@ class DisaggregatedPool:
                 src, req = self._handoff_q.get(timeout=0.1)
             except queue.Empty:
                 continue
-            # destination = most free blocks (rebalancing for free)
-            di = max(range(len(self.decode)),
-                     key=lambda i: self.decode[i]._alloc.free_blocks)
+            # destination = most free blocks (rebalancing for free).
+            # The engine OBJECT is captured (not its index): a tier
+            # rebalance may rewrite the decode list between pick and
+            # transfer, and the object stays a valid import target
+            # either way.
+            with self._tier_lock:
+                di = max(range(len(self.decode)),
+                         key=lambda i: self.decode[i]._alloc.free_blocks)
+                deng = self.decode[di]
             if self._servers:
                 def transfer(snap, _r=req, _d=di):
                     return self._send_wire(snap, _r, _d)
             else:
-                def transfer(snap, _r=req, _d=di):
-                    return self.decode[_d].import_sequence(
-                        snap, req=_r) is not None
+                def transfer(snap, _r=req, _e=deng):
+                    return _e.import_sequence(snap, req=_r) is not None
             # any transfer failure degrades to local decode on the
             # prefill engine (_migrate_one resumes it there)
             _migrate_one(src, req, transfer,
@@ -5079,15 +5132,16 @@ class DisaggregatedPool:
 
     def submit(self, prompt, max_new_tokens=None,
                temperature=None, top_p=None, top_k=None,
-               priority=None, trace=None) -> Request:
+               priority=None, trace=None, session_id=None) -> Request:
         # admissions are role-gated: ONLY prefill engines take traffic
         # (least-loaded by queued + live), decode engines only import
-        eng = min(self.prefill,
-                  key=lambda e: e._queue.qsize() + len(e._prefilling)
-                  + int(e._active.sum()))
+        with self._tier_lock:
+            eng = min(self.prefill,
+                      key=lambda e: e._queue.qsize() + len(e._prefilling)
+                      + int(e._active.sum()))
         return eng.submit(prompt, max_new_tokens, temperature,
                           top_p=top_p, top_k=top_k, priority=priority,
-                          trace=trace)
+                          trace=trace, session_id=session_id)
 
     def generate(self, prompt, max_new_tokens=None, timeout: float = 120.0,
                  temperature=None, top_p=None, top_k=None) -> list[int]:
@@ -5134,6 +5188,97 @@ class DisaggregatedPool:
     @property
     def prefix_tokens_saved(self) -> int:
         return sum(e.prefix_tokens_saved for e in self.pools)
+
+    def tier_pressure(self) -> dict:
+        """Per-tier load signal for the autoscaler's tier-rebalance
+        decision (ISSUE 15): backlog per prefill replica (queued +
+        mid-prefill sequences — the work the prefill tier has not
+        finished) vs live decode sequences per decode replica.  GIL
+        list/queue-size reads only."""
+        with self._tier_lock:
+            prefill, decode = list(self.prefill), list(self.decode)
+        pb = sum(e._queue.qsize() + len(e._prefilling) for e in prefill)
+        dl = sum(int(e._active.sum()) for e in decode)
+        return {
+            "prefill_pressure": pb / max(len(prefill), 1),
+            "decode_pressure": dl / max(len(decode), 1),
+            "prefill_replicas": len(prefill),
+            "decode_replicas": len(decode),
+        }
+
+    def rebalance(self, prefill_replicas: int) -> bool:
+        """Tier-ratio actuator (ISSUE 15): move engines between the
+        prefill and decode tiers until the prefill tier holds
+        ``prefill_replicas`` — chips are fungible across roles as the
+        admission/decode mix shifts (Podracer).  Both tiers keep >= 1
+        engine.  Runs on the CALLER's thread (the autoscaler loop):
+
+        - prefill -> decode: the least-loaded prefill engine stops
+          taking admissions (list membership gates ``submit``), its
+          handoff hook drops, and its role flips — in-flight prefills
+          finish and decode LOCALLY (degraded, never wrong: the same
+          fallback a failed handoff takes).
+        - decode -> prefill: the emptiest decode engine first drains
+          its live sequences onto the surviving decode engines through
+          ``migrate_live_sequences`` (copy-then-cutover — a failed
+          move decodes in place and the flip is aborted), then flips.
+
+        Wire-mode pools refuse: the per-decode-engine migration
+        servers are placement state this actuator does not manage.
+        Returns True when the tier split changed."""
+        target = int(prefill_replicas)
+        if self._servers:
+            raise RuntimeError(
+                "tier rebalance unsupported on wire=True pools")
+        if not 1 <= target <= len(self.pools) - 1:
+            raise ValueError(
+                f"prefill_replicas {target} out of range "
+                f"[1, {len(self.pools) - 1}]")
+        changed = False
+        while True:
+            with self._tier_lock:
+                delta = target - len(self.prefill)
+                if delta == 0:
+                    break
+                if delta < 0:
+                    # prefill -> decode: membership flip is enough; the
+                    # role read happens at prefill completion, so a
+                    # sequence mid-chunk just decodes where it is
+                    eng = min(self.prefill,
+                              key=lambda e: e._queue.qsize()
+                              + len(e._prefilling))
+                    self.prefill.remove(eng)
+                    eng.on_prefilled = None
+                    eng.role = "decode"
+                    self.decode.append(eng)
+                    self.tier_rebalances_total += 1
+                    changed = True
+                    continue
+                # decode -> prefill: pick the emptiest donor, but drain
+                # OUTSIDE the lock (migration ops carry 60s timeouts)
+                eng = max(self.decode,
+                          key=lambda e: e._alloc.free_blocks)
+                rest = [d for d in self.decode if d is not eng]
+            dst = max(rest, key=lambda e: e._alloc.free_blocks)
+            moved, failed = migrate_live_sequences(eng, dst)
+            if failed:
+                # the donor still owns sequences: flipping it to
+                # prefill would strand them behind admission-only
+                # scheduling — abort, the next tick retries
+                raise RuntimeError(
+                    f"tier rebalance aborted: {failed} sequences "
+                    "failed to drain off the donor decode engine")
+            with self._tier_lock:
+                if eng in self.decode and len(self.decode) > 1:
+                    self.decode.remove(eng)
+                    eng.role = "prefill"
+                    eng.on_prefilled = (
+                        lambda req, _e=eng:
+                        self._handoff_q.put((_e, req)))
+                    self.prefill.append(eng)
+                    self.tier_rebalances_total += 1
+                    changed = True
+        return changed
 
     def stats(self) -> dict:
         """Numeric stats summed across the tiers (counters add; the

@@ -841,6 +841,19 @@ class _Deployment:
         #: (one DegradedTimeout + shrink per episode, not per 4 Hz tick)
         self.degraded_since: Optional[float] = None
         self.degraded_escalated = False
+        #: predictive autoscaler (ISSUE 15), fingerprint-rebuilt like
+        #: the traffic plane so predictor state and cooldown clocks
+        #: survive the 4 Hz reconcile; its replica actuators write
+        #: ``autoscale_desired`` and ``_desired_replicas`` applies it
+        self.autoscaler = None
+        self.autoscale_fp: Optional[str] = None
+        self.autoscale_desired: Optional[int] = None
+        #: wake-from-zero cold-start clock: stamped when the loop fires
+        #: a placement at n=0, closed when the fleet reports ready —
+        #: the measured budget scale-to-zero is held to
+        self.cold_start_t0: Optional[float] = None
+        #: (monotonic t, cumulative plane sheds) for the shed-rate sensor
+        self.shed_mark: tuple[float, float] = (0.0, 0.0)
 
     @property
     def revisions(self) -> list[_Revision]:
@@ -984,12 +997,26 @@ class InferenceServiceController(Controller):
             if not isinstance(hib, dict) or not str(hib.get("root", "")):
                 raise ValueError(
                     "invalid engine knobs: hibernation must be "
-                    '{"root": dir[, "fsync": bool]}')
-            unknown = set(hib) - {"root", "fsync"}
+                    '{"root": dir[, "fsync": bool, "reap_idle_s": s, '
+                    '"reap_interval_s": s]}')
+            unknown = set(hib) - {"root", "fsync", "reap_idle_s",
+                                  "reap_interval_s"}
             if unknown:
                 raise ValueError(
                     f"invalid engine knobs: hibernation keys "
                     f"{sorted(unknown)} unknown")
+            # idle-session reaper knobs (ISSUE 15 satellite): a zero or
+            # negative idle clock would hibernate sessions mid-decode
+            for k in ("reap_idle_s", "reap_interval_s"):
+                if hib.get(k) is not None:
+                    try:
+                        ok = float(hib[k]) > 0
+                    except (TypeError, ValueError):
+                        ok = False
+                    if not ok:
+                        raise ValueError(
+                            f"invalid engine knobs: hibernation.{k} "
+                            f"{hib[k]!r} (must be a positive number)")
             if int(cfg.get("block_size", 0) or 0) <= 0:
                 raise ValueError(
                     "invalid engine knobs: hibernation requires the "
@@ -1005,6 +1032,18 @@ class InferenceServiceController(Controller):
             try:
                 validate_tracing(cfg["tracing"])
             except ValueError as e:
+                raise ValueError(f"invalid engine knobs: {e}") from e
+        # predictive autoscaler knobs (ISSUE 15) freeze here too — the
+        # PR 4/7/8 convention: inverted hysteresis bands or a negative
+        # cooldown is ONE Failed status at conf-freeze, not a decision
+        # loop misbehaving at 4 Hz; validate_autoscale is the one
+        # shared validator
+        if cfg.get("autoscale") is not None:
+            from .autoscale import validate_autoscale
+
+            try:
+                validate_autoscale(cfg["autoscale"])
+            except (TypeError, ValueError) as e:
                 raise ValueError(f"invalid engine knobs: {e}") from e
         pps = cfg.get("prefix_poll_s")
         if pps is not None:
@@ -1123,9 +1162,25 @@ class InferenceServiceController(Controller):
             return None
 
         dep.pct = max(0, min(100, pct or 0)) if dep.canary is not None else 0
+        # predictive autoscaler (ISSUE 15): build/tick BEFORE the
+        # scaling pass so this reconcile applies the tick's verdict
+        self._sync_autoscaler(isvc, dep)
         for rev in dep.revisions:
             desired = self._desired_replicas(dep, rev)
+            before = list(rev.predictors)
             self._scale_predictors(isvc, dep, rev, desired)
+            if dep.autoscaler is not None and rev is dep.stable:
+                # pre-warm placed replicas from a hot peer's registry
+                # BEFORE _wire exposes them to traffic (the r12/r16
+                # residual): first admissions hit a warm prefix cache
+                for s in rev.predictors:
+                    if s not in before:
+                        try:
+                            self._prewarm_replica(isvc, rev, s)
+                        except Exception as e:  # noqa: BLE001 — warm
+                            # cache is an optimization, never a gate
+                            log.debug("replica pre-warm failed: %s", e)
+        self._measure_cold_start(dep)
         self._wire(isvc, dep)
         self._sync_traffic(dep)
 
@@ -1305,6 +1360,21 @@ class InferenceServiceController(Controller):
             # activator's wait — an idle-scaled gang would answer its
             # next caller with timeouts
             floor = max(floor, 1)
+        if (dep.autoscaler is not None and rev is dep.stable
+                and pred.gang is None):
+            # predictive loop (ISSUE 15): the tick's replica actuators
+            # wrote autoscale_desired and this branch REPLACES the
+            # reactive idle clock below.  The activator's wake still
+            # wins — demand at the door between ticks must not wait a
+            # loop interval (a gated wake decision leaves
+            # wants_scale_up set for exactly this backstop).
+            if dep.wants_scale_up:
+                dep.wants_scale_up = False
+                dep.autoscale_desired = max(
+                    dep.autoscale_desired or 0, 1, floor)
+            target = (n if dep.autoscale_desired is None
+                      else dep.autoscale_desired)
+            return max(min(target, pred.max_replicas), floor)
         if dep.wants_scale_up and rev is dep.stable:
             dep.wants_scale_up = False
             return max(n, 1, floor)
@@ -1620,6 +1690,360 @@ class InferenceServiceController(Controller):
             dep.wants_scale_up = True
         self.queue.add(key)
 
+    # -- predictive autoscaler (ISSUE 15) ---------------------------------
+
+    def _sync_autoscaler(self, isvc, dep: _Deployment) -> None:
+        """Keep the deployment's :class:`~.autoscale.ClusterAutoscaler`
+        in sync with the stable revision's ``autoscale:`` knob family
+        (fingerprinted like the traffic plane — predictor window,
+        cooldown clocks and retry state survive the 4 Hz reconcile),
+        then run one tick.  The tick runs HERE, on the reconcile
+        worker: this controller is single-worker precisely because
+        reconciles mutate live deployment state, and the decision
+        loop's actuators (victim ordering, tier rebalance, engine
+        resize) are exactly such mutations — a free-running thread
+        would race every reconcile.  ``ClusterAutoscaler.start()``
+        remains the threaded mode for the bench/standalone path."""
+        if dep.stable is None:
+            return
+        spec = dep.stable.cfg.get("autoscale")
+        if spec is None:
+            if dep.autoscaler is not None:
+                dep.autoscaler = None
+                dep.autoscale_fp = None
+                dep.autoscale_desired = None
+                dep.cold_start_t0 = None
+            return
+        fp = json.dumps(spec, sort_keys=True, default=str)
+        if fp != dep.autoscale_fp:
+            from .autoscale import AutoscalePolicy, ClusterAutoscaler
+
+            try:
+                policy = AutoscalePolicy.from_config(dict(spec))
+            except (TypeError, ValueError) as e:
+                # conf-freeze validated this; only a racing edit of a
+                # live cfg dict can land here — keep the previous loop
+                log.debug("autoscale config rejected: %s", e)
+                return
+            dep.autoscaler = ClusterAutoscaler(
+                policy,
+                sensors=lambda: self._autoscale_signals(dep),
+                actuators=self._autoscale_actuators(isvc, dep))
+            dep.autoscale_fp = fp
+            dep.autoscale_desired = None
+        dec = dep.autoscaler.tick()
+        if dec.action != "none":
+            self.emit_event(
+                isvc, "AutoscaleDecision", f"{dec.action}: {dec.reason}")
+
+    def _autoscale_signals(self, dep: _Deployment) -> dict:
+        """One sensor snapshot for ``autoscale.decide`` — in-process
+        stats reads only (plane counters, tracer summary, engine
+        ``stats()``/``tier_pressure()``, the router idle clock).  No
+        blocking HTTP: this runs on the shared reconcile worker."""
+        rev = dep.stable
+        pol = dep.autoscaler.policy
+        preds = [] if rev is None else list(rev.predictors)
+        spec = rev.spec.predictor if rev is not None else None
+        n = len(preds)
+        inflight = 0
+        live = 0.0
+        free_ratio = 1.0
+        degree = 0
+        pp = dp = 0.0
+        pn = dn = 0
+        for s in preds:
+            try:
+                inflight += int(s.metrics.inflight)
+            except (AttributeError, TypeError):
+                pass
+            engines = getattr(s, "engines", None)
+            if engines is None:
+                continue
+            for eng in engines().values():
+                tier = getattr(eng, "tier_pressure", None)
+                if tier is not None:
+                    t = tier()
+                    pp += t["prefill_pressure"]
+                    dp += t["decode_pressure"]
+                    pn += t["prefill_replicas"]
+                    dn += t["decode_replicas"]
+                st = eng.stats()
+                live += float(st.get("slots_live", 0) or 0)
+                total = float(st.get("kv_blocks_total", 0) or 0)
+                if total > 0:
+                    free_ratio = min(
+                        free_ratio,
+                        float(st.get("kv_blocks_free", 0)) / total)
+                mesh = getattr(eng, "mesh", None)
+                degree = max(degree,
+                             int(mesh.size) if mesh is not None else 1)
+        now = time.monotonic()
+        shed_rate = 0.0
+        plane = dep.router.traffic if dep.router is not None else None
+        if plane is not None:
+            total_sheds = sum(
+                int(c.get("qos_shed_total", 0))
+                for c in plane.stats().get("classes", {}).values())
+            t0, s0 = dep.shed_mark
+            if t0 and now > t0:
+                shed_rate = max(0.0, (total_sheds - s0) / (now - t0))
+            dep.shed_mark = (now, float(total_sheds))
+        qwait = 0.0
+        tracer = dep.router.tracer if dep.router is not None else None
+        if tracer is not None:
+            summary = tracer.sink.summary(pol.window_s)
+            for c in summary.get("classes", {}).values():
+                if c.get("traces"):
+                    qwait = max(qwait,
+                                c["queue_wait_sum_s"] / c["traces"])
+        idle_s = 0.0
+        if dep.router is not None and dep.router.last_request_time:
+            idle_s = max(0.0, time.time()
+                         - dep.router.last_request_time)
+        return {
+            "replicas": n,
+            "min_replicas": spec.min_replicas if spec else 0,
+            "max_replicas": spec.max_replicas if spec else max(n, 1),
+            "util": (inflight / max(n, 1)
+                     / max(pol.target_concurrency, 1e-9)),
+            "shed_rate": shed_rate,
+            "queue_wait_s": qwait,
+            "free_block_ratio": free_ratio,
+            "idle_s": idle_s,
+            "live": live,
+            "pending": 1.0 if dep.wants_scale_up else 0.0,
+            "degree": degree,
+            "prefill_pressure": pp,
+            "decode_pressure": dp,
+            "prefill_replicas": pn,
+            "decode_replicas": dn,
+        }
+
+    def _autoscale_actuators(self, isvc, dep: _Deployment) -> dict:
+        """The controller's actuator channel map.  Replica channels
+        write ``autoscale_desired`` — the SAME ``_scale_predictors``
+        machinery the reactive path uses then applies it this pass, so
+        scale-down stays the lossless migrate-then-retire drain and
+        the canary/gang invariants hold unchanged."""
+
+        def _replica_up(dec) -> None:
+            rev = dep.stable
+            cur = 0 if rev is None else len(rev.predictors)
+            if cur == 0 and dep.cold_start_t0 is None:
+                dep.cold_start_t0 = time.monotonic()
+            dep.autoscale_desired = max(
+                int(dec.replicas if dec.replicas is not None
+                    else cur + 1), 1)
+            dep.wants_scale_up = False
+
+        def _replica_down(dec) -> None:
+            rev = dep.stable
+            if rev is None or len(rev.predictors) <= 1:
+                raise RuntimeError("no replica to retire")
+            self._order_scale_down_victim(dep, rev)
+            dep.autoscale_desired = int(
+                dec.replicas if dec.replicas is not None
+                else len(rev.predictors) - 1)
+
+        def _zero(dec) -> None:
+            self._hibernate_for_zero(isvc, dep)
+            dep.autoscale_desired = 0
+
+        def _resize(dec) -> None:
+            self._resize_replicas_to_degree(isvc, dep, int(dec.degree))
+
+        def _tier(dec) -> None:
+            rev = dep.stable
+            for s in ([] if rev is None else rev.predictors):
+                engines = getattr(s, "engines", None)
+                if engines is None:
+                    continue
+                for eng in engines().values():
+                    fn = getattr(eng, "rebalance", None)
+                    if fn is None:
+                        continue
+                    npools = len(eng.pools)
+                    fn(max(1, min(int(dec.prefill), npools - 1)))
+                    return
+            raise RuntimeError("no disaggregated pool to rebalance")
+
+        return {"replica_up": _replica_up, "replica_down": _replica_down,
+                "zero": _zero, "resize": _resize, "tier": _tier}
+
+    def _order_scale_down_victim(self, dep: _Deployment,
+                                 rev: _Revision) -> None:
+        """Reorder ``rev.predictors`` so the least session/prefix-heat
+        replica sits LAST — ``_scale_predictors`` pops from the tail,
+        so the victim is the replica whose retirement invalidates the
+        least cluster KV reuse (poller prefix census) and migrates the
+        fewest live conversations."""
+        preds = rev.predictors
+        if len(preds) < 2:
+            return
+        poller = (dep.router.prefix_poller
+                  if dep.router is not None else None)
+        heat = poller.heat_by_backend() if poller is not None else {}
+
+        def score(s) -> tuple:
+            h = int(heat.get(getattr(s, "url", ""), 0))
+            live = 0
+            engines = getattr(s, "engines", None)
+            if engines is not None:
+                for eng in engines().values():
+                    try:
+                        live += int(eng.stats().get("slots_live", 0))
+                    except (AttributeError, TypeError, RuntimeError):
+                        pass
+            return (h, live)
+
+        victim = min(preds, key=score)
+        if preds[-1] is not victim:
+            preds.remove(victim)
+            preds.append(victim)
+
+    def _prewarm_replica(self, isvc, rev: _Revision, server) -> int:
+        """Warm a freshly placed replica's prefix registry from a hot
+        peer before it takes traffic: registry-census the peer
+        (``prefix_census``), export its block content
+        (``export_prefix_blocks`` — the in-process ``kv_fetch``) and
+        ``install_prefix`` into the new pool.  Bounded and best-effort:
+        a cold replica that serves its first request un-warmed just
+        prefills, exactly as before this path existed."""
+        engines = getattr(server, "engines", None)
+        if engines is None:
+            return 0  # gang replicas warm through serve_main
+        peers = [s for s in rev.predictors
+                 if s is not server and getattr(s, "ready", True)
+                 and getattr(s, "engines", None) is not None]
+        installed = 0
+        for name, eng in engines().items():
+            if not getattr(eng, "paged", False):
+                continue
+            for peer in peers:
+                src = peer.engines().get(name)
+                if src is None or not getattr(src, "paged", False):
+                    continue
+                try:
+                    census = src.prefix_census(timeout=10.0)
+                except (RuntimeError, TimeoutError):
+                    continue
+                # deepest records first; cap the copy budget so warm-up
+                # can never stall the reconcile pass behind a huge pool
+                census = sorted(census, key=len, reverse=True)[:8]
+                for toks in census:
+                    try:
+                        covered, blocks = src.export_prefix_blocks(
+                            [int(t) for t in toks], timeout=10.0)
+                        if covered and blocks and eng.install_prefix(
+                                covered, blocks, timeout=10.0):
+                            installed += 1
+                    except (RuntimeError, TimeoutError):
+                        break
+                break  # one warm peer per engine is enough
+        if installed:
+            self.emit_event(
+                isvc, "ReplicaPrewarmed",
+                f"{installed} hot prefixes installed before traffic")
+        return installed
+
+    def _hibernate_for_zero(self, isvc, dep: _Deployment) -> int:
+        """Scale-to-zero prologue: park every session durably in the
+        spill store before the fleet tears down — a zero with live
+        sessions would otherwise trade HBM for lost conversations.
+        ``idle_sessions(0.0)`` enumerates every session-tagged
+        sequence; a failed spill resumes in place (and the teardown
+        still drains losslessly via the migrate-then-retire path)."""
+        rev = dep.stable
+        parked = 0
+        for s in ([] if rev is None else rev.predictors):
+            engines = getattr(s, "engines", None)
+            if engines is None:
+                continue
+            for eng in engines().values():
+                probe = getattr(eng, "idle_sessions", None)
+                if (probe is None
+                        or getattr(eng, "spill_store", None) is None):
+                    continue
+                for req in probe(0.0):
+                    sid = getattr(req, "session_id", None)
+                    if not sid:
+                        continue
+                    try:
+                        if eng.hibernate_sequence(req, sid):
+                            parked += 1
+                    except (RuntimeError, TimeoutError) as e:
+                        log.debug("pre-zero hibernate %s failed: %s",
+                                  sid, e)
+        if parked:
+            self.emit_event(
+                isvc, "SessionsHibernated",
+                f"{parked} sessions hibernated ahead of scale-to-zero")
+        return parked
+
+    def _resize_replicas_to_degree(self, isvc, dep: _Deployment,
+                                   degree: int) -> None:
+        """TP-degree actuator for in-process replicas: run the PR 9
+        copy-then-cutover resize on every plain paged engine behind the
+        stable revision (``swap_engine`` re-points the runtime, the
+        preemptors and the tracer follow the pool).  Tiered/disagg
+        engines are skipped — their capacity knob is the tier split,
+        not the degree.  Raises when nothing resized: the decision
+        demanded throughput the fleet cannot deliver, and the loop's
+        bounded-retry backoff must see that, not a silent no-op."""
+        from .resize import GangResizer
+
+        rev = dep.stable
+        resized = 0
+        err: Optional[Exception] = None
+        for s in ([] if rev is None else rev.predictors):
+            models = getattr(s, "models", None)
+            if models is None:
+                continue
+            for model in models().values():
+                eng = getattr(model, "engine", None)
+                if (eng is None or not getattr(eng, "paged", False)
+                        or getattr(eng, "pools", None) is not None):
+                    continue
+                try:
+                    resizer = GangResizer(
+                        eng,
+                        set_engine=getattr(model, "swap_engine", None))
+                    if resizer.degree() == int(degree):
+                        continue
+                    resizer.resize_to_degree(int(degree))
+                    resized += 1
+                except Exception as e:  # noqa: BLE001 — a failed resize
+                    # already resumed the old degree in place; surface
+                    # it to the actuator's retry budget below
+                    err = e
+        if err is not None:
+            raise RuntimeError(
+                f"TP resize to degree {degree} failed on a replica"
+            ) from err
+        if not resized:
+            raise RuntimeError(
+                f"no replica engine accepted a TP resize to {degree}")
+        self.emit_event(
+            isvc, "GangResized",
+            f"{resized} replica engine(s) resized to TP degree {degree}"
+            " by the autoscaler")
+
+    def _measure_cold_start(self, dep: _Deployment) -> None:
+        """Close the wake-from-zero clock once every stable replica
+        reports ready — the measured budget ``decide`` holds
+        scale-to-zero to (zero is only cheap if waking is)."""
+        if (dep.autoscaler is None or dep.cold_start_t0 is None
+                or dep.stable is None):
+            return
+        preds = dep.stable.predictors
+        want = dep.autoscale_desired
+        if (preds and (want is None or len(preds) >= want)
+                and all(getattr(s, "ready", True) for s in preds)):
+            dep.autoscaler.note_cold_start(
+                time.monotonic() - dep.cold_start_t0)
+            dep.cold_start_t0 = None
+
     # -- resolution -------------------------------------------------------
 
     def _resolve(self, isvc: InferenceService):
@@ -1665,6 +2089,9 @@ class InferenceServiceController(Controller):
     # -- teardown / status -------------------------------------------------
 
     def _teardown_deployment(self, dep: _Deployment) -> None:
+        dep.autoscaler = None
+        dep.autoscale_fp = None
+        dep.autoscale_desired = None
         for rev in dep.revisions:
             for s in rev.servers:
                 s.stop()

@@ -472,6 +472,21 @@ class GangResizer:
         mesh = getattr(self.engine, "mesh", None)
         return int(mesh.size) if mesh is not None else 1
 
+    def resize_to_degree(self, degree: int) -> Any:
+        """Degree-targeted actuator entry point (ISSUE 15): the
+        autoscaler reasons in TP degrees, not mesh-axes dicts — map the
+        target onto the single-axis layout every elastic consumer uses
+        (``{"model": N}``; ``_resize_locked`` normalizes degree 1 on
+        unmeshed engines).  A same-degree target is a no-op returning
+        the live engine, NOT a resync-by-rebuild — the supervisor owns
+        that path."""
+        d = int(degree)
+        if d < 1:
+            raise ValueError(f"target TP degree must be >= 1, got {d}")
+        if d == self.degree():
+            return self.engine
+        return self.resize({"model": d})
+
     # -- the resize --------------------------------------------------------
 
     def resize(self, mesh_axes: Optional[dict], *,

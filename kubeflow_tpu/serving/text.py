@@ -279,6 +279,11 @@ class TextGenerator(Model):
         #: from config["hibernation"] and attached to every paged
         #: engine (hibernate/thaw + the /metrics session gauges)
         self.spill_store = None
+        #: idle-session reaper (ISSUE 15 satellite) — built by load()
+        #: when config["hibernation"]["reap_idle_s"] is set: quiet
+        #: sessions hibernate to the spill store on a clock instead of
+        #: only by operator/API action
+        self.reaper = None
         #: request-lifecycle tracer (ISSUE 13) — built by load() from
         #: config["tracing"] ({"sample": f, "ring": n}); ModelServer
         #: discovers it here (door spans, /traces, phase histograms)
@@ -365,6 +370,16 @@ class TextGenerator(Model):
             str(hib["root"]), fsync=bool(hib.get("fsync", True)))
         for eng in self._hibernation_engines():
             eng.attach_spill_store(self.spill_store)
+        reap = hib.get("reap_idle_s")
+        if reap:
+            from .autoscale import SessionReaper
+
+            # the engine list is re-read every scan so an elastic
+            # resize (swap_engine) retargets the clock automatically
+            self.reaper = SessionReaper(
+                self._hibernation_engines, float(reap),
+                interval_s=float(hib.get("reap_interval_s", 1.0)),
+            ).start()
 
     def _hibernation_engines(self) -> list:
         """The paged engines the store is attached to — for a
@@ -471,6 +486,9 @@ class TextGenerator(Model):
                     np_._parked.extend(carried)
 
     def stop(self) -> None:
+        if self.reaper is not None:
+            self.reaper.stop()
+            self.reaper = None
         if self.traffic is not None:
             self.traffic.stop()
             self.traffic = None

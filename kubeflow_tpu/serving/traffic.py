@@ -368,6 +368,17 @@ class KvBlockRegistry:
         self.misses_total += 1
         return None, 0
 
+    def heat_by_backend(self) -> dict[str, int]:
+        """backend -> number of registry entries it advertises — the
+        per-replica KV footprint the autoscaler's scale-down victim
+        pick consumes (ISSUE 15): retiring the coldest backend
+        invalidates the least cluster prefix reuse."""
+        with self._lock:
+            out: dict[str, int] = {}
+            for b, _depth in self._map.values():
+                out[b] = out.get(b, 0) + 1
+        return out
+
     def stats(self) -> dict:
         with self._lock:
             entries = len(self._map)
@@ -486,6 +497,25 @@ class ClusterPrefixPoller:
         """key hex -> number of replicas advertising it."""
         with self._lock:
             return {k: len(v) for k, v in self._heat.items()}
+
+    def heat_by_backend(self) -> dict[str, int]:
+        """backend URL -> number of prefix keys it advertises — the
+        placement-side view of the census (ISSUE 15): the autoscaler
+        retires the replica carrying the LEAST heat, so a scale-down
+        costs the fewest warm prefixes."""
+        with self._lock:
+            out: dict[str, int] = {}
+            for per in self._heat.values():
+                for b in per:
+                    out[b] = out.get(b, 0) + 1
+        return out
+
+    def hottest(self, n: int = 8) -> list[tuple[str, int]]:
+        """Top-``n`` (key hex, replica count) rows, hottest first —
+        the pre-warm working set a freshly placed replica should fetch
+        before taking traffic."""
+        heat = self.heat()
+        return sorted(heat.items(), key=lambda kv: (-kv[1], kv[0]))[:n]
 
     def metrics_lines(self) -> list[str]:
         """The cluster prefix-heat gauge lines for the router's

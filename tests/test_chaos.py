@@ -1295,3 +1295,157 @@ class TestKvSpillChaos:
             assert store.session_count() == 0
         finally:
             b.stop()
+
+
+class TestAutoscaleActuatorChaos:
+    """Actuator-failure faults for the ClusterAutoscaler decision loop
+    (ISSUE 15): a seeded failed placement / failed drain / failed
+    resize must produce exponential backoff with at most ``max_retries``
+    attempts per demand episode (then the channel PARKS — bounded, no
+    oscillating resize storm), and a transient failure must converge
+    back to a clean actuation.  Pure host loop: seeded FaultPlan
+    failpoint + manual clock, no engines."""
+
+    CHANNELS = ("replica_up", "replica_down", "resize", "tier", "zero")
+
+    #: one sensor recipe per channel that makes decide() demand it
+    SIGS = {
+        "replica_up": {"replicas": 1, "min_replicas": 1,
+                       "max_replicas": 4, "util": 5.0},
+        "replica_down": {"replicas": 3, "min_replicas": 1,
+                         "max_replicas": 4, "util": 0.0},
+        "resize": {"replicas": 4, "min_replicas": 1, "max_replicas": 4,
+                   "util": 5.0, "degree": 1},
+        "tier": {"replicas": 1, "min_replicas": 1, "max_replicas": 1,
+                 "util": 1.0, "prefill_pressure": 10.0,
+                 "decode_pressure": 1.0, "prefill_replicas": 1,
+                 "decode_replicas": 2},
+        "zero": {"replicas": 1, "min_replicas": 0, "max_replicas": 4,
+                 "util": 0.0, "idle_s": 999.0, "live": 0.0},
+    }
+
+    def _make(self, plan, sig, *, max_retries=3):
+        from kubeflow_tpu.serving.autoscale import (
+            AutoscalePolicy,
+            ClusterAutoscaler,
+        )
+
+        policy = AutoscalePolicy(
+            scale_to_zero=True, tp_degrees=(1, 2, 4),
+            up_cooldown_s=0.0, down_cooldown_s=0.0, resize_cooldown_s=0.0,
+            tier_cooldown_s=0.0, zero_cooldown_s=0.0,
+            max_retries=max_retries, backoff_s=0.5, backoff_cap_s=4.0)
+        fired = []
+        acts = {c: (lambda dec, _c=c: fired.append(_c))
+                for c in self.CHANNELS}
+        auto = ClusterAutoscaler(
+            policy, sensors=lambda: dict(sig), actuators=acts,
+            failpoint=plan.autoscale_failpoint() if plan else None)
+        return auto, fired
+
+    def test_seeded_builder_deterministic_and_paired(self):
+        for seed in (0, 3, 11):
+            a = FaultPlan(seed=seed).autoscale_actuator_fail()
+            b = FaultPlan(seed=seed).autoscale_actuator_fail()
+            assert a.faults[0].role == b.faults[0].role
+            assert a.faults[0].role in FaultPlan.AUTOSCALE_ACTUATORS
+        plan = FaultPlan(seed=0).autoscale_actuator_fail("resize", times=2)
+        assert plan.due_autoscale_fails() == ["resize"]
+        fp = plan.autoscale_failpoint()
+        fp("replica_up")  # wrong channel: clean pass-through
+        with pytest.raises(RuntimeError):
+            fp("resize")
+        assert plan.due_autoscale_fails() == ["resize"]  # one left
+        with pytest.raises(RuntimeError):
+            fp("resize")
+        assert plan.due_autoscale_fails() == []
+        fp("resize")  # exhausted: pass-through
+        with pytest.raises(ValueError):
+            FaultPlan(seed=0).autoscale_actuator_fail("bogus")
+
+    def test_dead_actuator_parks_after_bounded_retries(self):
+        """A permanently failing actuator costs exactly max_retries
+        attempts, with exponential backoff between them, then the
+        channel parks — 50 more ticks of identical demand fire
+        NOTHING (the no-flap contract)."""
+        plan = FaultPlan(seed=1).autoscale_actuator_fail(
+            "replica_up", times=10_000)
+        auto, fired = self._make(plan, self.SIGS["replica_up"])
+        t = 100.0
+        for _ in range(3):
+            auto.tick(now=t)          # attempt -> chaos failure
+            gated = auto.tick(now=t + 0.01)  # inside backoff: gated
+            assert gated.action == "none" and "backoff" in gated.reason \
+                or "parked" in gated.reason
+            t += 10.0                 # clear the backoff window
+        assert auto.actuator_failures_total == 3
+        assert auto.states["replica_up"].parked
+        for _ in range(50):
+            t += 1.0
+            dec = auto.tick(now=t)
+            assert dec.action == "none"
+            assert "parked" in dec.reason
+        assert auto.actuator_failures_total == 3  # bounded, forever
+        assert fired == []  # the actuator body never ran
+        # no oscillation: nothing else ever fired under constant demand
+        assert {a for a, _ok in auto.history} == {"scale_up", "none"}
+
+    def test_demand_change_resets_the_retry_budget(self):
+        """Parking is PER DEMAND EPISODE: when the demanded action
+        changes (the world moved on), a parked channel gets its retry
+        budget back — a later episode may try again, still bounded."""
+        plan = FaultPlan(seed=2).autoscale_actuator_fail(
+            "replica_up", times=10_000)
+        sig = dict(self.SIGS["replica_up"])
+        auto, _fired = self._make(plan, sig)
+        t = 100.0
+        for _ in range(4):
+            auto.tick(now=t)
+            t += 10.0
+        assert auto.states["replica_up"].parked
+        assert auto.actuator_failures_total == 3
+        # demand goes away (util inside the band): episode over
+        sig.clear()
+        sig.update({"replicas": 2, "min_replicas": 1, "max_replicas": 4,
+                    "util": 1.0})
+        for _ in range(30):  # predictor must forget the hot window
+            t += 5.0
+            auto.tick(now=t)
+        assert not auto.states["replica_up"].parked  # reset on change
+        # second episode: bounded again, not unbounded
+        sig.clear()
+        sig.update(self.SIGS["replica_up"])
+        for _ in range(10):
+            t += 10.0
+            auto.tick(now=t)
+        assert auto.states["replica_up"].parked
+        assert auto.actuator_failures_total == 6  # 3 per episode
+
+    def test_transient_failure_converges_each_channel(self):
+        """Seeded sweep over every actuator channel: times=2 failures,
+        then the SAME demand's next attempt succeeds — bounded retries
+        consume every injected fault and the loop converges."""
+        for chan in self.CHANNELS:
+            plan = FaultPlan(seed=7).autoscale_actuator_fail(
+                chan, times=2)
+            auto, fired = self._make(plan, self.SIGS[chan])
+            t, ok_actions = 100.0, []
+            for _ in range(8):
+                dec = auto.tick(now=t)
+                if dec.action != "none" and auto.history[-1][1]:
+                    ok_actions.append(dec.action)
+                t += 10.0
+            assert plan.due_autoscale_fails() == [], chan
+            assert fired and fired[0] == chan, chan
+            assert auto.actuator_failures_total == 2, chan
+            assert not auto.states[chan].parked, chan
+            assert ok_actions, chan  # converged to a clean actuation
+
+    def test_seeded_draw_sweep_is_deterministic(self):
+        roles = [FaultPlan(seed=s).autoscale_actuator_fail().faults[0].role
+                 for s in range(16)]
+        again = [FaultPlan(seed=s).autoscale_actuator_fail().faults[0].role
+                 for s in range(16)]
+        assert roles == again
+        assert set(roles) <= set(FaultPlan.AUTOSCALE_ACTUATORS)
+        assert len(set(roles)) > 1  # the draw actually varies by seed
