@@ -376,6 +376,80 @@ class Plan:
         return [f for f in self.faults if f.kind == FaultKind.CRASH]
 """,
         0),
+    Fixture(
+        # ISSUE 16 drift shape: a correlated-failure fault whose
+        # actuator poll was deleted — the plan builds domain outages
+        # nothing ever fires
+        "fault-pairing", "fault-pairing-outage/true-positive",
+        "kubeflow_tpu/chaos/_st_faults_outage.py",
+        """
+class FaultKind:
+    CRASH = "crash"
+    DOMAIN_OUTAGE = "domain_outage"
+
+class Fault:
+    def __init__(self, kind, at=0.0, node=None):
+        self.kind = kind
+        self.node = node
+
+class Plan:
+    def crash(self):
+        self.faults.append(Fault(FaultKind.CRASH))
+
+    def domain_outage(self, name):
+        self.faults.append(Fault(FaultKind.DOMAIN_OUTAGE, node=name))
+
+    def due(self):
+        return [f for f in self.faults if f.kind == FaultKind.CRASH]
+""",
+        1, "DOMAIN_OUTAGE"),
+    Fixture(
+        # the paired shape this PR ships: producer builder + a
+        # due_domain_outages-style consumer comparison
+        "fault-pairing", "fault-pairing-outage/near-miss",
+        "kubeflow_tpu/chaos/_st_faults_outage.py",
+        """
+class FaultKind:
+    DOMAIN_OUTAGE = "domain_outage"
+
+class Fault:
+    def __init__(self, kind, at=0.0, node=None):
+        self.kind = kind
+        self.node = node
+
+class Plan:
+    def domain_outage(self, name):
+        self.faults.append(Fault(FaultKind.DOMAIN_OUTAGE, node=name))
+
+    def due_domain_outages(self):
+        return [f.node for f in self.faults
+                if f.kind == FaultKind.DOMAIN_OUTAGE and not f.fired]
+""",
+        0),
+    Fixture(
+        # ISSUE 16 rooting: the emergency surge path runs on the
+        # autoscaler tick — writing scheduler-owned engine state from
+        # it is the race the contract forbids, emergency or not
+        "thread-affinity", "thread-affinity-emergency/true-positive",
+        "kubeflow_tpu/serving/_st_affinity_emergency.py",
+        """
+class SurgeAutoscaler:
+    def emergency_tick(self):
+        self._waiting.clear()
+""",
+        1, "scheduler-owned"),
+    Fixture(
+        # BackendHealth is NOT a dispatch root (no Engine/Autoscaler/
+        # Scaler/Reaper suffix): its lock-guarded circuit dict is its
+        # own to mutate from any request thread
+        "thread-affinity", "thread-affinity-emergency/near-miss",
+        "kubeflow_tpu/serving/_st_affinity_emergency.py",
+        """
+class BackendHealth:
+    def note_failure(self, backend):
+        self._waiting.append(backend)
+""",
+        0),
 )
 
 

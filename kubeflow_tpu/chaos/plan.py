@@ -57,6 +57,7 @@ class FaultKind(str, enum.Enum):
     SPILL_KILL = "spill_kill"        # process dies mid-spill-write
     TIER_IO_STALL = "tier_io_stall"  # storage-tier I/O wedges for a window
     AUTOSCALE_ACTUATOR_FAIL = "autoscale_actuator_fail"  # actuator dies
+    DOMAIN_OUTAGE = "domain_outage"  # failure domain dies at once
 
 
 @dataclass
@@ -267,6 +268,48 @@ class FaultPlan:
                         and t >= f.at):
                     f.fired = 1
                     out.append(f.index)
+        return out
+
+    def domain_outage(self, domains, at: Optional[float] = None,
+                      min_at: float = 0.2, max_at: float = 2.0,
+                      duration: float = 0.0) -> "FaultPlan":
+        """Correlated failure (ISSUE 16): one of ``domains`` (a list of
+        failure-domain names) dies WHOLE at a seeded offset — every
+        replica labeled with that domain stops at once, the
+        rack/zone-loss shape no single-replica fault exercises.  The
+        victim domain AND the outage time are frozen at plan-build
+        time (same seed = same domain dies at the same offset).  The
+        outage driver polls :meth:`due_domain_outages` from its
+        arrival loop and abruptly stops every replica of the named
+        domain.  ``duration > 0`` means the domain comes back after
+        the window (the driver restarts it); 0 = permanent for the
+        run.  Contract under test: the router's circuits open, the
+        domain's sessions/affinity/registry rows mass-forget in one
+        pass, retry amplification stays inside the budget, and the
+        surge path brings the fleet back under SLO."""
+        names = [str(d) for d in domains]
+        if not names:
+            raise ValueError("domain_outage needs at least one domain")
+        if at is None:
+            at = min_at + self.rng.random() * max(max_at - min_at, 0.0)
+        self.faults.append(Fault(
+            FaultKind.DOMAIN_OUTAGE,
+            node=names[self.rng.randrange(len(names))],
+            at=at, duration=duration))
+        return self
+
+    def due_domain_outages(self, now: Optional[float] = None) -> list[str]:
+        """Failure-domain names whose seeded outage is due (each fault
+        fires at most once) — the actuator poll for the outage driver,
+        mirroring :meth:`due_replica_kills`."""
+        t = self.elapsed(now)
+        out: list[str] = []
+        with self._lock:
+            for f in self.faults:
+                if (f.kind == FaultKind.DOMAIN_OUTAGE and not f.fired
+                        and t >= f.at):
+                    f.fired = 1
+                    out.append(f.node)
         return out
 
     def gang_member_loss(self, world: int, at: Optional[float] = None,

@@ -28,24 +28,29 @@ is NOT considered this tick — one action per tick is the anti-flap
 floor):
 
 1. ``wake``            — scaled to zero with demand pending
-2. ``scale_up``        — shed rate / queue wait / free-block famine
+2. ``scale_up``        — EMERGENCY surge (ISSUE 16): more than
+                         ``emergency_unhealthy_frac`` of the pool's
+                         health circuits open at once — bounded surge
+                         that may also bypass the placement cooldown,
+                         at most once per ``emergency_window_s``
+3. ``scale_up``        — shed rate / queue wait / free-block famine
                          (SLO pressure outranks the utilization bands)
-3. ``scale_up``        — forecast utilization above the high band
-4. ``resize_up``       — same deficit but replicas are at max: the
+4. ``scale_up``        — forecast utilization above the high band
+5. ``resize_up``       — same deficit but replicas are at max: the
                          bottleneck is per-replica throughput, so the
                          TP degree grows instead (Tenplex: parallelism
                          degree is a runtime variable)
-5. ``scale_to_zero``   — idle past the zero clock with a measured
+6. ``scale_to_zero``   — idle past the zero clock with a measured
                          cold-start budget that fits
-6. ``scale_down``      — forecast AND current utilization below the
+7. ``scale_down``      — forecast AND current utilization below the
                          low band (both: a forecast dip alone must not
                          shed capacity)
-7. ``resize_down``     — still below the low band at the replica floor
+8. ``resize_down``     — still below the low band at the replica floor
                          with a lower configured degree available
-8. ``tier_rebalance``  — prefill/decode pressure imbalance beyond the
+9. ``tier_rebalance``  — prefill/decode pressure imbalance beyond the
                          band (Podracer: chips are fungible across
                          roles)
-9. ``none``            — inside the hysteresis band
+10. ``none``           — inside the hysteresis band
 """
 
 from __future__ import annotations
@@ -81,6 +86,8 @@ _POLICY_KEYS = frozenset({
     "tp_degrees", "tier_band", "up_cooldown_s", "down_cooldown_s",
     "resize_cooldown_s", "tier_cooldown_s", "zero_cooldown_s",
     "max_retries", "backoff_s", "backoff_cap_s", "loop_s",
+    "emergency_unhealthy_frac", "emergency_surge",
+    "emergency_window_s", "thaw_concurrency",
 })
 
 
@@ -152,6 +159,27 @@ def validate_autoscale(spec) -> dict:
                 "list of ints >= 1")
     if "scale_to_zero" in out and not isinstance(out["scale_to_zero"], bool):
         raise ValueError("autoscale.scale_to_zero must be a bool")
+    _pos("emergency_window_s")
+    if "emergency_unhealthy_frac" in out:
+        try:
+            ok = 0.0 < float(out["emergency_unhealthy_frac"]) <= 1.0
+        except (TypeError, ValueError):
+            ok = False
+        if not ok:
+            raise ValueError(
+                "autoscale.emergency_unhealthy_frac "
+                f"{out['emergency_unhealthy_frac']!r} must be in (0, 1]")
+    if "emergency_surge" in out:
+        v = out["emergency_surge"]
+        if isinstance(v, bool) or not isinstance(v, int) or v < 1:
+            raise ValueError(
+                f"autoscale.emergency_surge {v!r} must be an int >= 1")
+    if "thaw_concurrency" in out:
+        v = out["thaw_concurrency"]
+        if isinstance(v, bool) or not isinstance(v, int) or v < 0:
+            raise ValueError(
+                f"autoscale.thaw_concurrency {v!r} must be an int >= 0 "
+                "(0 = uncapped)")
     return out
 
 
@@ -199,6 +227,19 @@ class AutoscalePolicy:
     backoff_cap_s: float = 30.0
     #: threaded-mode tick interval
     loop_s: float = 1.0
+    #: correlated-failure emergency mode (ISSUE 16): when more than
+    #: this fraction of the router's backends have non-closed health
+    #: circuits, ``decide`` fires a bounded surge scale-out that
+    #: outranks the utilization bands, and ``tick`` may bypass the
+    #: placement cooldown/backoff at most once per
+    #: ``emergency_window_s``
+    emergency_unhealthy_frac: float = 0.5
+    #: replicas added per emergency surge decision (capped at max)
+    emergency_surge: int = 1
+    emergency_window_s: float = 30.0
+    #: mass-recovery thaw cap: max concurrent ``thaw_sequence`` calls
+    #: per deployment (0 = uncapped, the pre-PR behavior)
+    thaw_concurrency: int = 0
 
     @classmethod
     def from_config(cls, spec: Optional[dict]) -> "AutoscalePolicy":
@@ -209,7 +250,8 @@ class AutoscalePolicy:
         for k, v in out.items():
             if k == "tp_degrees":
                 kw[k] = tuple(int(d) for d in v)
-            elif k in ("max_retries",):
+            elif k in ("max_retries", "emergency_surge",
+                       "thaw_concurrency"):
                 kw[k] = int(v)
             elif k == "scale_to_zero":
                 kw[k] = bool(v)
@@ -266,6 +308,39 @@ class TrendPredictor:
 
     def forecast(self, horizon_s: float) -> float:
         return self.level + self.slope * float(horizon_s)
+
+
+class ConcurrencyGate:
+    """Bounded-concurrency context manager for the mass-recovery
+    stampede paths (ISSUE 16): cold-start pre-warm and
+    hibernated-session thaw both arrive in herds after a domain
+    outage — the gate admits ``limit`` at a time and makes the rest
+    WAIT (refusal would just re-herd the retries).  Plain bounded
+    semaphore plus counters; safe to share across threads."""
+
+    def __init__(self, limit: int = 1):
+        self.limit = max(1, int(limit))
+        self._sem = threading.BoundedSemaphore(self.limit)
+        self._lock = threading.Lock()
+        self.entries_total = 0
+        self.waits_total = 0
+
+    def __enter__(self) -> "ConcurrencyGate":
+        if not self._sem.acquire(blocking=False):
+            with self._lock:
+                self.waits_total += 1
+            self._sem.acquire()
+        with self._lock:
+            self.entries_total += 1
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._sem.release()
+
+    def stats(self) -> dict:
+        return {"gate_limit": self.limit,
+                "gate_entries_total": self.entries_total,
+                "gate_waits_total": self.waits_total}
 
 
 class ActuatorState:
@@ -355,7 +430,8 @@ def decide(sig: Mapping, policy: AutoscalePolicy) -> Decision:
     ``util_forecast``, ``shed_rate``, ``queue_wait_s``,
     ``free_block_ratio``, ``idle_s``, ``live``, ``pending``,
     ``cold_start_s``, ``degree``, ``prefill_pressure``,
-    ``decode_pressure``, ``prefill_replicas``, ``decode_replicas``.
+    ``decode_pressure``, ``prefill_replicas``, ``decode_replicas``,
+    ``unhealthy_frac``.
     """
     n = int(_sig(sig, "replicas", 0))
     lo_n = max(int(_sig(sig, "min_replicas", 0)), 0)
@@ -372,7 +448,21 @@ def decide(sig: Mapping, policy: AutoscalePolicy) -> Decision:
                             replicas=max(floor, 1))
         return Decision("none", "scaled to zero, no demand")
 
-    # 2. SLO pressure outranks the utilization bands: a shed or a long
+    # 2. emergency surge (ISSUE 16): a correlated failure — more than
+    # emergency_unhealthy_frac of the pool's health circuits open at
+    # once — outranks every band and even the SLO rules: the fleet is
+    # not merely hot, it is GONE.  Surge is bounded (emergency_surge
+    # replicas, never past max) and the reason prefix is the contract
+    # ``tick`` keys its cooldown bypass off.
+    bad = _sig(sig, "unhealthy_frac", 0.0)
+    if bad > policy.emergency_unhealthy_frac and n < hi_n:
+        return Decision(
+            "scale_up",
+            f"emergency: {bad:.0%} of backends unhealthy",
+            replicas=min(hi_n,
+                         n + max(int(policy.emergency_surge), 1)))
+
+    # 3. SLO pressure outranks the utilization bands: a shed or a long
     # queue wait is a miss already happening, not a forecast
     shed = _sig(sig, "shed_rate", 0.0)
     qwait = _sig(sig, "queue_wait_s", 0.0)
@@ -389,7 +479,7 @@ def decide(sig: Mapping, policy: AutoscalePolicy) -> Decision:
                             f"free-block ratio {free:.3g}",
                             replicas=n + 1)
 
-    # 3/4. the high band: forecast says the fleet will run hot.  With
+    # 4/5. the high band: forecast says the fleet will run hot.  With
     # replica headroom, add concurrency; at max replicas the deficit is
     # per-replica throughput — grow the TP degree instead.
     if fc > policy.high_band:
@@ -404,7 +494,7 @@ def decide(sig: Mapping, policy: AutoscalePolicy) -> Decision:
                             f"at max replicas, forecast util {fc:.3g}",
                             degree=bigger[0])
 
-    # 5. scale-to-zero: idle past the clock, nothing live, and the
+    # 6. scale-to-zero: idle past the clock, nothing live, and the
     # measured cold start fits the budget (an unmeasured cold start
     # counts as 0 — the first zero is how the budget gets measured,
     # and the activator path bounds the damage)
@@ -416,7 +506,7 @@ def decide(sig: Mapping, policy: AutoscalePolicy) -> Decision:
             <= policy.cold_start_budget_s):
         return Decision("scale_to_zero", f"idle {idle:.3g}s", replicas=0)
 
-    # 6/7. the low band: BOTH current and forecast utilization must sit
+    # 7/8. the low band: BOTH current and forecast utilization must sit
     # below it (a dip in the forecast alone must not shed capacity —
     # that asymmetry is deliberate: adding capacity early is cheap,
     # removing it early sheds SLO)
@@ -435,7 +525,7 @@ def decide(sig: Mapping, policy: AutoscalePolicy) -> Decision:
                             f"at replica floor, util {util:.3g}",
                             degree=smaller[-1])
 
-    # 8. tier rebalance: prefill vs decode pressure imbalance beyond the
+    # 9. tier rebalance: prefill vs decode pressure imbalance beyond the
     # band, with a spare engine on the fat side
     pp = _sig(sig, "prefill_pressure", 0.0)
     dp = _sig(sig, "decode_pressure", 0.0)
@@ -509,6 +599,10 @@ class ClusterAutoscaler:
         self.actuator_skips_total = 0
         self.sensor_errors_total = 0
         self.ticks_total = 0
+        #: emergency cooldown bypass (ISSUE 16): at most one per
+        #: ``emergency_window_s`` — the clock that bounds it
+        self._last_emergency_bypass = float("-inf")
+        self.emergency_bypass_total = 0
         #: EWMA of measured cold starts (scale-up fire -> replica ready)
         self.cold_start_s = 0.0
         self._cold_n = 0
@@ -563,7 +657,20 @@ class ClusterAutoscaler:
         chan = dec.actuator
         assert chan is not None
         state = self.states[chan]
-        if not state.ready(now):
+        ready = state.ready(now)
+        if (not ready and not state.parked
+                and dec.reason.startswith("emergency")
+                and now - self._last_emergency_bypass
+                >= self.policy.emergency_window_s):
+            # correlated-failure surge may jump the placement
+            # cooldown/backoff — but never a PARKED channel (the
+            # bounded-retry contract holds even in an emergency), and
+            # at most once per emergency window so a flapping health
+            # sensor cannot turn the bypass into unlimited fire
+            self._last_emergency_bypass = now
+            self.emergency_bypass_total += 1
+            ready = True
+        if not ready:
             self.actuator_skips_total += 1
             why = ("parked after bounded retries" if state.parked
                    else "backoff" if now < state.blocked_until
@@ -636,6 +743,8 @@ class ClusterAutoscaler:
                 self.actuator_failures_total,
             "autoscale_actuator_skips_total": self.actuator_skips_total,
             "autoscale_sensor_errors_total": self.sensor_errors_total,
+            "autoscale_emergency_bypass_total":
+                self.emergency_bypass_total,
             "autoscale_cold_start_s": round(self.cold_start_s, 4),
             "decisions": dict(self.decisions_total),
         }

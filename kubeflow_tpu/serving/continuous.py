@@ -3151,7 +3151,12 @@ class ContinuousEngine:
                       req: Optional[Request] = None,
                       timeout: float = 60.0) -> tuple[Request, dict]:
         """Resume a hibernated session from the storage tier (any
-        replica sharing the store).  Returns ``(req, info)``:
+        replica sharing the store).  When the controller attached a
+        ``thaw_gate`` (``autoscale.thaw_concurrency``, ISSUE 16) the
+        thaw waits its turn there first — a domain outage thaws its
+        dead half's sessions in a herd, and an uncapped herd of
+        import_sequence scatters would starve live decode.  Returns
+        ``(req, info)``:
 
         - verified payload -> ``import_sequence`` scatters the spilled
           blocks and decoding resumes at the exact position,
@@ -3165,6 +3170,17 @@ class ContinuousEngine:
         ``info["tokens"]`` carries the tokens generated BEFORE
         hibernation (the session transcript the API handle already
         delivered).  The spill entry is consumed on success."""
+        gate = getattr(self, "thaw_gate", None)
+        if gate is not None:
+            with gate:
+                return self._thaw_sequence_gated(
+                    session_id, store, req, timeout)
+        return self._thaw_sequence_gated(session_id, store, req, timeout)
+
+    def _thaw_sequence_gated(self, session_id: str, store=None,
+                             req: Optional[Request] = None,
+                             timeout: float = 60.0
+                             ) -> tuple[Request, dict]:
         store = store or self.spill_store
         if store is None:
             raise RuntimeError("no spill store attached "

@@ -21,6 +21,8 @@ from __future__ import annotations
 import importlib
 import json
 import logging
+import math
+import random
 import threading
 import time
 import urllib.error
@@ -276,6 +278,19 @@ class Router:
         #: per-backend counters: url -> {requests, errors, inflight}
         self._backend_stats: dict[str, dict[str, int]] = {}
         self.no_backend_total = 0
+        # correlated-failure survival (ISSUE 16): per-backend health
+        # circuits + the cluster retry budget.  Always active — with
+        # ``domains`` unset every backend sits in one implicit domain
+        # and only the circuit/budget behavior applies.
+        from .traffic import BackendHealth, RetryBudget
+
+        self.health = BackendHealth()
+        self.retry_budget = RetryBudget()
+        #: url -> failure-domain label (empty = single implicit domain)
+        self._domains: dict[str, str] = {}
+        #: domains currently declared down (mass-forget fired once)
+        self._domains_down: set[str] = set()
+        self.domain_outages_total = 0
         router = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -475,11 +490,19 @@ class Router:
                         with urllib.request.urlopen(req, timeout=60) as resp:
                             payload = resp.read()
                             router._note(backend, delta=-1)
+                            router._backend_up(backend)
                             self._respond(resp.status, payload)
                             return
                     except urllib.error.HTTPError as e:
                         router._note(backend, delta=-1,
                                      error=e.code >= 500)
+                        # circuit evidence: a 5xx is an erroring-but-
+                        # alive replica (error-rate trip); anything
+                        # else (429 shed, 4xx) proves it answers
+                        if e.code >= 500:
+                            router.health.note_failure(backend)
+                        else:
+                            router._backend_up(backend)
                         self._respond(e.code, e.read(),
                                       retry_after=e.headers.get(
                                           "Retry-After"))
@@ -500,16 +523,40 @@ class Router:
                             return
                         router._backend_down(backend)
                         tried.add(backend)
+                        # the cluster retry budget (ISSUE 16): N dying
+                        # replicas must not multiply a 2x storm into a
+                        # 2(1+retries)x storm — past the budget, the
+                        # client gets the jittered 503 below instead
+                        # of another forwarded attempt
+                        if not router.retry_budget.try_retry():
+                            backend = None
+                            break
+                        # spread the re-route across SURVIVING domains
+                        # with a small jittered backoff: the recovery
+                        # herd from a domain-sized outage arrives at
+                        # the survivors de-synchronized, not as a wave
+                        time.sleep(random.uniform(0.01, 0.05))
+                        avoid = {router.domain_of(u) for u in tried
+                                 if router.domain_of(u)}
                         backend = router._pick(explain, keys,
                                                exclude=tried,
-                                               session=session)
+                                               session=session,
+                                               avoid_domains=avoid)
                 router.no_backend_total += 1
+                from .traffic import jittered_retry_after
+
+                # jittered, load-aware Retry-After (ISSUE 16
+                # satellite): the more circuits are open, the longer
+                # and more spread out the herd's retry horizon
+                ra = jittered_retry_after(
+                    1.0, load=len(router.health.open_backends()))
                 self._respond(
                     503, json.dumps({
                         "error": "no ready replicas",
                         "reason": "no_ready_replicas",
-                        "retry_after": 1,
-                    }).encode(), retry_after="1")
+                        "retry_after": round(ra, 3),
+                    }).encode(),
+                    retry_after=str(max(1, math.ceil(ra))))
 
             def _respond(self, code: int, body: bytes,
                          retry_after: Optional[str] = None) -> None:
@@ -587,11 +634,81 @@ class Router:
                 st["errors"] += 1
 
     def _backend_down(self, backend: str) -> None:
+        # feed the health circuit FIRST (ISSUE 16): enough consecutive
+        # connection failures open it and routing skips the corpse
+        # until a jittered half-open probe proves it back — before the
+        # circuit existed this forgot the backend's affinity but kept
+        # routing connect attempts at it until membership churn
+        self.health.note_failure(backend)
         if self.traffic is not None:
             self.traffic.affinity.forget(backend)
             # its hibernated/live sessions' KV died with it: resumes
             # re-route and thaw from the shared storage tier instead
             self.traffic.sessions.forget(backend)
+        self._check_domain_outage(self.domain_of(backend))
+
+    def _backend_up(self, backend: str) -> None:
+        """One successful forward: recovery evidence for the circuit,
+        a deposit into the cluster retry budget, and — if its domain
+        was declared down — the all-clear for the domain."""
+        self.health.note_success(backend)
+        self.retry_budget.note_success()
+        d = self.domain_of(backend)
+        if d and d in self._domains_down:
+            self._domains_down.discard(d)
+
+    def domain_of(self, backend: str) -> str:
+        """Failure-domain label for ``backend`` ('' = the single
+        implicit domain when ``domains`` is unconfigured)."""
+        return self._domains.get(backend, "")
+
+    def set_domains(self, mapping: dict[str, str]) -> None:
+        """Install the url -> failure-domain map (the controller's
+        ``_wire`` keeps it in lockstep with the pools).  Domains whose
+        members all churned away stop being tracked as down."""
+        self._domains = dict(mapping or {})
+        self._domains_down &= set(self._domains.values())
+
+    def backends(self) -> list[str]:
+        """Flat live data-plane backend list (pool order)."""
+        with self._lock:
+            return [u for us, _w in self._pools for u in us]
+
+    def _check_domain_outage(self, domain: str) -> None:
+        """Declare ``domain`` down when EVERY one of its live backends
+        has an open circuit while another domain still serves, and
+        mass-forget its sessions/affinity/registry rows in ONE pass —
+        the herd of resumes then routes straight to survivors instead
+        of each request rediscovering the outage one dead connect at
+        a time.  Fires once per outage (re-armed by the first
+        successful forward into the domain, or membership churn)."""
+        if not domain or domain in self._domains_down:
+            return
+        members = [u for u in self.backends()
+                   if self._domains.get(u, "") == domain]
+        others = [u for u in self.backends()
+                  if self._domains.get(u, "") != domain]
+        # "another domain still serves" means a survivor with a
+        # non-open circuit — a TOTAL collapse is not a domain outage
+        # (mass-forgetting with nobody to re-route toward just throws
+        # away the warm-resume state the comeback would want)
+        if not members or not any(
+                self.health.state(u) != "open" for u in others):
+            return
+        if any(self.health.state(u) != "open" for u in members):
+            return
+        self._domains_down.add(domain)
+        self.domain_outages_total += 1
+        for u in members:
+            # trip is idempotent; the forgets are the mass action
+            self.health.trip(u)
+            if self.traffic is not None:
+                self.traffic.affinity.forget(u)
+                self.traffic.sessions.forget(u)
+            if self.prefix_poller is not None:
+                self.prefix_poller.registry.forget(u)
+        log.warning("failure domain %r declared down "
+                    "(%d backends, circuits open)", domain, len(members))
 
     def _inflight(self, backend: str) -> int:
         with self._lock:
@@ -619,6 +736,24 @@ class Router:
                     f'{{backend="{prom_label(b)}"}} {st[fam]}')
         lines.append("# TYPE kft_router_no_backend_total gauge")
         lines.append(f"kft_router_no_backend_total {self.no_backend_total}")
+        # correlated-failure survival gauges (ISSUE 16): circuit
+        # states per backend, trip/close/probe counters, the cluster
+        # retry budget, and declared domain outages
+        lines.append("# TYPE kft_router_circuit_open gauge")
+        for b in sorted(self.backends()):
+            state = self.health.state(b)
+            lines.append(
+                f'kft_router_circuit_open{{backend="{prom_label(b)}",'
+                f'domain="{prom_label(self.domain_of(b))}"}} '
+                f"{1 if state != 'closed' else 0}")
+        from .traffic import prom_stat_lines as _psl
+
+        fams = _psl({**self.health.stats(), **self.retry_budget.stats(),
+                     "domain_outages_total": self.domain_outages_total},
+                    "kft_router_")
+        for fam in sorted(fams):
+            lines.append(f"# TYPE {fam} gauge")
+            lines.extend(fams[fam])
         if self.traffic is not None:
             from .traffic import prom_stat_lines
 
@@ -708,6 +843,10 @@ class Router:
         # the affinity map has its own)
         for u in gone:
             self._backend_down(u)
+            # membership churn, not a failure: the circuit record and
+            # domain label die with the URL (ports never come back)
+            self.health.forget(u)
+            self._domains.pop(u, None)
 
     def set_explain_backends(self, urls: list[str]) -> None:
         """Backends for the ``:explain`` verb (KServe routes the verb to the
@@ -734,10 +873,13 @@ class Router:
                 self._backend_stats.pop(u, None)
         for u in gone:
             self._backend_down(u)
+            self.health.forget(u)
+            self._domains.pop(u, None)
 
     def _pick(self, explain: bool = False, keys: Optional[list] = None,
               exclude: Optional[set] = None,
-              session: Optional[str] = None) -> Optional[str]:
+              session: Optional[str] = None,
+              avoid_domains: Optional[set] = None) -> Optional[str]:
         with self._lock:
             use_explain = explain and self._explain_pools
             pools = self._explain_pools if use_explain else self._pools
@@ -756,27 +898,47 @@ class Router:
                 if cur[i] > cur[best]:
                     best = i
             cur[best] -= total
-            pool = pools[best][0]
-            if exclude:
-                pool = [u for u in pool if u not in exclude]
+
+            def live(urls: list) -> list:
+                # circuit filter (ISSUE 16): skip open circuits — a
+                # pure filter; arming a half-open probe happens below
+                # on the ONE backend actually picked
+                out = [u for u in urls
+                       if not exclude or u not in exclude]
+                out = self.health.routable(out)
+                if avoid_domains and out:
+                    # re-route spreading: prefer SURVIVING domains
+                    # over the one that just failed; only when at
+                    # least one such candidate exists (with domains
+                    # unset every url maps to '' and this no-ops)
+                    spread = [u for u in out
+                              if self._domains.get(u, "")
+                              not in avoid_domains]
+                    if spread:
+                        out = spread
+                return out
+
+            pool = live(pools[best][0])
+            if not pool:
+                # crash-retry/circuits emptied the WRR-chosen pool:
+                # any OTHER pool's live backend beats a 503 — a canary
+                # split must not turn one stable-replica crash into
+                # "no ready replicas" while the canary serves
+                for us, _w in pools:
+                    pool = live(us)
+                    if pool:
+                        break
                 if not pool:
-                    # crash-retry emptied the WRR-chosen pool: any
-                    # OTHER pool's live backend beats a 503 — a canary
-                    # split must not turn one stable-replica crash
-                    # into "no ready replicas" while the canary serves
-                    for us, _w in pools:
-                        pool = [u for u in us if u not in exclude]
-                        if pool:
-                            break
-                    if not pool:
-                        return None
+                    return None
             plane = self.traffic
             if plane is None or not (keys or session):
                 # round-robin WITHIN the chosen pool, cursor per pool — a
                 # shared cursor lets a 1-backend pool reset it and starve
                 # backends of the other pool during a canary split
                 rrs[best] = (rrs[best] + 1) % len(pool)
-                return pool[rrs[best]]
+                choice = pool[rrs[best]]
+                self.health.on_routed(choice)
+                return choice
         # session/prefix-affinity pick (outside the WRR lock: the plane
         # has its own): a durable session resumes at the replica still
         # holding its KV (ISSUE 12); otherwise the replica already
@@ -786,6 +948,7 @@ class Router:
         backend, _depth = plane.route(keys or [], pool,
                                       load=self._inflight,
                                       session=session)
+        self.health.on_routed(backend)
         return backend
 
     def stop(self) -> None:
@@ -848,6 +1011,12 @@ class _Deployment:
         self.autoscaler = None
         self.autoscale_fp: Optional[str] = None
         self.autoscale_desired: Optional[int] = None
+        #: mass-recovery thaw cap (ISSUE 16): one shared
+        #: ConcurrencyGate attached to every engine's ``thaw_gate``
+        #: when the policy sets ``thaw_concurrency`` > 0, so a dead
+        #: domain's hibernated sessions re-materialize a few at a time
+        #: instead of starving live decode
+        self.thaw_gate = None
         #: wake-from-zero cold-start clock: stamped when the loop fires
         #: a placement at n=0, closed when the fleet reports ready —
         #: the measured budget scale-to-zero is held to
@@ -871,6 +1040,12 @@ class InferenceServiceController(Controller):
         super().__init__(store)
         self._deployments: dict[str, _Deployment] = {}
         self._lock = threading.Lock()
+        # cold-start concurrency gate (ISSUE 16): serialize the
+        # pre-warm/compile path so emergency grow-back after a domain
+        # outage cannot stampede N simultaneous census+install sweeps
+        # through one warm peer
+        from .autoscale import ConcurrencyGate
+        self._prewarm_gate = ConcurrencyGate(1)
 
     def stop(self) -> None:
         super().stop()
@@ -974,6 +1149,26 @@ class InferenceServiceController(Controller):
             raise ValueError(
                 f"invalid engine knobs: affinity_block {ab} (must be "
                 ">= 1)")
+        # failure-domain knobs (ISSUE 16) freeze here too: `domains`
+        # maps domain name -> stripe weight (replicas are placed
+        # round-robin across the weighted stripe, so spread is the
+        # default); a mistyped map is ONE Failed status at conf-freeze,
+        # not a router mis-labeling backends at the first outage
+        doms = cfg.get("domains")
+        if doms is not None:
+            if not isinstance(doms, dict) or not doms:
+                raise ValueError(
+                    "invalid engine knobs: domains must be a non-empty "
+                    "mapping of domain name -> stripe weight")
+            for k, v in doms.items():
+                if not isinstance(k, str) or not k:
+                    raise ValueError(
+                        "invalid engine knobs: domains keys must be "
+                        f"non-empty strings (got {k!r})")
+                if isinstance(v, bool) or not isinstance(v, int) or v < 1:
+                    raise ValueError(
+                        f"invalid engine knobs: domains[{k!r}] {v!r} "
+                        "(stripe weight must be an int >= 1)")
         # hierarchical KV / durable-session knobs (ISSUE 12) freeze
         # here too — the PR 4/7/8 convention: a mistyped tier config is
         # ONE Failed status, not a replica exploding at load
@@ -1393,6 +1588,45 @@ class InferenceServiceController(Controller):
             return max(n - 1, floor)
         return max(n, floor)
 
+    @staticmethod
+    def _domain_stripe(doms: dict) -> list[str]:
+        """Expand the ``domains`` name -> stripe-weight map into an
+        INTERLEAVED placement stripe (smooth WRR over sorted names, the
+        router's own algorithm) — ``{"a": 2, "b": 1}`` yields
+        ``[a, b, a]``, not ``[a, a, b]``, so the first two replicas
+        land in different domains and spread is the default, not an
+        afterthought."""
+        names = sorted(doms)
+        weights = {n: max(1, int(doms[n])) for n in names}
+        total = sum(weights.values())
+        cur = {n: 0 for n in names}
+        stripe: list[str] = []
+        for _ in range(total):
+            for n in names:
+                cur[n] += weights[n]
+            best = max(names, key=lambda n: cur[n])
+            cur[best] -= total
+            stripe.append(best)
+        return stripe
+
+    def _assign_domain(self, rev: _Revision) -> str:
+        """Failure domain for the NEXT replica of ``rev``: the least-
+        filled domain relative to its stripe weight (ties: stripe
+        order) — a replica placed after an outage grows back into the
+        emptied domain first.  '' when ``domains`` is unconfigured
+        (the single implicit domain)."""
+        doms = rev.cfg.get("domains")
+        if not isinstance(doms, dict) or not doms:
+            return ""
+        stripe = self._domain_stripe(doms)
+        counts = {d: 0 for d in stripe}
+        for s in rev.predictors:
+            d = getattr(s, "domain", "")
+            if d in counts:
+                counts[d] += 1
+        return min(stripe, key=lambda d: (counts[d] / stripe.count(d),
+                                          stripe.index(d)))
+
     def _scale_predictors(
         self, isvc, dep: _Deployment, rev: _Revision, desired: int
     ) -> bool:
@@ -1404,6 +1638,7 @@ class InferenceServiceController(Controller):
                 handle = _GangPredictor(
                     self.store, isvc, rev.rev, gang, rev.cfg,
                     ordinal=rev.gang_counter - 1)
+                handle.domain = self._assign_domain(rev)
                 rev.predictors.append(handle)
                 self.emit_event(
                     isvc, "GangPlaced",
@@ -1435,6 +1670,7 @@ class InferenceServiceController(Controller):
                     pred.logger.url, pred.logger.mode,
                     service=isvc.metadata.name)
             server.start()
+            server.domain = self._assign_domain(rev)
             rev.predictors.append(server)
             self.emit_event(
                 isvc, "ReplicaStarted", f"rev {rev.rev} {server.url}")
@@ -1604,6 +1840,18 @@ class InferenceServiceController(Controller):
             explain_pools.append((canary_explain, dep.pct))
         dep.router.set_weighted_backends(pools)
         dep.router.set_weighted_explain_backends(explain_pools)
+        # failure-domain labels ride the same wiring pass (ISSUE 16):
+        # the router's outage detection and re-route spreading key off
+        # this map; with ``domains`` unset it stays empty and the
+        # router behaves exactly as before (single implicit domain)
+        mapping: dict[str, str] = {}
+        for r in dep.revisions:
+            for s in r.predictors:
+                d = getattr(s, "domain", "")
+                u = getattr(s, "url", None)
+                if d and u:
+                    mapping[u] = d
+        dep.router.set_domains(mapping)
 
     def _sync_traffic(self, dep: _Deployment) -> None:
         """Keep the router's traffic plane (ISSUE 9) in sync with the
@@ -1731,6 +1979,19 @@ class InferenceServiceController(Controller):
                 actuators=self._autoscale_actuators(isvc, dep))
             dep.autoscale_fp = fp
             dep.autoscale_desired = None
+            from .autoscale import ConcurrencyGate
+            dep.thaw_gate = (
+                ConcurrencyGate(int(policy.thaw_concurrency))
+                if policy.thaw_concurrency > 0 else None)
+        # attach the thaw cap to every live engine each pass — engines
+        # churn with replica placement, the gate survives via dep
+        if dep.thaw_gate is not None and dep.stable is not None:
+            for s in dep.stable.predictors:
+                engines = getattr(s, "engines", None)
+                if engines is None:
+                    continue
+                for eng in engines().values():
+                    eng.thaw_gate = dep.thaw_gate
         dec = dep.autoscaler.tick()
         if dec.action != "none":
             self.emit_event(
@@ -1801,6 +2062,17 @@ class InferenceServiceController(Controller):
         if dep.router is not None and dep.router.last_request_time:
             idle_s = max(0.0, time.time()
                          - dep.router.last_request_time)
+        # Correlated-failure sensor (ISSUE 16): fraction of the
+        # router's backend pool whose health circuit is not closed.
+        # Feeds the emergency surge rule in ``autoscale.decide`` —
+        # absent circuits (no router yet) read as a healthy 0.0.
+        unhealthy = 0.0
+        if dep.router is not None:
+            urls = dep.router.backends()
+            if urls:
+                bad = sum(1 for u in urls
+                          if dep.router.health.state(u) != "closed")
+                unhealthy = bad / len(urls)
         return {
             "replicas": n,
             "min_replicas": spec.min_replicas if spec else 0,
@@ -1818,6 +2090,7 @@ class InferenceServiceController(Controller):
             "decode_pressure": dp,
             "prefill_replicas": pn,
             "decode_replicas": dn,
+            "unhealthy_frac": unhealthy,
         }
 
     def _autoscale_actuators(self, isvc, dep: _Deployment) -> dict:
@@ -1877,13 +2150,36 @@ class InferenceServiceController(Controller):
         replica sits LAST — ``_scale_predictors`` pops from the tail,
         so the victim is the replica whose retirement invalidates the
         least cluster KV reuse (poller prefix census) and migrates the
-        fewest live conversations."""
+        fewest live conversations.  Domain-spread guard (ISSUE 16):
+        a candidate whose retirement would EMPTY its failure domain
+        while another domain still holds >= 2 replicas is excluded —
+        scale-down must never trade away the last replica of a domain
+        the placement stripe deliberately spread into.  With
+        ``domains`` unset every replica maps to the implicit ""
+        domain and the guard is a no-op."""
         preds = rev.predictors
         if len(preds) < 2:
             return
         poller = (dep.router.prefix_poller
                   if dep.router is not None else None)
         heat = poller.heat_by_backend() if poller is not None else {}
+
+        counts: dict[str, int] = {}
+        for s in preds:
+            counts[getattr(s, "domain", "")] = counts.get(
+                getattr(s, "domain", ""), 0) + 1
+
+        def allowed(s) -> bool:
+            d = getattr(s, "domain", "")
+            if counts.get(d, 0) > 1:
+                return True
+            # removing s empties domain d — only allowed when no OTHER
+            # domain would keep >= 2 replicas (i.e. spread is already
+            # as thin as it can be)
+            return not any(c >= 2 for dd, c in counts.items()
+                           if dd != d)
+
+        candidates = [s for s in preds if allowed(s)] or preds
 
         def score(s) -> tuple:
             h = int(heat.get(getattr(s, "url", ""), 0))
@@ -1897,7 +2193,7 @@ class InferenceServiceController(Controller):
                         pass
             return (h, live)
 
-        victim = min(preds, key=score)
+        victim = min(candidates, key=score)
         if preds[-1] is not victim:
             preds.remove(victim)
             preds.append(victim)
@@ -1917,30 +2213,32 @@ class InferenceServiceController(Controller):
                  if s is not server and getattr(s, "ready", True)
                  and getattr(s, "engines", None) is not None]
         installed = 0
-        for name, eng in engines().items():
-            if not getattr(eng, "paged", False):
-                continue
-            for peer in peers:
-                src = peer.engines().get(name)
-                if src is None or not getattr(src, "paged", False):
+        with self._prewarm_gate:
+            for name, eng in engines().items():
+                if not getattr(eng, "paged", False):
                     continue
-                try:
-                    census = src.prefix_census(timeout=10.0)
-                except (RuntimeError, TimeoutError):
-                    continue
-                # deepest records first; cap the copy budget so warm-up
-                # can never stall the reconcile pass behind a huge pool
-                census = sorted(census, key=len, reverse=True)[:8]
-                for toks in census:
+                for peer in peers:
+                    src = peer.engines().get(name)
+                    if src is None or not getattr(src, "paged", False):
+                        continue
                     try:
-                        covered, blocks = src.export_prefix_blocks(
-                            [int(t) for t in toks], timeout=10.0)
-                        if covered and blocks and eng.install_prefix(
-                                covered, blocks, timeout=10.0):
-                            installed += 1
+                        census = src.prefix_census(timeout=10.0)
                     except (RuntimeError, TimeoutError):
-                        break
-                break  # one warm peer per engine is enough
+                        continue
+                    # deepest records first; cap the copy budget so
+                    # warm-up can never stall the reconcile pass
+                    # behind a huge pool
+                    census = sorted(census, key=len, reverse=True)[:8]
+                    for toks in census:
+                        try:
+                            covered, blocks = src.export_prefix_blocks(
+                                [int(t) for t in toks], timeout=10.0)
+                            if covered and blocks and eng.install_prefix(
+                                    covered, blocks, timeout=10.0):
+                                installed += 1
+                        except (RuntimeError, TimeoutError):
+                            break
+                    break  # one warm peer per engine is enough
         if installed:
             self.emit_event(
                 isvc, "ReplicaPrewarmed",

@@ -390,6 +390,268 @@ class KvBlockRegistry:
         }
 
 
+class BackendHealth:
+    """Per-backend health circuit (ISSUE 16): closed -> open ->
+    half-open -> closed.
+
+    Before this existed, ``Router._backend_down`` forgot a backend's
+    affinity forever and kept ROUTING to it until membership churn
+    removed the URL — every request burned a connect attempt on the
+    corpse.  The circuit makes death a first-class, RECOVERABLE state:
+
+    - **closed**: traffic flows; failures are counted (consecutive +
+      a sliding error-rate window).
+    - **open**: ``fail_threshold`` consecutive failures (or the window
+      error rate crossing ``error_rate``) trips the circuit; routing
+      skips the backend until a JITTERED recovery deadline (jitter so
+      N routers probing one recovering replica don't arrive as a
+      synchronized wave).
+    - **half-open**: past the deadline, exactly ONE live request is
+      allowed through as the recovery probe (``on_routed`` arms it);
+      success closes the circuit, failure re-opens it with doubled
+      backoff up to ``open_cap_s``.
+
+    Selection is two-phase so an unpicked candidate never strands a
+    probe: ``routable(candidates)`` is a pure filter (no side
+    effects), and the router calls ``on_routed(choice)`` on the ONE
+    backend it actually forwards to.  All state sits under one lock;
+    every caller is a router/HTTP worker thread."""
+
+    CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
+
+    def __init__(self, fail_threshold: int = 3, error_rate: float = 0.5,
+                 window: int = 20, open_s: float = 1.0,
+                 open_cap_s: float = 30.0, probe_jitter: float = 0.5):
+        if int(fail_threshold) < 1:
+            raise ValueError("fail_threshold must be >= 1")
+        if not (0.0 < float(error_rate) <= 1.0):
+            raise ValueError("error_rate must be in (0, 1]")
+        if float(open_s) <= 0 or float(open_cap_s) < float(open_s):
+            raise ValueError("need 0 < open_s <= open_cap_s")
+        self.fail_threshold = int(fail_threshold)
+        self.error_rate = float(error_rate)
+        self.window = max(2, int(window))
+        self.open_s = float(open_s)
+        self.open_cap_s = float(open_cap_s)
+        self.probe_jitter = max(0.0, float(probe_jitter))
+        #: url -> mutable record (state machine per backend)
+        self._circuits: dict[str, dict] = {}
+        self._lock = threading.Lock()
+        self.opens_total = 0
+        self.closes_total = 0
+        self.probes_total = 0
+
+    def _rec(self, backend: str) -> dict:
+        rec = self._circuits.get(backend)
+        if rec is None:
+            rec = self._circuits[backend] = {
+                "state": self.CLOSED, "consec": 0,
+                "outcomes": collections.deque(maxlen=self.window),
+                "reopen_at": 0.0, "open_for": self.open_s,
+                "probe_inflight": False,
+            }
+        return rec
+
+    def _trip(self, rec: dict, now: float) -> None:
+        import random
+
+        rec["state"] = self.OPEN
+        rec["probe_inflight"] = False
+        rec["reopen_at"] = now + rec["open_for"] * (
+            1.0 + random.random() * self.probe_jitter)
+        self.opens_total += 1
+
+    def note_failure(self, backend: str) -> None:
+        now = time.monotonic()
+        with self._lock:
+            rec = self._rec(backend)
+            rec["consec"] += 1
+            rec["outcomes"].append(False)
+            if rec["state"] == self.HALF_OPEN:
+                # failed probe: re-open with doubled backoff — a
+                # replica mid-restart must not eat a probe per open_s
+                rec["open_for"] = min(rec["open_for"] * 2.0,
+                                      self.open_cap_s)
+                self._trip(rec, now)
+                return
+            if rec["state"] != self.CLOSED:
+                return
+            outcomes = rec["outcomes"]
+            rate_hot = (len(outcomes) >= self.window
+                        and outcomes.count(False) / len(outcomes)
+                        >= self.error_rate)
+            if rec["consec"] >= self.fail_threshold or rate_hot:
+                rec["open_for"] = self.open_s
+                self._trip(rec, now)
+
+    def note_success(self, backend: str) -> None:
+        with self._lock:
+            rec = self._circuits.get(backend)
+            if rec is None:
+                return
+            rec["consec"] = 0
+            rec["outcomes"].append(True)
+            if rec["state"] != self.CLOSED:
+                # a successful probe (or an in-flight request that
+                # outlived the trip) is recovery evidence either way
+                rec["state"] = self.CLOSED
+                rec["open_for"] = self.open_s
+                rec["probe_inflight"] = False
+                self.closes_total += 1
+
+    def trip(self, backend: str) -> None:
+        """Force-open one circuit NOW (the domain-outage mass action:
+        when a whole domain is declared down, its other members must
+        not each burn ``fail_threshold`` connect attempts first)."""
+        now = time.monotonic()
+        with self._lock:
+            rec = self._rec(backend)
+            if rec["state"] != self.OPEN:
+                rec["open_for"] = self.open_s
+                self._trip(rec, now)
+
+    def forget(self, backend: str) -> None:
+        """Membership churn removed the URL — ports never come back,
+        so the record must die with it (unbounded growth otherwise)."""
+        with self._lock:
+            self._circuits.pop(backend, None)
+
+    def state(self, backend: str) -> str:
+        with self._lock:
+            rec = self._circuits.get(backend)
+            return rec["state"] if rec else self.CLOSED
+
+    def routable(self, candidates) -> list:
+        """Pure filter: the candidates traffic may reach this instant —
+        closed circuits, plus open ones whose jittered recovery
+        deadline has passed and half-open ones with no probe already
+        in flight.  No side effects: arming the probe is
+        :meth:`on_routed`'s job, on the ONE candidate actually
+        picked."""
+        now = time.monotonic()
+        out = []
+        with self._lock:
+            for b in candidates:
+                rec = self._circuits.get(b)
+                if rec is None or rec["state"] == self.CLOSED:
+                    out.append(b)
+                elif rec["probe_inflight"]:
+                    continue  # one probe at a time
+                elif rec["state"] == self.HALF_OPEN or now >= rec["reopen_at"]:
+                    out.append(b)
+        return out
+
+    def on_routed(self, backend: str) -> None:
+        """The router picked ``backend``: if its circuit is non-closed
+        this request IS the recovery probe — arm it (one at a time)."""
+        with self._lock:
+            rec = self._circuits.get(backend)
+            if rec is None or rec["state"] == self.CLOSED:
+                return
+            rec["state"] = self.HALF_OPEN
+            rec["probe_inflight"] = True
+            self.probes_total += 1
+
+    def open_backends(self) -> list[str]:
+        with self._lock:
+            return [b for b, rec in self._circuits.items()
+                    if rec["state"] == self.OPEN]
+
+    def stats(self) -> dict:
+        with self._lock:
+            states = [rec["state"] for rec in self._circuits.values()]
+        return {
+            "circuit_open_backends": states.count(self.OPEN),
+            "circuit_half_open_backends": states.count(self.HALF_OPEN),
+            "circuit_opens_total": self.opens_total,
+            "circuit_closes_total": self.closes_total,
+            "circuit_probes_total": self.probes_total,
+        }
+
+
+class RetryBudget:
+    """Cluster retry budget (ISSUE 16): re-routes are permitted as a
+    CAPPED FRACTION of recent successes, token-bucket style.
+
+    The amplification bound the outage bench pins: N dying replicas
+    under a 2x open-loop storm must not multiply into a
+    2(1+retries)x storm — with the budget, total forwarded attempts
+    stay <= (1 + ratio) * successes (plus the small ``floor_rate``
+    trickle that keeps single-failure failover alive when the cluster
+    is quiet and the success-funded bucket is empty).
+
+    ``note_success`` deposits ``ratio`` tokens (capped at ``burst``);
+    ``try_retry`` spends one, falling back to the floor bucket, and
+    returns False when the budget is exhausted — the router then
+    answers 503 with a jittered ``Retry-After`` instead of amplifying
+    the storm."""
+
+    def __init__(self, ratio: float = 0.2, burst: float = 5.0,
+                 floor_rate: float = 0.5):
+        if float(ratio) < 0:
+            raise ValueError("ratio must be >= 0")
+        if float(burst) < 1:
+            raise ValueError("burst must be >= 1")
+        self.ratio = float(ratio)
+        self.burst = float(burst)
+        #: start full: the first failure after a quiet period must be
+        #: allowed to fail over without waiting for successes
+        self._tokens = self.burst
+        self._floor = TokenBucket(max(0.0, float(floor_rate)),
+                                  burst=1.0)
+        self._lock = threading.Lock()
+        self.retries_granted_total = 0
+        self.retries_denied_total = 0
+
+    def note_success(self) -> None:
+        if self.ratio <= 0:
+            return
+        with self._lock:
+            self._tokens = min(self.burst, self._tokens + self.ratio)
+
+    def try_retry(self) -> bool:
+        with self._lock:
+            if self._tokens >= 1.0:
+                self._tokens -= 1.0
+                self.retries_granted_total += 1
+                return True
+        if self._floor.rate > 0 and self._floor.try_take() == 0.0:
+            with self._lock:
+                self.retries_granted_total += 1
+            return True
+        with self._lock:
+            self.retries_denied_total += 1
+        return False
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "retry_budget_tokens": round(self._tokens, 3),
+                "retries_granted_total": self.retries_granted_total,
+                "retries_denied_total": self.retries_denied_total,
+            }
+
+
+def jittered_retry_after(base: float = 1.0, load: float = 0.0,
+                         spread: float = 0.5, cap: float = 30.0) -> float:
+    """The ONE retry-after hint: a load-aware base, JITTERED so shed /
+    503'd clients do not re-arrive as a synchronized wave (the
+    constant ``retry_after=1`` at the router's no-ready-replicas path
+    meant every client of a dead domain retried in lockstep —
+    herd-safe recovery needs the herd spread out).  Uniform in
+    ``[hint*(1-spread), hint*(1+spread)]`` where ``hint = base +
+    load``, clamped to ``[0.05, cap]``.  Both the plane's concurrency
+    shed ETA and the router's 503 ride this helper — one responder,
+    no drifting copies (the PR 8 ``shed_http`` lesson)."""
+    import random
+
+    hint = min(float(cap), max(0.05, float(base) + float(load)))
+    spread = max(0.0, min(float(spread), 1.0))
+    lo = hint * (1.0 - spread)
+    hi = hint * (1.0 + spread)
+    return min(float(cap), max(0.05, lo + random.random() * (hi - lo)))
+
+
 class ClusterPrefixPoller:
     """Router-side block-registry poller (ISSUE 13 satellite, the r16
     residual): scrape every live replica's ``/metrics``
@@ -421,7 +683,15 @@ class ClusterPrefixPoller:
         self._heat: "collections.OrderedDict[str, dict[str, int]]" = \
             collections.OrderedDict()
         self._lock = threading.Lock()
+        #: unreachable-backend backoff (ISSUE 16 satellite): url ->
+        #: (skip-until monotonic deadline, consecutive failures).
+        #: During a domain outage the sweep used to burn a full scrape
+        #: timeout per dead backend per cycle; now a dead backend is
+        #: skipped with per-backend jittered exponential backoff and
+        #: re-probed cheaply once its deadline passes.
+        self._unreachable: dict[str, tuple[float, int]] = {}
         self.polls_total = 0
+        self.poll_skips_total = 0
         self._stop = threading.Event()
         self._thread = threading.Thread(
             target=self._loop, name="prefix-poller", daemon=True)
@@ -450,19 +720,47 @@ class ClusterPrefixPoller:
         import re
         import urllib.request
 
+        import random
+
         self.polls_total += 1
         urls = list(self._backends() or [])
+        now = time.monotonic()
+        with self._lock:
+            # membership churn prunes the backoff table with the pool
+            self._unreachable = {
+                u: v for u, v in self._unreachable.items() if u in urls}
+            skipping = {u for u, (until, _n) in self._unreachable.items()
+                        if now < until}
         seen: dict[str, dict[str, int]] = {}
         reached: set[str] = set()
         rows_total = 0
         for url in urls:
+            if url in skipping:
+                # unreachable last sweep(s): inside its jittered
+                # backoff window — do NOT burn a scrape timeout on it
+                self.poll_skips_total += 1
+                continue
             try:
                 with urllib.request.urlopen(
                         url.rstrip("/") + "/metrics", timeout=2.0) as r:
                     text = r.read().decode()
             except (OSError, ValueError):
-                continue  # timed out / down: keep its prior entries
+                # timed out / down: keep its prior entries, back off
+                # exponentially (jittered so N routers re-probe a
+                # recovering replica spread out, not as one wave)
+                with self._lock:
+                    _until, fails = self._unreachable.get(url, (0.0, 0))
+                    fails += 1
+                    delay = min(self.interval_s * (2.0 ** (fails - 1)),
+                                8.0 * self.interval_s)
+                    delay *= 1.0 + random.uniform(-self.jitter,
+                                                  self.jitter)
+                    self._unreachable[url] = (time.monotonic() + delay,
+                                              fails)
+                continue
             reached.add(url)
+            with self._lock:
+                self._unreachable.pop(url, None)
             rows_total += self.registry.observe_metrics(url, text)
             for key_hex, depth in re.findall(
                     r'^kft_kv_prefix_key\{[^}]*key="([0-9a-f]+)"'
@@ -865,9 +1163,11 @@ class TrafficPlane:
 
     def _slot_eta(self, st: _ClassState) -> float:
         """Honest-ish Retry-After for a concurrency shed: with no
-        completion-rate estimate, 1s per queued-ahead requester is a
-        bounded hint, never a promise."""
-        return min(30.0, 1.0 + st.waiting)
+        completion-rate estimate, ~1s per queued-ahead requester is a
+        bounded hint, never a promise — JITTERED through the shared
+        helper so shed clients of one hot class do not re-arrive in a
+        synchronized wave (ISSUE 16 satellite)."""
+        return jittered_retry_after(1.0, load=st.waiting)
 
     def release(self, ticket: _Ticket) -> None:
         if not ticket.ok or ticket.cls is None:

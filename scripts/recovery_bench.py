@@ -454,6 +454,176 @@ def run_resize_trial(i: int, conversations: int) -> dict:
         (new if new is not None else src).stop()
 
 
+class _StubReplica:
+    """A minimal always-answers backend for the outage row: the row
+    measures ROUTING recovery (circuits, retry budget, mass-forget),
+    so the data plane is a constant-latency JSON responder — no jax,
+    no model, trials stay sub-second."""
+
+    def __init__(self, latency_s: float = 0.005):
+        import threading
+        from http.server import (
+            BaseHTTPRequestHandler,
+            ThreadingHTTPServer,
+        )
+
+        stub = self
+
+        class H(BaseHTTPRequestHandler):
+            def do_POST(self):
+                self.rfile.read(
+                    int(self.headers.get("Content-Length", 0) or 0))
+                time.sleep(latency_s)
+                stub.requests += 1
+                body = b'{"choices": [{"text": "ok"}]}'
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        self.requests = 0
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        self._httpd.daemon_threads = True
+        self.url = f"http://127.0.0.1:{self._httpd.server_address[1]}"
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5)
+
+
+def run_outage_trial(i: int, seed: int, per_domain: int = 2,
+                     storm_s: float = 3.0, workers: int = 8) -> dict:
+    """One seeded domain outage mid open-loop storm (ISSUE 16): two
+    failure domains of ``per_domain`` stub replicas behind the real
+    Router, a 2x storm, and ``FaultPlan.domain_outage`` kills every
+    replica of the seeded victim domain at once.  Scored:
+
+    - ``reroute_s``        outage -> first 200 served by a survivor
+    - ``slo_recovery_s``   outage -> 10 consecutive requests all 200
+                           under the latency SLO (back under SLO)
+    - ``retry_amplification``  (client requests + granted retries) /
+                           client requests — the budget contract caps
+                           it at 1 + ratio (+ the burst transient)
+    - ``hung``             requests that never completed (must be 0)
+    """
+    import threading
+    import urllib.error
+    import urllib.request
+
+    from kubeflow_tpu.chaos import FaultPlan
+    from kubeflow_tpu.serving.controller import Router
+    from kubeflow_tpu.serving.traffic import TrafficPlane
+
+    domains = ("d0", "d1")
+    stubs = {d: [_StubReplica() for _ in range(per_domain)]
+             for d in domains}
+    router = Router(activate=lambda: None)
+    router.set_backends([s.url for d in domains for s in stubs[d]])
+    router.set_traffic(TrafficPlane({}))
+    router.set_domains({s.url: d for d in domains for s in stubs[d]})
+    plan = FaultPlan(seed=seed + i).domain_outage(
+        list(domains), min_at=0.3, max_at=0.6)
+    plan.activate()
+    url = router.url + "/openai/v1/completions"
+    body = json.dumps({"model": "m", "prompt": "storm",
+                       "max_tokens": 2}).encode()
+    records: list = []
+    rec_lock = threading.Lock()
+    outage = {"t": None, "domain": None}
+    stop_evt = threading.Event()
+    slo_s = 0.75
+
+    def actuate():
+        for d in plan.due_domain_outages():
+            outage["t"] = time.perf_counter()
+            outage["domain"] = d
+            for s in stubs[d]:
+                s.stop()
+
+    def storm():
+        while not stop_evt.is_set():
+            if outage["t"] is None:
+                actuate()
+            t0 = time.perf_counter()
+            try:
+                req = urllib.request.Request(
+                    url, data=body,
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(req, timeout=20) as r:
+                    r.read()
+                    code = r.status
+            except urllib.error.HTTPError as e:
+                e.read()
+                code = e.code
+            except OSError:
+                code = 0  # timeout/conn failure = a hang candidate
+            with rec_lock:
+                records.append((t0, time.perf_counter(), code))
+            time.sleep(0.002)
+
+    threads = [threading.Thread(target=storm, daemon=True)
+               for _ in range(workers)]
+    t_start = time.perf_counter()
+    for t in threads:
+        t.start()
+    while time.perf_counter() - t_start < storm_s:
+        time.sleep(0.01)
+    stop_evt.set()
+    hung = 0
+    for t in threads:
+        t.join(timeout=30)
+        hung += 1 if t.is_alive() else 0
+    try:
+        assert outage["t"] is not None, "seeded outage never fired"
+        out_t = outage["t"]
+        after = sorted([r for r in records if r[0] >= out_t])
+        ok_after = [r for r in after if r[2] == 200]
+        reroute = (ok_after[0][1] - out_t) if ok_after else None
+        slo_recovery = None
+        run = 0
+        for r in after:
+            run = run + 1 if (r[2] == 200
+                              and r[1] - r[0] <= slo_s) else 0
+            if run >= 10:
+                slo_recovery = r[1] - out_t
+                break
+        rb = router.retry_budget.stats()
+        n = len(records)
+        amp = (n + rb["retries_granted_total"]) / max(n, 1)
+        return {
+            "reroute_s": reroute,
+            "slo_recovery_s": slo_recovery,
+            "retry_amplification": round(amp, 4),
+            "retries_granted": rb["retries_granted_total"],
+            "retries_denied": rb["retries_denied_total"],
+            "requests": n,
+            "failed_after_outage": sum(
+                1 for r in after if r[2] != 200),
+            "hung": hung,
+            "circuit_opens": router.health.stats()[
+                "circuit_opens_total"],
+            "domain_outages_detected": router.domain_outages_total,
+        }
+    finally:
+        router.stop()
+        for d in domains:
+            for s in stubs[d]:
+                try:
+                    s.stop()
+                except Exception:  # noqa: BLE001 — the victim domain's
+                    # stubs are already stopped by the actuator; a
+                    # double-shutdown OSError here is the expected case
+                    pass
+
+
 def main() -> None:
     trials = int(sys.argv[1]) if len(sys.argv) > 1 else 12
     workers = int(sys.argv[2]) if len(sys.argv) > 2 else 4
@@ -574,7 +744,7 @@ def main() -> None:
         phase_p50[key] = round(vals[len(vals) // 2], 3)
     per_count = {
         str(c): _percentiles([r["gang_resize_s"] for r in resize_rows
-                              if r["conversations"] == c])["p50"]
+                              if r["conversations"] == c])["value"]
         for c in (2, 6)}
     print(json.dumps({
         "metric": "gang_resize_p50_seconds",
@@ -585,6 +755,39 @@ def main() -> None:
         "phase_p50": phase_p50,
         "p50_by_conversations": per_count,
         "recompiles_total": sum(r["recompiles"] for r in resize_rows),
+    }))
+
+    # seeded domain outage mid storm (ISSUE 16): circuits + retry
+    # budget + mass-forget — time-to-reroute, retry amplification,
+    # time-back-under-SLO
+    outage_trials = max(3, trials // 3)
+    outage_rows = []
+    for i in range(outage_trials):
+        row = run_outage_trial(i, seed)
+        outage_rows.append(row)
+        print("# domain-outage trial", i, json.dumps({
+            k: (round(v, 3) if isinstance(v, float) else v)
+            for k, v in row.items()}), file=sys.stderr)
+    reroutes = [r["reroute_s"] for r in outage_rows
+                if r["reroute_s"] is not None]
+    slo_recoveries = [r["slo_recovery_s"] for r in outage_rows
+                      if r["slo_recovery_s"] is not None]
+    print(json.dumps({
+        "metric": "domain_outage_reroute_p50_seconds",
+        "unit": (f"s (seeded whole-domain kill mid 2x storm -> first "
+                 f"survivor 200; n={outage_trials}, 2 domains x 2 "
+                 "stub replicas, real Router circuits + retry "
+                 "budget)"),
+        **_percentiles(reroutes or [0.0]),
+        "slo_recovery_p50_s": (round(sorted(slo_recoveries)[
+            len(slo_recoveries) // 2], 3) if slo_recoveries else None),
+        "retry_amplification_max": max(
+            r["retry_amplification"] for r in outage_rows),
+        "retries_denied_total": sum(
+            r["retries_denied"] for r in outage_rows),
+        "hung_total": sum(r["hung"] for r in outage_rows),
+        "domain_outages_detected": sum(
+            r["domain_outages_detected"] for r in outage_rows),
     }))
 
 

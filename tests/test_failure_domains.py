@@ -1,0 +1,919 @@
+"""Correlated-failure survival (ISSUE 16).
+
+Layers, matching the tentpole:
+
+- HEALTH CIRCUITS: per-backend closed -> open -> half-open state
+  machine (consecutive + error-rate trips, jittered recovery, single
+  probe, doubled backoff on a failed probe);
+- RETRY BUDGET: re-routes as a capped fraction of recent successes —
+  the amplification bound the outage bench pins;
+- JITTERED RETRY-AFTER: the one shared load-aware hint both the shed
+  path and the router's 503 ride;
+- FAILURE DOMAINS: the router's url -> domain map, the one-pass
+  mass-forget when a whole domain dies, the scale-down victim guard
+  that never empties a domain, and conf-freeze validation of the
+  ``domains`` knob;
+- EMERGENCY AUTOSCALE: the decide() surge row, the tick() cooldown
+  bypass (bounded, never past a parked channel), and the
+  ConcurrencyGate the cold-start/thaw stampede paths share;
+- CHAOS: ``FaultPlan.domain_outage`` is seeded at plan build and fires
+  exactly once;
+- MASS RECOVERY: hibernated sessions thaw on a survivor exactly once
+  (spill entry consumed, zero recompiles, ledger clean), with the
+  thaw gate serializing the herd.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from types import SimpleNamespace
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from kubeflow_tpu.analysis.runtime import BlockLedger
+from kubeflow_tpu.models import llama as llamalib
+from kubeflow_tpu.serving.continuous import ContinuousEngine
+from kubeflow_tpu.serving.storage import KvSpillStore, SpillCorrupt
+from kubeflow_tpu.serving.traffic import (
+    BackendHealth,
+    ClusterPrefixPoller,
+    RetryBudget,
+    TrafficPlane,
+    jittered_retry_after,
+)
+
+
+@pytest.fixture(scope="module")
+def tiny_llama():
+    cfg = llamalib.tiny()
+    model = llamalib.Llama(cfg)
+    params = model.init(jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32))
+    return cfg, params["params"]
+
+
+LONG = list(range(1, 65))  # 64 tokens = 4 blocks at block_size 16
+
+
+def make_engine(tiny_llama, **kw):
+    cfg, params = tiny_llama
+    kw.setdefault("num_slots", 4)
+    kw.setdefault("decode_chunk", 2)
+    kw.setdefault("prefix_cache", False)
+    kw.setdefault("block_size", 16)
+    eng = ContinuousEngine(cfg, params, **kw)
+    eng.attach_block_ledger(BlockLedger())
+    return eng
+
+
+def assert_no_leaks(*engines):
+    for eng in engines:
+        assert eng.audit_blocks() == []
+        assert eng.stats()["kv_blocks_leaked_total"] == 0
+        assert eng.block_ledger.conservation_errors == []
+
+
+def post(url: str, payload: dict, headers=None, timeout: float = 60.0):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, dict(r.headers), json.loads(r.read() or b"{}")
+    except urllib.error.HTTPError as e:
+        try:
+            body = json.loads(e.read() or b"{}")
+        except ValueError:
+            body = {}
+        return e.code, dict(e.headers), body
+
+
+class _Stub:
+    """Constant-latency JSON responder: the routing-layer tests measure
+    circuits / budget / mass-forget, so the data plane is a stub — no
+    jax, sub-second tests.  GET /metrics serves optional prefix rows so
+    the poller tests can scrape it."""
+
+    def __init__(self, metrics_text: str = ""):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        stub = self
+
+        class H(BaseHTTPRequestHandler):
+            def do_POST(self):
+                self.rfile.read(
+                    int(self.headers.get("Content-Length", 0) or 0))
+                stub.requests += 1
+                body = b'{"choices": [{"text": "ok"}]}'
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                body = stub.metrics_text.encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "text/plain")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        self.requests = 0
+        self.metrics_text = metrics_text
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        self._httpd.daemon_threads = True
+        self.url = f"http://127.0.0.1:{self._httpd.server_address[1]}"
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5)
+
+
+def _dead_url() -> str:
+    """A URL nothing listens on (bind, grab the port, close)."""
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return f"http://127.0.0.1:{port}"
+
+
+# -- health circuits ------------------------------------------------------
+
+
+class TestBackendHealth:
+    def test_consecutive_failures_trip_and_success_closes(self):
+        h = BackendHealth(fail_threshold=3, open_s=0.05, probe_jitter=0.0)
+        b = "http://b0"
+        for _ in range(2):
+            h.note_failure(b)
+        assert h.state(b) == "closed"
+        assert h.routable([b]) == [b]
+        h.note_failure(b)
+        assert h.state(b) == "open"
+        assert h.routable([b]) == []
+        assert h.open_backends() == [b]
+        # past the recovery deadline the backend is routable again;
+        # a success (the probe's outcome) closes the circuit
+        time.sleep(0.06)
+        assert h.routable([b]) == [b]
+        h.note_success(b)
+        assert h.state(b) == "closed"
+        st = h.stats()
+        assert st["circuit_opens_total"] == 1
+        assert st["circuit_closes_total"] == 1
+
+    def test_error_rate_trips_without_consecutive(self):
+        h = BackendHealth(fail_threshold=100, error_rate=0.5, window=4)
+        b = "http://b0"
+        # alternate failure/success: consecutive never reaches 100 but
+        # the 4-wide window eventually hits 2/4 = 50% failures
+        h.note_failure(b)
+        h.note_success(b)
+        h.note_failure(b)
+        h.note_success(b)
+        assert h.state(b) == "closed"
+        h.note_failure(b)
+        assert h.state(b) == "open"
+
+    def test_half_open_single_probe_and_doubled_backoff(self):
+        h = BackendHealth(fail_threshold=1, open_s=0.05, open_cap_s=10.0,
+                          probe_jitter=0.0)
+        b = "http://b0"
+        h.note_failure(b)
+        assert h.state(b) == "open"
+        time.sleep(0.06)
+        # two-phase: routable() is a pure filter (no probe armed yet),
+        # on_routed() arms exactly one probe
+        assert h.routable([b]) == [b]
+        assert h.routable([b]) == [b]
+        h.on_routed(b)
+        assert h.state(b) == "half_open"
+        assert h.routable([b]) == []  # one probe at a time
+        # a failed probe re-opens with DOUBLED backoff: the old 0.05s
+        # deadline is not enough anymore
+        h.note_failure(b)
+        assert h.state(b) == "open"
+        time.sleep(0.06)
+        assert h.routable([b]) == []
+        time.sleep(0.06)
+        assert h.routable([b]) == [b]
+        assert h.stats()["circuit_probes_total"] == 1
+
+    def test_trip_forces_open_and_forget_resets(self):
+        h = BackendHealth()
+        b = "http://b0"
+        h.trip(b)
+        assert h.state(b) == "open"
+        h.forget(b)
+        assert h.state(b) == "closed"
+        assert h.routable([b]) == [b]
+
+    def test_unknown_backend_is_closed_and_routable(self):
+        h = BackendHealth()
+        assert h.state("http://never-seen") == "closed"
+        assert h.routable(["http://never-seen"]) == ["http://never-seen"]
+
+    @pytest.mark.parametrize("kw", [
+        {"fail_threshold": 0},
+        {"error_rate": 0.0},
+        {"error_rate": 1.5},
+        {"open_s": 0.0},
+        {"open_s": 2.0, "open_cap_s": 1.0},
+    ])
+    def test_bad_knobs_rejected(self, kw):
+        with pytest.raises(ValueError):
+            BackendHealth(**kw)
+
+
+class TestRetryBudget:
+    def test_burst_then_deny_and_success_refunds(self):
+        # floor disabled: the success-funded bucket is the whole budget
+        rb = RetryBudget(ratio=0.2, burst=3.0, floor_rate=0.0)
+        assert [rb.try_retry() for _ in range(3)] == [True] * 3
+        assert rb.try_retry() is False
+        # 5 successes at ratio 0.2 fund exactly one more retry
+        for _ in range(5):
+            rb.note_success()
+        assert rb.try_retry() is True
+        assert rb.try_retry() is False
+        st = rb.stats()
+        assert st["retries_granted_total"] == 4
+        assert st["retries_denied_total"] == 2
+
+    def test_floor_keeps_single_failover_alive(self):
+        rb = RetryBudget(ratio=0.0, burst=1.0, floor_rate=1000.0)
+        assert rb.try_retry() is True   # the burst token
+        assert rb.try_retry() is True   # the floor trickle
+        assert rb.stats()["retries_denied_total"] == 0
+
+    @pytest.mark.parametrize("kw", [{"ratio": -0.1}, {"burst": 0.5}])
+    def test_bad_knobs_rejected(self, kw):
+        with pytest.raises(ValueError):
+            RetryBudget(**kw)
+
+
+class TestJitteredRetryAfter:
+    def test_bounds_and_spread(self):
+        xs = [jittered_retry_after(base=1.0, spread=0.5)
+              for _ in range(200)]
+        assert all(0.5 <= x <= 1.5 for x in xs)
+        assert max(xs) - min(xs) > 0.1  # it actually jitters
+
+    def test_load_raises_hint_and_cap_clamps(self):
+        assert jittered_retry_after(base=1.0, load=10.0, spread=0.0) \
+            == pytest.approx(11.0)
+        assert jittered_retry_after(base=1.0, load=100.0) <= 30.0
+        assert jittered_retry_after(base=0.0, load=0.0, spread=0.0) \
+            == pytest.approx(0.05)
+
+
+# -- poller backoff (satellite) -------------------------------------------
+
+
+class TestPollerBackoff:
+    def test_unreachable_backend_skipped_with_backoff(self):
+        dead = _dead_url()
+        poller = ClusterPrefixPoller(lambda: [dead], interval_s=3600.0)
+        try:
+            poller.poll_once()   # connect refused: enters backoff
+            assert poller.poll_skips_total == 0
+            poller.poll_once()   # inside the (hours-long) window: skip
+            assert poller.poll_skips_total == 1
+            poller.poll_once()
+            assert poller.poll_skips_total == 2
+        finally:
+            poller.stop()
+
+    def test_reachable_backend_clears_backoff_and_keeps_heat(self):
+        key = "ab" * 8
+        stub = _Stub(metrics_text=(
+            "# TYPE kft_kv_prefix_key gauge\n"
+            f'kft_kv_prefix_key{{model="m",key="{key}"}} 2\n'))
+        poller = ClusterPrefixPoller(lambda: [stub.url],
+                                     interval_s=0.01, jitter=0.0)
+        try:
+            poller.poll_once()
+            assert poller.heat() == {key: 1}
+            stub.stop()
+            # the scrape fails now; prior heat survives (one flaky
+            # scrape must not flap the census down), url backs off
+            poller.poll_once()
+            assert poller.heat() == {key: 1}
+            time.sleep(0.05)  # past the tiny first backoff window
+            poller.poll_once()  # re-probe (fails again, doubled delay)
+            assert poller.heat() == {key: 1}
+        finally:
+            poller.stop()
+
+
+# -- failure domains on the router ----------------------------------------
+
+
+class TestRouterDomains:
+    def _router(self, urls, domains):
+        from kubeflow_tpu.serving.controller import Router
+
+        router = Router(activate=lambda: None)
+        router.set_backends(urls)
+        router.set_traffic(TrafficPlane({}))
+        router.set_domains(domains)
+        return router
+
+    def test_domain_outage_mass_forget_fires_once(self):
+        urls = ["http://d0-a", "http://d0-b", "http://d1-a", "http://d1-b"]
+        doms = {urls[0]: "d0", urls[1]: "d0",
+                urls[2]: "d1", urls[3]: "d1"}
+        router = self._router(urls, doms)
+        try:
+            # seed affinity + session state pointing at d0
+            router.traffic.affinity.observe([101, 102], urls[0])
+            router.traffic.sessions.observe("conv-1", urls[1])
+            router.traffic.sessions.observe("conv-2", urls[2])
+            # d0-a's circuit opens: no outage yet (d0-b still closed)
+            for _ in range(3):
+                router._backend_down(urls[0])
+            assert router.domain_outages_total == 0
+            assert router.traffic.sessions.best(
+                "conv-1", urls) == urls[1]
+            # d0-b opens too -> the WHOLE domain is down: one-pass
+            # mass-forget of its affinity/session rows
+            for _ in range(3):
+                router._backend_down(urls[1])
+            assert router.domain_outages_total == 1
+            assert router.traffic.sessions.best("conv-1", urls) is None
+            assert router.traffic.affinity.best(
+                [101, 102], urls) == (None, 0)
+            # the survivor domain's rows are untouched
+            assert router.traffic.sessions.best(
+                "conv-2", urls) == urls[2]
+            # fires ONCE: more failures on the dead domain do not
+            # re-declare it
+            router._backend_down(urls[0])
+            assert router.domain_outages_total == 1
+            # a successful forward into d0 is the all-clear (re-arms)
+            router._backend_up(urls[0])
+            assert "d0" not in router._domains_down
+        finally:
+            router.stop()
+
+    def test_total_collapse_declares_only_the_first_domain(self):
+        # d0 dies while d1 serves: a domain outage.  Then d1 dies too:
+        # total collapse, NOT a second domain outage — there is no
+        # survivor left to mass-forget toward
+        urls = ["http://d0-a", "http://d1-a"]
+        router = self._router(
+            urls, {urls[0]: "d0", urls[1]: "d1"})
+        try:
+            for _ in range(3):
+                router._backend_down(urls[0])
+            assert router.domain_outages_total == 1
+            for _ in range(3):
+                router._backend_down(urls[1])
+            assert router.domain_outages_total == 1
+        finally:
+            router.stop()
+
+    def test_implicit_single_domain_never_declares_outage(self):
+        # domains unset: every url maps to "" and the outage machinery
+        # stays inert — the pre-PR behavior contract
+        urls = ["http://a", "http://b"]
+        router = self._router(urls, {})
+        try:
+            for u in urls:
+                for _ in range(3):
+                    router._backend_down(u)
+            assert router.domain_outages_total == 0
+            assert router.domain_of(urls[0]) == ""
+        finally:
+            router.stop()
+
+    def test_metrics_export_circuit_and_outage_rows(self):
+        urls = ["http://d0-a", "http://d0-b", "http://d1-a"]
+        doms = {urls[0]: "d0", urls[1]: "d0", urls[2]: "d1"}
+        router = self._router(urls, doms)
+        try:
+            for u in urls[:2]:
+                for _ in range(3):
+                    router._backend_down(u)
+            text = router.metrics_text()
+            assert "# TYPE kft_router_circuit_open gauge" in text
+            assert ('kft_router_circuit_open{backend="http://d0-a",'
+                    'domain="d0"} 1') in text
+            assert ('kft_router_circuit_open{backend="http://d1-a",'
+                    'domain="d1"} 0') in text
+            assert "kft_router_domain_outages_total 1" in text
+            assert "kft_router_circuit_opens_total" in text
+            assert "kft_router_retry_budget_tokens" in text
+        finally:
+            router.stop()
+
+    def test_storm_reroutes_to_survivor_and_declares_outage(self):
+        """End to end over real sockets: kill one domain's only
+        backend mid-traffic — every request still resolves 200 via the
+        survivor (the in-request re-route), the corpse's circuit opens
+        and the domain is declared down."""
+        stubs = {"d0": _Stub(), "d1": _Stub()}
+        urls = [stubs["d0"].url, stubs["d1"].url]
+        router = self._router(
+            urls, {stubs[d].url: d for d in stubs})
+        t0 = time.perf_counter()
+        try:
+            url = router.url + "/openai/v1/completions"
+            body = {"model": "m", "prompt": "x", "max_tokens": 2}
+            code, _, _ = post(url, body, timeout=30)
+            assert code == 200
+            stubs["d0"].stop()  # the whole d0 domain dies at once
+            codes = [post(url, body, timeout=30)[0] for _ in range(12)]
+            # zero hung, zero failed: every arrival re-routes inside
+            # its own request (budget-granted) or routes clean
+            assert codes == [200] * 12, codes
+            assert router.health.state(stubs["d0"].url) == "open"
+            assert router.domain_outages_total == 1
+            assert stubs["d1"].requests >= 12
+            assert router.retry_budget.stats()[
+                "retries_denied_total"] == 0
+            # completion-time bound: the whole recovery storm resolved
+            # promptly (no hidden timeout-and-retry stalls)
+            assert time.perf_counter() - t0 < 30.0
+        finally:
+            router.stop()
+            for s in stubs.values():
+                try:
+                    s.stop()
+                except Exception:  # noqa: BLE001 — d0's stub is already
+                    # stopped by the test body; double-shutdown is fine
+                    pass
+
+
+# -- scale-down domain guard ----------------------------------------------
+
+
+class TestScaleDownDomainGuard:
+    def _order(self, preds):
+        from kubeflow_tpu.serving.controller import (
+            InferenceServiceController,
+        )
+
+        dep = SimpleNamespace(router=None)
+        rev = SimpleNamespace(predictors=preds)
+        InferenceServiceController._order_scale_down_victim(
+            None, dep, rev)
+        return rev.predictors
+
+    @staticmethod
+    def _pred(url, domain):
+        return SimpleNamespace(url=url, domain=domain, engines=None)
+
+    def test_never_empties_a_domain_while_another_holds_two(self):
+        preds = [self._pred("u1", "a"), self._pred("u2", "a"),
+                 self._pred("u3", "b")]
+        ordered = self._order(list(preds))
+        # u3 is b's LAST replica while a holds 2: the victim (tail)
+        # must come from a
+        assert ordered[-1].domain == "a"
+
+    def test_thin_spread_allows_emptying(self):
+        # one replica per domain: the spread is as thin as it can be,
+        # any victim is allowed (scale-down must still make progress)
+        preds = [self._pred("u1", "a"), self._pred("u2", "b")]
+        ordered = self._order(list(preds))
+        assert len(ordered) == 2
+
+    def test_unset_domains_guard_is_noop(self):
+        preds = [self._pred("u1", ""), self._pred("u2", ""),
+                 self._pred("u3", "")]
+        ordered = self._order(list(preds))
+        # all candidates allowed; zero heat everywhere -> the stable
+        # min picks the first, exactly the pre-PR ordering
+        assert ordered[-1].url == "u1"
+
+
+# -- emergency autoscale --------------------------------------------------
+
+
+class TestEmergencyDecide:
+    def _policy(self, **kw):
+        from kubeflow_tpu.serving.autoscale import AutoscalePolicy
+
+        return AutoscalePolicy(**kw)
+
+    def _sig(self, **kw):
+        # util 0.8 sits inside the [0.5, 1.25) hysteresis band so the
+        # only live question is the emergency rule
+        base = {"replicas": 2, "min_replicas": 1, "max_replicas": 4,
+                "util": 0.8}
+        base.update(kw)
+        return base
+
+    def test_surge_fires_above_threshold(self):
+        from kubeflow_tpu.serving.autoscale import decide
+
+        dec = decide(self._sig(unhealthy_frac=0.6), self._policy())
+        assert dec.action == "scale_up"
+        assert dec.reason.startswith("emergency")
+        assert dec.replicas == 3
+
+    def test_surge_bounded_by_max_replicas(self):
+        from kubeflow_tpu.serving.autoscale import decide
+
+        dec = decide(self._sig(unhealthy_frac=1.0),
+                     self._policy(emergency_surge=10))
+        assert dec.action == "scale_up"
+        assert dec.replicas == 4
+        # already at max: nothing to surge into
+        dec = decide(self._sig(replicas=4, unhealthy_frac=1.0),
+                     self._policy())
+        assert dec.action == "none"
+
+    def test_below_threshold_and_absent_signal_are_inert(self):
+        from kubeflow_tpu.serving.autoscale import decide
+
+        assert decide(self._sig(unhealthy_frac=0.5),
+                      self._policy()).action == "none"
+        # absent signal: bit-identical to the pre-PR decision table
+        assert decide(self._sig(), self._policy()).action == "none"
+
+    @pytest.mark.parametrize("bad,needle", [
+        ({"emergency_unhealthy_frac": 0.0}, "emergency_unhealthy_frac"),
+        ({"emergency_unhealthy_frac": 1.5}, "emergency_unhealthy_frac"),
+        ({"emergency_surge": 0}, "emergency_surge"),
+        ({"emergency_surge": True}, "emergency_surge"),
+        ({"emergency_window_s": -1}, "emergency_window_s"),
+        ({"thaw_concurrency": -1}, "thaw_concurrency"),
+        ({"thaw_concurrency": True}, "thaw_concurrency"),
+    ])
+    def test_bad_knobs_rejected_at_validation(self, bad, needle):
+        from kubeflow_tpu.serving.autoscale import validate_autoscale
+
+        with pytest.raises(ValueError, match=needle):
+            validate_autoscale(bad)
+
+
+class TestEmergencyTick:
+    def _scaler(self, fired, *, fail=False, **pol):
+        from kubeflow_tpu.serving.autoscale import (
+            AutoscalePolicy,
+            ClusterAutoscaler,
+        )
+
+        pol.setdefault("up_cooldown_s", 100.0)
+        pol.setdefault("emergency_window_s", 50.0)
+        sig = {"replicas": 2, "min_replicas": 1, "max_replicas": 8,
+               "util": 0.8, "unhealthy_frac": 0.75}
+
+        def act(dec):
+            if fail:
+                raise RuntimeError("actuator down")
+            fired.append(dec)
+
+        return ClusterAutoscaler(
+            AutoscalePolicy(**pol), sensors=lambda: dict(sig),
+            actuators={"replica_up": act})
+
+    def test_bypass_jumps_cooldown_once_per_window(self):
+        fired = []
+        sc = self._scaler(fired)
+        dec = sc.tick(now=0.0)
+        assert dec.action == "scale_up"      # cold channel: no bypass
+        assert sc.emergency_bypass_total == 0
+        dec = sc.tick(now=1.0)               # inside the 100s cooldown
+        assert dec.action == "scale_up"      # ...but the bypass fires
+        assert sc.emergency_bypass_total == 1
+        dec = sc.tick(now=2.0)               # inside the 50s window:
+        assert dec.action == "none"          # gated, no second bypass
+        assert "cooldown" in dec.reason
+        assert sc.emergency_bypass_total == 1
+        dec = sc.tick(now=60.0)              # window elapsed
+        assert dec.action == "scale_up"
+        assert sc.emergency_bypass_total == 2
+        assert len(fired) == 3
+
+    def test_bypass_never_jumps_a_parked_channel(self):
+        fired = []
+        sc = self._scaler(fired, fail=True, max_retries=1)
+        sc.tick(now=0.0)                     # fails -> parked
+        assert sc.states["replica_up"].parked
+        dec = sc.tick(now=200.0)             # emergency still on
+        assert dec.action == "none"
+        assert "parked" in dec.reason
+        assert sc.emergency_bypass_total == 0
+        assert fired == []
+
+    def test_emergency_bypass_total_in_stats(self):
+        sc = self._scaler([])
+        assert "autoscale_emergency_bypass_total" in sc.stats()
+
+
+class TestConcurrencyGate:
+    def test_limit_and_wait_counters(self):
+        from kubeflow_tpu.serving.autoscale import ConcurrencyGate
+
+        gate = ConcurrencyGate(1)
+        inside = threading.Event()
+        release = threading.Event()
+        done = threading.Event()
+
+        def holder():
+            with gate:
+                inside.set()
+                release.wait(30)
+
+        def waiter():
+            with gate:
+                done.set()
+
+        t1 = threading.Thread(target=holder, daemon=True)
+        t1.start()
+        assert inside.wait(10)
+        t2 = threading.Thread(target=waiter, daemon=True)
+        t2.start()
+        time.sleep(0.05)
+        assert not done.is_set()             # serialized behind t1
+        release.set()
+        assert done.wait(10)
+        t1.join(10)
+        t2.join(10)
+        st = gate.stats()
+        assert st["gate_limit"] == 1
+        assert st["gate_entries_total"] == 2
+        assert st["gate_waits_total"] == 1
+
+
+# -- chaos: the seeded domain-outage fault --------------------------------
+
+
+class TestDomainOutageFault:
+    def test_seeded_victim_and_offset_are_frozen(self):
+        from kubeflow_tpu.chaos import FaultPlan
+
+        a = FaultPlan(seed=7).domain_outage(["d0", "d1", "d2"])
+        b = FaultPlan(seed=7).domain_outage(["d0", "d1", "d2"])
+        assert a.faults[0].node == b.faults[0].node
+        assert a.faults[0].at == b.faults[0].at
+        # a different seed is free to choose differently — across a
+        # small sweep at least one choice must differ (deflake guard:
+        # the victim is seeded, not constant)
+        picks = {FaultPlan(seed=s).domain_outage(
+            ["d0", "d1", "d2"]).faults[0].node for s in range(16)}
+        assert len(picks) > 1
+
+    def test_fires_exactly_once(self):
+        from kubeflow_tpu.chaos import FaultPlan
+
+        plan = FaultPlan(seed=3).domain_outage(["d0", "d1"], at=0.0)
+        plan.activate()
+        first = plan.due_domain_outages()
+        assert first in (["d0"], ["d1"])
+        assert plan.due_domain_outages() == []
+
+    def test_empty_domains_rejected(self):
+        from kubeflow_tpu.chaos import FaultPlan
+
+        with pytest.raises(ValueError):
+            FaultPlan(seed=1).domain_outage([])
+
+
+# -- conf-freeze (satellite) ----------------------------------------------
+
+
+class TestConfFreezeDomains:
+    def test_bad_domains_knobs_are_one_failed_status(self):
+        from kubeflow_tpu.api.common import ObjectMeta
+        from kubeflow_tpu.api.inference import (
+            ComponentSpec,
+            InferenceService,
+            InferenceServicePhase,
+            InferenceServiceSpec,
+            ModelFormat,
+        )
+        from kubeflow_tpu.controlplane.cluster import Cluster
+
+        cases = {
+            "bad-domains-list": {"domains": ["zone-a"]},
+            "bad-domains-empty": {"domains": {}},
+            "bad-domains-weight": {"domains": {"zone-a": 0}},
+            "bad-domains-bool": {"domains": {"zone-a": True}},
+        }
+        with Cluster() as cluster:
+            cluster.add_tpu_slice("slice-0", 1, 4)
+            cluster.enable_serving()
+            for name, cfg in cases.items():
+                cluster.store.create(InferenceService(
+                    metadata=ObjectMeta(name=name),
+                    spec=InferenceServiceSpec(predictor=ComponentSpec(
+                        model_format=ModelFormat(name="llama-continuous"),
+                        config={"params_ref": "mem://never-fetched",
+                                **cfg}))))
+            for name in cases:
+                deadline = time.time() + 20
+                isvc = None
+                while time.time() < deadline:
+                    isvc = cluster.store.try_get("InferenceService", name)
+                    if (isvc is not None and isvc.status.phase
+                            == InferenceServicePhase.FAILED):
+                        break
+                    time.sleep(0.05)
+                assert isvc is not None
+                assert isvc.status.phase == InferenceServicePhase.FAILED, \
+                    (name, isvc.status)
+                assert "domains" in (isvc.status.message or ""), \
+                    (name, isvc.status.message)
+
+
+# -- mass recovery: thaw on a survivor ------------------------------------
+
+
+class TestMassRecoveryThaw:
+    def test_survivor_thaws_exactly_once(self, tiny_llama, tmp_path):
+        """The dead domain's hibernated session thaws on a survivor
+        sharing the store root — exactly once: the spill entry is
+        consumed, a second thaw is a hard error, zero recompiles and a
+        clean ledger on the survivor."""
+        store = KvSpillStore(str(tmp_path))
+        a = make_engine(tiny_llama)
+        a.attach_spill_store(store)
+        req = a.submit(LONG, max_new_tokens=120)
+        deadline = time.time() + 120
+        while len(req.tokens) < 8:
+            assert time.time() < deadline
+            time.sleep(0.01)
+        assert a.hibernate_sequence(req, "conv-dead-domain")
+        a.stop()   # the whole domain dies
+        del a
+
+        b = make_engine(tiny_llama)
+        try:
+            b.attach_spill_store(store)
+            assert store.contains("conv-dead-domain")
+            t0 = time.perf_counter()
+            req2, info = b.thaw_sequence("conv-dead-domain")
+            out = req2.wait(120)
+            assert len(out) == 120
+            assert not info["degraded"]
+            # exactly-once: consumed on success, a replay cannot thaw
+            # the same session twice
+            assert not store.contains("conv-dead-domain")
+            with pytest.raises(SpillCorrupt):
+                b.thaw_sequence("conv-dead-domain")
+            st = b.stats()
+            assert st["jit_recompiles_total"] == 0
+            assert st["kv_thaws_total"] == 1
+            # completion-time bound: a thaw is a resume, not a retrain
+            assert time.perf_counter() - t0 < 120.0
+            assert_no_leaks(b)
+        finally:
+            b.stop()
+
+    def test_thaw_gate_serializes_the_herd(self, tiny_llama, tmp_path):
+        """Two sessions thaw concurrently through a limit-1 gate: both
+        complete, and the gate saw one wait — the herd was serialized,
+        not refused."""
+        from kubeflow_tpu.serving.autoscale import ConcurrencyGate
+
+        store = KvSpillStore(str(tmp_path))
+        eng = make_engine(tiny_llama)
+        try:
+            eng.attach_spill_store(store)
+            for sid in ("h-1", "h-2"):
+                req = eng.submit(LONG, max_new_tokens=120)
+                deadline = time.time() + 120
+                while len(req.tokens) < 6:
+                    assert time.time() < deadline
+                    time.sleep(0.01)
+                assert eng.hibernate_sequence(req, sid)
+            eng.thaw_gate = ConcurrencyGate(1)
+            results = {}
+
+            def thaw(sid):
+                req2, _info = eng.thaw_sequence(sid)
+                results[sid] = req2.wait(120)
+
+            threads = [threading.Thread(target=thaw, args=(sid,),
+                                        daemon=True)
+                       for sid in ("h-1", "h-2")]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=120)
+                assert not t.is_alive(), "a gated thaw hung"
+            assert len(results["h-1"]) == 120
+            assert len(results["h-2"]) == 120
+            st = eng.thaw_gate.stats()
+            assert st["gate_entries_total"] == 2
+            assert eng.stats()["jit_recompiles_total"] == 0
+            assert_no_leaks(eng)
+        finally:
+            eng.stop()
+
+
+# -- the full storm (slow) ------------------------------------------------
+
+
+@pytest.mark.slow
+class TestDomainOutageMidStorm:
+    def test_seeded_domain_kill_reroutes_and_recovers(self, tiny_llama):
+        """Heavy variant: real model replicas in two failure domains, a
+        seeded ``domain_outage`` kills one domain whole mid-storm —
+        zero hung requests, successes keep completing on the survivor,
+        the router declares the outage, amplification stays inside the
+        budget, and the survivor never recompiles."""
+        from kubeflow_tpu.chaos import FaultPlan
+        from kubeflow_tpu.serving.controller import Router
+        from kubeflow_tpu.serving.server import ModelServer
+        from kubeflow_tpu.serving.storage import register_mem
+        from kubeflow_tpu.serving.text import TextGenerator
+
+        ref = register_mem("failure-domain-storm", tiny_llama)
+
+        def server():
+            srv = ModelServer()
+            srv.register(TextGenerator("m", dict(
+                params_ref=ref, tokenizer="bytes", num_slots=4,
+                decode_chunk=2, block_size=16, prefix_cache=False,
+                max_new_tokens=8, warmup_groups=[])))
+            srv.start()
+            return srv
+
+        servers = {"d0": server(), "d1": server()}
+        for s in servers.values():
+            code, _, _ = post(s.url + "/openai/v1/completions",
+                              {"model": "m", "prompt": "warm",
+                               "max_tokens": 2}, timeout=120)
+            assert code == 200
+        router = Router(activate=lambda: None)
+        router.set_backends([s.url for s in servers.values()])
+        router.set_traffic(TrafficPlane(
+            {"default": {"max_concurrent": 2, "queue_depth": 8}}))
+        router.set_domains({servers[d].url: d for d in servers})
+        plan = FaultPlan(seed=41).domain_outage(["d0", "d1"], at=0.0)
+        results = []
+        lock = threading.Lock()
+        killed = []
+        try:
+            plan.activate()
+            threads = []
+            kill_t = [None]
+
+            def one(i):
+                code, _, _ = post(
+                    router.url + "/openai/v1/completions",
+                    {"model": "m", "prompt": f"storm {i}",
+                     "max_tokens": 4}, timeout=120)
+                with lock:
+                    results.append((i, code, time.perf_counter()))
+
+            for i in range(16):
+                if i == 6:
+                    for d in plan.due_domain_outages():
+                        servers[d].stop()  # the whole domain, at once
+                        killed.append(d)
+                    kill_t[0] = time.perf_counter()
+                th = threading.Thread(target=one, args=(i,), daemon=True)
+                th.start()
+                threads.append(th)
+                time.sleep(0.05)
+            hung = 0
+            for th in threads:
+                th.join(timeout=120)
+                hung += int(th.is_alive())
+            assert hung == 0, "a request hung through the domain kill"
+            assert len(killed) == 1  # the seeded victim fired once
+            assert len(results) == 16
+            codes = [c for _, c, _ in results]
+            assert all(c in (0, 200, 429, 500, 502, 503)
+                       for c in codes), results
+            assert sum(1 for _, c, t in results
+                       if c == 200 and t > kill_t[0]) >= 2, results
+            survivor = servers[{"d0": "d1", "d1": "d0"}[killed[0]]]
+            assert router.backend_stats()[survivor.url]["requests"] >= 4
+            # amplification bound: forwarded attempts stay inside
+            # 1 + ratio of the client storm (the budget contract)
+            rb = router.retry_budget.stats()
+            amp = (16 + rb["retries_granted_total"]) / 16
+            assert amp <= 1 + router.retry_budget.ratio \
+                + router.retry_budget.burst / 16
+            # the survivor took the storm without a single recompile
+            with urllib.request.urlopen(
+                    survivor.url + "/metrics", timeout=30) as r:
+                text = r.read().decode()
+            assert ('kft_engine_jit_recompiles_total{model="m"} 0'
+                    in text)
+        finally:
+            router.stop()
+            for d, s in servers.items():
+                if d not in killed:
+                    s.stop()

@@ -605,7 +605,11 @@ class TestRouterDoor:
                 router.url + "/openai/v1/completions",
                 {"model": "m", "prompt": "x"}, timeout=30)
             assert code == 503
-            assert headers["Retry-After"] == "1"
+            # jittered, load-aware Retry-After (ISSUE 16): no longer
+            # the synchronized constant "1" — the header is a bounded
+            # ceil of the jittered hint, the body carries the float
+            assert 1 <= int(headers["Retry-After"]) <= 30
+            assert body["retry_after"] > 0
             assert body["reason"] == "no_ready_replicas"
             # the failure is countable
             with urllib.request.urlopen(router.url + "/metrics") as r:
