@@ -295,14 +295,23 @@ def host_sync_in_dispatch(ctx: LintContext) -> Iterable[Finding]:
         # state — a device fetch or blocking socket inside a sensor or
         # actuator closure turns every tick into a stall, so sensing
         # must stay host-side stdlib and heavy actuation must go
-        # through the engines' public cross-thread APIs.
+        # through the engines' public cross-thread APIs.  AOT program
+        # ARTIFACT classes (ISSUE 17: ``*ArtifactCache`` /
+        # ``*ProgramStore``) are rooted because artifact load/publish
+        # is warmup-only by design: the seal boundary (RecompileCounter
+        # arming) keeps disk I/O off the scheduler thread structurally,
+        # and this root makes the complementary promise checkable — a
+        # device fetch or blocking sync creeping into cache
+        # bookkeeping (key hashing, manifest verify, counter reads)
+        # would put host work back on the dispatch path every time a
+        # program is consulted.
         roots += [
             qual
             for cls, methods in graph.by_class.items()
             if cls.endswith(("Allocator", "TrafficPlane", "Admission",
                              "Preemptor", "Resizer", "Reshard",
                              "BlockPool", "Autoscaler", "Scaler",
-                             "Reaper"))
+                             "Reaper", "ArtifactCache", "ProgramStore"))
             or _TIER_CLASS.search(cls)
             for qual in methods.values()
         ]

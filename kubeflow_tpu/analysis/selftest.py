@@ -255,6 +255,34 @@ class AutoscalePlanner:
 """,
         0),
     Fixture(
+        # ISSUE 17 rooting: AOT artifact classes are dispatch-path
+        # roots — a device fetch inside cache bookkeeping puts host
+        # work back on the dispatch path every program consult
+        "host-sync-in-dispatch", "host-sync-artifact-cache/true-positive",
+        "kubeflow_tpu/serving/_st_dispatch_artifacts.py",
+        """
+import jax
+
+class ProgramArtifactCache:
+    def fingerprint(self, buf):
+        return jax.device_get(buf)
+""",
+        1, "host sync"),
+    Fixture(
+        # suffix match roots *ArtifactCache, not names that merely
+        # contain it: an index over the cache dir is host bookkeeping
+        # that never runs on the dispatch path
+        "host-sync-in-dispatch", "host-sync-artifact-cache/near-miss",
+        "kubeflow_tpu/serving/_st_dispatch_artifacts.py",
+        """
+import jax
+
+class ArtifactCacheIndex:
+    def fingerprint(self, buf):
+        return jax.device_get(buf)
+""",
+        0),
+    Fixture(
         # ISSUE 15 rooting: every orchestration-class method is an
         # external entry — writing scheduler-owned state from the
         # decision loop is the race the contract forbids

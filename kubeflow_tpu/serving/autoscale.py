@@ -606,18 +606,34 @@ class ClusterAutoscaler:
         #: EWMA of measured cold starts (scale-up fire -> replica ready)
         self.cold_start_s = 0.0
         self._cold_n = 0
+        #: the warm-path EWMA (ISSUE 17): cold starts whose warmup HIT
+        #: the AOT artifact cache, tracked separately — one cache-cold
+        #: build (first boot, version bump) must not poison the budget
+        #: the scale-to-zero gate holds the steady state to
+        self.cold_start_warm_s = 0.0
+        self._cold_warm_n = 0
 
     # -- sensors ----------------------------------------------------------
 
-    def note_cold_start(self, seconds: float) -> None:
+    def note_cold_start(self, seconds: float, warm: bool = False) -> None:
         """Record one measured cold start (scale-up decision to replica
         Ready).  The EWMA is the budget ``decide`` holds scale-to-zero
-        to — zero is only cheap if waking is."""
+        to — zero is only cheap if waking is.  ``warm=True`` tags a
+        build whose program warmup hit the artifact cache: it ALSO
+        feeds the warm-path EWMA, which the gate prefers once measured
+        (every post-first boot takes the warm path, so that is the
+        budget that predicts the next wake)."""
         with self._lock:
             self._cold_n += 1
             a = 0.3 if self._cold_n > 1 else 1.0
             self.cold_start_s = (a * float(seconds)
                                  + (1 - a) * self.cold_start_s)
+            if warm:
+                self._cold_warm_n += 1
+                a = 0.3 if self._cold_warm_n > 1 else 1.0
+                self.cold_start_warm_s = (
+                    a * float(seconds)
+                    + (1 - a) * self.cold_start_warm_s)
 
     # -- the loop ---------------------------------------------------------
 
@@ -637,7 +653,13 @@ class ClusterAutoscaler:
         self._util.observe(now, _sig(sig, "util", 0.0))
         sig.setdefault("util_forecast",
                        self._util.forecast(self.policy.horizon_s))
-        sig.setdefault("cold_start_s", self.cold_start_s)
+        # the scale-to-zero gate budgets the NEXT wake: once a
+        # warm-cache cold start has been measured, that is the path
+        # every future wake takes — prefer it over the all-paths EWMA
+        # (which one cache-cold first boot would otherwise poison)
+        sig.setdefault("cold_start_s",
+                       self.cold_start_warm_s if self._cold_warm_n > 0
+                       else self.cold_start_s)
         dec = decide(sig, self.policy)
 
         # demand-change bookkeeping: when the demanded action changes
@@ -746,6 +768,8 @@ class ClusterAutoscaler:
             "autoscale_emergency_bypass_total":
                 self.emergency_bypass_total,
             "autoscale_cold_start_s": round(self.cold_start_s, 4),
+            "autoscale_cold_start_warm_s": round(
+                self.cold_start_warm_s, 4),
             "decisions": dict(self.decisions_total),
         }
         out["autoscale_parked_actuators"] = sum(
@@ -766,6 +790,8 @@ class ClusterAutoscaler:
             "kft_autoscale_parked_actuators "
             f"{s['autoscale_parked_actuators']}",
             f"kft_autoscale_cold_start_s {s['autoscale_cold_start_s']}",
+            "kft_autoscale_cold_start_warm_s "
+            f"{s['autoscale_cold_start_warm_s']}",
         ]
         for action in ACTIONS:
             lines.append(

@@ -92,9 +92,11 @@ from .paged import (
     scatter_block_view,
     write_window_tables,
 )
+from . import programs as programslib
 from .paged import block_keys as _block_keys
 from .paged import lcp as _lcp  # noqa: F401 — the one LCP implementation
 from .storage import fetch_mem
+from .trace import Trace
 
 log = logging.getLogger("kubeflow_tpu.serving")
 
@@ -1232,6 +1234,7 @@ class ContinuousEngine:
         host_watermark: float = 0.25,
         admission_policy=None,
         role: str = "mixed",
+        program_cache=None,
     ):
         if num_slots < 1:
             raise ValueError("num_slots must be >= 1")
@@ -1406,6 +1409,20 @@ class ContinuousEngine:
         if not self.seq_buckets:
             raise ValueError(f"no usable seq bucket <= {cap}")
 
+        #: optional serving/programs.py ProgramArtifactCache: while the
+        #: engine is warming (recompile guard unarmed), unseen program
+        #: signatures load serialized executables from the shared
+        #: artifact root instead of paying the compile wall; once
+        #: sealed the wrapper never touches disk again
+        self.program_cache = program_cache
+        #: warmup trace material: (family, outcome, t0, t1) per first
+        #: compile / artifact load, drained by warmup() into the
+        #: engine.warmup trace; the stashed trace flushes to the
+        #: tracer's sink when one is attached (text.py attaches AFTER
+        #: build — flush_warmup_trace() is the idempotent handoff)
+        self._warm_events: list = []
+        self._warmup_trace = None
+
         self._build_programs()
         self._init_pool()
 
@@ -1560,7 +1577,36 @@ class ContinuousEngine:
         #: live request for a trace+compile, so the gauge must stay 0
         #: (tier-1 asserts it; /metrics exports jit_recompiles_total)
         self._recompiles = RecompileCounter()
-        guard = lambda p: recompile_guard(p, self._recompiles)  # noqa: E731
+
+        #: AOT artifact seam (serving/programs.py): every program is
+        #: wrapped UNDER the guard.  With a cache, unseen signatures
+        #: load/publish serialized executables while unsealed; without
+        #: one, a WarmObserver just times first compiles so the
+        #: engine.warmup trace gets per-family/rung spans either way.
+        #: The seal predicate is the guard's armed flag, which flips
+        #: before the scheduler starts — artifact I/O can never run on
+        #: the dispatch thread.
+        sealed = lambda: self._recompiles.armed  # noqa: E731
+        if self.program_cache is not None:
+            aot_base = programslib.cache_key_base(
+                cfg, self.params, mesh,
+                slots=slots, chunk=chunk,
+                budget=self.prefill_budget, spec_k=self.spec_k,
+                spec_ngram=self.spec_ngram, block=self.block_size,
+                segments=self.prefix_segments, seglen=self.segment_len)
+
+            def aot(p, family):
+                return programslib.AotProgram(
+                    p, cache=self.program_cache, key_base=aot_base,
+                    family=family, sealed=sealed,
+                    observer=self._note_warm)
+        else:
+            def aot(p, family):
+                return programslib.WarmObserver(
+                    p, family=family, sealed=sealed,
+                    observer=self._note_warm)
+
+        guard = lambda p, family: recompile_guard(aot(p, family), self._recompiles)  # noqa: E731
 
         #: decode-attention window buckets: each decode dispatch attends
         #: only over cache slots below the smallest bucket covering every
@@ -1604,7 +1650,7 @@ class ContinuousEngine:
             attend = next(b for b in self.attend_buckets if b >= bucket)
             if attend not in self._prefill_programs:
                 self._prefill_programs[attend] = guard(make_prefill_program(
-                    cfg, attend, mesh))
+                    cfg, attend, mesh), f"prefill:{attend}")
             return self._prefill_programs[attend]
 
         self._prefill_for = prefill_for
@@ -1636,7 +1682,7 @@ class ContinuousEngine:
                 cfg.max_seq_len)
             if attend not in self._decode_programs:
                 self._decode_programs[attend] = guard(make_decode_program(
-                    cfg, attend, chunk, mesh))
+                    cfg, attend, chunk, mesh), f"decode:{attend}")
             return self._decode_programs[attend]
 
         self._decode_for = decode_for
@@ -1653,7 +1699,7 @@ class ContinuousEngine:
                 if attend not in self._fused_programs:
                     self._fused_programs[attend] = guard(make_fused_step_program(
                         cfg, attend, chunk, budget, self._batch_axes,
-                        mesh))
+                        mesh), f"fused:{attend}")
                 return self._fused_programs[attend]
 
             def chunk_prefill_for(needed: int):
@@ -1663,7 +1709,8 @@ class ContinuousEngine:
                 if attend not in self._chunk_programs:
                     self._chunk_programs[attend] = guard(
                         make_chunk_prefill_program(
-                            cfg, attend, budget, self._batch_axes, mesh))
+                            cfg, attend, budget, self._batch_axes, mesh),
+                        f"chunk_prefill:{attend}")
                 return self._chunk_programs[attend]
 
             self._fused_for = fused_for
@@ -1679,7 +1726,8 @@ class ContinuousEngine:
                     cfg.max_seq_len)
                 if attend not in self._verify_programs:
                     self._verify_programs[attend] = guard(
-                        make_verify_program(cfg, attend, spec_k, mesh))
+                        make_verify_program(cfg, attend, spec_k, mesh),
+                        f"verify:{attend}")
                 return self._verify_programs[attend]
 
             self._verify_for = verify_for
@@ -1695,7 +1743,8 @@ class ContinuousEngine:
                         self._fused_verify_programs[attend] = guard(
                             make_fused_verify_program(
                                 cfg, attend, spec_k, self.prefill_budget,
-                                self._batch_axes, mesh))
+                                self._batch_axes, mesh),
+                            f"fused_verify:{attend}")
                     return self._fused_verify_programs[attend]
 
                 self._fused_verify_for = fused_verify_for
@@ -1724,7 +1773,7 @@ class ContinuousEngine:
                 a = next(x for x in self._seg_attends if x >= bucket)
                 if a not in self._seg_prefill_programs:
                     self._seg_prefill_programs[a] = guard(make_prefill_program(
-                        self._seg_cfg, a, mesh))
+                        self._seg_cfg, a, mesh), f"seg_prefill:{a}")
                 return self._seg_prefill_programs[a]
 
             self._seg_prefill_for = seg_prefill_for
@@ -1743,7 +1792,7 @@ class ContinuousEngine:
                     mesh)
 
             self._seg_merge = guard(shardedlib.mesh_jit(
-                mesh, seg_merge, donate_argnums=(0,)))
+                mesh, seg_merge, donate_argnums=(0,)), "seg_merge")
 
             self._suffix_admit_programs: dict[tuple, Any] = {}
 
@@ -1755,7 +1804,8 @@ class ContinuousEngine:
                 k = (a, sa, bucket)
                 if k not in self._suffix_admit_programs:
                     self._suffix_admit_programs[k] = guard(
-                        make_suffix_admit_program(cfg, a, sa, bucket, mesh))
+                        make_suffix_admit_program(cfg, a, sa, bucket, mesh),
+                        f"suffix_admit:{a}:{sa}:{bucket}")
                 return self._suffix_admit_programs[k]
 
             self._suffix_admit_for = suffix_admit_for
@@ -1770,7 +1820,8 @@ class ContinuousEngine:
                 k = (a, sa)
                 if k not in self._prefix_decode_programs:
                     self._prefix_decode_programs[k] = guard(
-                        make_prefix_decode_program(cfg, a, sa, chunk, mesh))
+                        make_prefix_decode_program(cfg, a, sa, chunk, mesh),
+                        f"prefix_decode:{a}:{sa}")
                 return self._prefix_decode_programs[k]
 
             self._prefix_decode_for = prefix_decode_for
@@ -1785,7 +1836,8 @@ class ContinuousEngine:
             if key not in self._prefix_programs:
                 self._prefix_programs[key] = guard(make_prefix_admit_program(
                     cfg, attend, suffix_bucket, self._batch_axes, mesh,
-                    seq_axes=self._seq_axes))
+                    seq_axes=self._seq_axes),
+                    f"prefix_admit:{attend}:{suffix_bucket}")
             return self._prefix_programs[key]
 
         self._prefix_admit_for = prefix_admit_for
@@ -1824,7 +1876,8 @@ class ContinuousEngine:
                 if a not in self._paged_decode_programs:
                     self._paged_decode_programs[a] = guard(
                         make_paged_decode_program(cfg, a, chunk,
-                                                  *paged_args))
+                                                  *paged_args),
+                        f"paged_decode:{a}")
                 return self._paged_decode_programs[a]
 
             def paged_chunk_for(needed: int, budget: int):
@@ -1833,7 +1886,8 @@ class ContinuousEngine:
                 if k not in self._paged_chunk_programs:
                     self._paged_chunk_programs[k] = guard(
                         make_paged_chunk_prefill_program(
-                            cfg, a, budget, *paged_args))
+                            cfg, a, budget, *paged_args),
+                        f"paged_chunk:{a}:{budget}")
                 return self._paged_chunk_programs[k]
 
             def paged_fused_for(needed: int):
@@ -1842,7 +1896,8 @@ class ContinuousEngine:
                     self._paged_fused_programs[a] = guard(
                         make_paged_fused_step_program(
                             cfg, a, chunk, self.prefill_budget,
-                            *paged_args))
+                            *paged_args),
+                        f"paged_fused:{a}")
                 return self._paged_fused_programs[a]
 
             def paged_verify_for(needed: int):
@@ -1850,7 +1905,8 @@ class ContinuousEngine:
                 if a not in self._paged_verify_programs:
                     self._paged_verify_programs[a] = guard(
                         make_paged_verify_program(cfg, a, self.spec_k,
-                                                  *paged_args))
+                                                  *paged_args),
+                        f"paged_verify:{a}")
                 return self._paged_verify_programs[a]
 
             def paged_fused_verify_for(needed: int):
@@ -1859,7 +1915,8 @@ class ContinuousEngine:
                     self._paged_fused_verify_programs[a] = guard(
                         make_paged_fused_verify_program(
                             cfg, a, self.spec_k, self.prefill_budget,
-                            *paged_args))
+                            *paged_args),
+                        f"paged_fused_verify:{a}")
                 return self._paged_fused_verify_programs[a]
 
             self._paged_decode_for = paged_decode_for
@@ -1868,16 +1925,21 @@ class ContinuousEngine:
             self._paged_verify_for = paged_verify_for
             self._paged_fused_verify_for = paged_fused_verify_for
             self._block_copy = guard(
-                make_block_copy_program(self._block_axes, mesh))
+                make_block_copy_program(self._block_axes, mesh),
+                "block_copy")
             # live KV migration (ISSUE 8): one-block gather/scatter at a
             # FIXED [1, 1] table shape — the host loops blocks, so one
             # compiled program each serves sequences of any length
             self._kv_export = guard(make_kv_export_program(
-                self._block_axes, self._block_seq_axes, mesh))
+                self._block_axes, self._block_seq_axes, mesh),
+                "kv_export")
             self._kv_import = guard(make_kv_import_program(
-                self._block_axes, self._block_seq_axes, mesh))
-            self._logits_take = guard(make_logits_take_program(mesh))
-            self._logits_set = guard(make_logits_set_program(mesh))
+                self._block_axes, self._block_seq_axes, mesh),
+                "kv_import")
+            self._logits_take = guard(
+                make_logits_take_program(mesh), "logits_take")
+            self._logits_set = guard(
+                make_logits_set_program(mesh), "logits_set")
 
         # logits dtype follows the model's activation dtype (bf16 on TPU;
         # the pool logits buffer must match or the decode scan carry
@@ -1891,7 +1953,8 @@ class ContinuousEngine:
         # donate pool buffers: the pool cache must exist in HBM once, not
         # once per in-flight dispatch
         self._merge = guard(
-            shardedlib.mesh_jit(mesh, merge, donate_argnums=(0, 1)))
+            shardedlib.mesh_jit(mesh, merge, donate_argnums=(0, 1)),
+            "merge")
 
     def _init_pool(self) -> None:
         mesh = self.mesh
@@ -1952,10 +2015,54 @@ class ContinuousEngine:
                     "warmup() must run before the first submit(): the "
                     "scheduler thread owns the donated pool buffers once "
                     "traffic starts")
-            self._warmup_locked(groups)
+            self._warm_events = []
+            tr = Trace(name="warmup", kind="engine")
+            tr.phase("engine.warmup")
+            try:
+                self._warmup_locked(groups)
+            finally:
+                # per-family/rung compile + artifact-load spans, so the
+                # compile wall shows up in /traces and
+                # kft_phase_seconds like every other phase
+                for family, outcome, t0, t1 in self._warm_events:
+                    sp = tr.begin(f"warmup.{outcome}", family=family)
+                    sp.start = t0
+                    sp.done(at=t1)
+                self._warm_events = []
+                if self.program_cache is not None:
+                    s = self.program_cache.stats()
+                    tr.meta["aot_hits"] = s["aot_cache_hits_total"]
+                    tr.meta["aot_misses"] = s["aot_cache_misses_total"]
+                tr.finish()
+                self._warmup_trace = tr
+                self.flush_warmup_trace()
             # warmup's shape ladder is the paid-once warm set; growth
             # past it is a mid-serving recompile — start counting
             self._recompiles.armed = True
+
+    def _note_warm(self, family: str, outcome: str, t0: float,
+                   t1: float) -> None:
+        """Observer for AotProgram/WarmObserver: one event per first
+        compile or artifact load, pre-seal only (the wrappers stop
+        calling once armed).  Capped below MAX_SPANS_PER_TRACE so the
+        warmup trace never eats the shared dropped-span sentinel."""
+        if len(self._warm_events) < 500:
+            self._warm_events.append((family, outcome, t0, t1))
+
+    def flush_warmup_trace(self) -> None:
+        """Hand the stashed warmup trace to the tracer's sink.
+
+        Idempotent, and callable at ANY point after warmup: the runtime
+        attaches ``self.tracer`` only after the engine is built
+        (text.py), so warmup stashes its trace and whoever attaches a
+        tracer flushes it.  A flush with no tracer or no stash is a
+        no-op.
+        """
+        tr, tracer = self._warmup_trace, self.tracer
+        if tr is None or tracer is None:
+            return
+        self._warmup_trace = None
+        tracer.sink.finish(tr)
 
     def _warmup_locked(self, groups) -> None:
         if groups is None:
@@ -2393,6 +2500,20 @@ class ContinuousEngine:
             # jit-cache growth past each program's first compile; MUST
             # stay 0 in steady state — a recompile stalls the whole pool
             "jit_recompiles_total": int(self._recompiles.count),
+            # AOT program-artifact cache (serving/programs.py): warmup
+            # hit/miss economics + store size; zeros when no cache is
+            # configured so dashboards keep one shape either way
+            **(self.program_cache.stats() if self.program_cache
+               is not None else {
+                   "aot_cache_hits_total": 0,
+                   "aot_cache_misses_total": 0,
+                   "aot_cache_load_failures_total": 0,
+                   "aot_cache_published_total": 0,
+                   "aot_cache_bytes_read_total": 0,
+                   "aot_cache_bytes_written_total": 0,
+                   "aot_cache_entries": 0,
+                   "aot_cache_bytes": 0,
+               }),
             "prefix_hits": self.prefix_hits,
             "prefix_tokens_saved": self.prefix_tokens_saved,
             "segments_capacity": self.prefix_segments,
@@ -4835,6 +4956,9 @@ class TieredEngine:
     def warmup(self, groups=None) -> None:
         self.engine.warmup(groups)
 
+    def flush_warmup_trace(self) -> None:
+        self.engine.flush_warmup_trace()
+
     def stop(self) -> None:
         self.engine.stop()
 
@@ -5168,6 +5292,10 @@ class DisaggregatedPool:
         for eng in self.pools:
             eng.warmup(groups)
 
+    def flush_warmup_trace(self) -> None:
+        for eng in self.pools:
+            eng.flush_warmup_trace()
+
     def stop(self) -> None:
         self._stopping.set()
         self._worker.join(timeout=10)
@@ -5311,7 +5439,10 @@ class DisaggregatedPool:
             for k, v in st.items():
                 if isinstance(v, bool) or not isinstance(v, (int, float)):
                     continue
-                if k in config_keys:
+                if k in config_keys or k.startswith("aot_cache_"):
+                    # the pool's engines share ONE artifact cache —
+                    # summing its counters would multiply them by the
+                    # replica count
                     merged.setdefault(k, v)
                 else:
                     merged[k] = merged.get(k, 0) + v
@@ -5414,6 +5545,10 @@ def build_engine(cfg, params, config: dict, *, default_eos=None,
     kw = engine_kwargs(
         config, default_eos=default_eos,
         default_max_new_tokens=default_max_new_tokens)
+    # AOT program-artifact cache (serving/programs.py): constructed
+    # HERE, not in engine_kwargs — engine_kwargs is also the
+    # controller's validation probe and must stay side-effect-free
+    kw["program_cache"] = programslib.build_program_cache(config)
     cfg, params = apply_serving_quant(cfg, params, config)
     short_len = config.get("short_pool_len")
     tier_lens = config.get("tier_lens")

@@ -1240,6 +1240,18 @@ class InferenceServiceController(Controller):
                 validate_autoscale(cfg["autoscale"])
             except (TypeError, ValueError) as e:
                 raise ValueError(f"invalid engine knobs: {e}") from e
+        # AOT program-artifact cache knobs (ISSUE 17) freeze here too —
+        # the PR 4/7/9 convention: a missing root or a mistyped fsync
+        # flag is ONE Failed status at conf-freeze, not every replica
+        # failing its warmup at load; validate_aot is the one shared
+        # validator
+        if cfg.get("aot") is not None:
+            from .programs import validate_aot
+
+            try:
+                validate_aot(cfg["aot"])
+            except (TypeError, ValueError) as e:
+                raise ValueError(f"invalid engine knobs: {e}") from e
         pps = cfg.get("prefix_poll_s")
         if pps is not None:
             try:
@@ -2339,8 +2351,34 @@ class InferenceServiceController(Controller):
         if (preds and (want is None or len(preds) >= want)
                 and all(getattr(s, "ready", True) for s in preds)):
             dep.autoscaler.note_cold_start(
-                time.monotonic() - dep.cold_start_t0)
+                time.monotonic() - dep.cold_start_t0,
+                warm=self._wake_was_warm(preds))
             dep.cold_start_t0 = None
+
+    @staticmethod
+    def _wake_was_warm(preds) -> bool:
+        """Did this wake-from-zero serve its program ladder out of the
+        AOT artifact cache?  A build that compiled even one rung sits on
+        the cold budget — mixing it into the warm EWMA would let
+        ``decide`` scale to zero against a wake time the fleet cannot
+        actually hit."""
+        saw_cache = False
+        for s in preds:
+            engines = getattr(s, "engines", None)
+            if engines is None:
+                continue
+            for eng in engines().values():
+                try:
+                    st = eng.stats()
+                except (RuntimeError, TimeoutError):
+                    return False
+                hits = st.get("aot_cache_hits_total")
+                if hits is None:
+                    continue
+                saw_cache = True
+                if st.get("aot_cache_misses_total", 0) > 0 or hits <= 0:
+                    return False
+        return saw_cache
 
     # -- resolution -------------------------------------------------------
 

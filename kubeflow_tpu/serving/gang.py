@@ -74,6 +74,7 @@ from typing import Any, Optional
 import numpy as np
 
 from . import continuous as contlib
+from . import programs as programslib
 from ..runtime import bootstrap
 
 log = logging.getLogger("kubeflow_tpu.serving")
@@ -1583,6 +1584,9 @@ def _follower_resize(engine, channel: GangChannel, conf: dict):
         _plan, leaves = client.receive()
         params = unflatten_params(leaves)
         kw = dict(conf.get("kwargs") or {})
+        # the wire kwargs are JSON-safe by design: the artifact cache
+        # carries over from the engine being replaced instead
+        kw["program_cache"] = getattr(engine, "program_cache", None)
         # allocation only at commit: the new-degree pool buffers exist
         # only once every leaf arrived intact
         new = contlib.ContinuousEngine(
@@ -1844,6 +1848,11 @@ def serve_main(ctx: bootstrap.PodContext) -> None:
     cfg, params = contlib.apply_serving_quant(cfg, params, conf)
     kw = contlib.engine_kwargs(conf, default_eos=conf.get("eos_id"))
     kw["seq_buckets"] = conf.get("seq_buckets")
+    # AOT artifact cache: EVERY rank consults the same root (the config
+    # is identical gang-wide), so followers load the same artifacts the
+    # leader does — the publish rename is atomic, concurrent ranks race
+    # safely and the losers verify the winner's entry
+    kw["program_cache"] = programslib.build_program_cache(conf)
     gang_port = int(conf["gang_port"])
     token = _resolve_gang_token(conf)
     elastic = conf.get("elastic") or {}

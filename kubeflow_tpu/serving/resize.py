@@ -525,8 +525,64 @@ class GangResizer:
 
             rtr = Trace(name="resize", old_degree=old_degree,
                         new_degree=new_degree)
-            rtr.phase("resize.export")
         orig_policy = src.admission_policy
+        prebuilt = None
+        if channel is None and getattr(src, "program_cache", None) is not None:
+            # PREBUILD (local engines with an AOT artifact cache):
+            # construct and warm the destination-degree engine
+            # CONCURRENTLY with old-degree serving, so copy-then-cutover
+            # finally covers the programs, not just the state — the
+            # quiesce window below no longer contains the compile wall.
+            # The block budget is estimated from the live set
+            # (position+remaining is dispatch-stable); admissions during
+            # the prebuild can push the real budget past the estimate,
+            # in which case the prebuilt engine is discarded and the
+            # serial path rebuilds against the just-published artifacts
+            # — still fast, never wrong.
+            tp = time.perf_counter()
+            if rtr is not None:
+                rtr.phase("resize.prebuild")
+            try:
+                reserved_est = 0
+                if src.paged:
+                    bs = src.block_size
+                    for i, r in enumerate(src._slots):
+                        if r is None:
+                            continue
+                        total = int(src._positions[i]) + int(
+                            src._remaining[i])
+                        reserved_est += max(
+                            -(-max(total, 1) // bs),
+                            len(src._slot_blocks[i]), 1)
+                nb_est = (int(num_blocks) if num_blocks
+                          else resize_block_budget(
+                              src.num_blocks, old_degree, new_degree,
+                              reserved=reserved_est))
+                kwp = self._engine_kwargs_of(
+                    src, orig_policy=orig_policy)
+                kwp["num_blocks"] = nb_est
+                kwp["program_cache"] = src.program_cache
+                pre_params = unflatten_params(
+                    dict(flatten_params(src.params)))
+                prebuilt = contlib.ContinuousEngine(
+                    src.cfg, pre_params, mesh_axes=mesh_axes, **kwp)
+                if self.tracer is not None:
+                    prebuilt.tracer = self.tracer
+                pre_groups = self._warmup_groups
+                if pre_groups != []:
+                    prebuilt.warmup([tuple(g) for g in pre_groups]
+                                    if pre_groups else None)
+            except Exception:  # noqa: BLE001 — the prebuild is an
+                # optimization: ANY failure here falls back to the
+                # serial rebuild inside the quiesce window
+                log.warning("resize prebuild failed; falling back to "
+                            "serial rebuild", exc_info=True)
+                if prebuilt is not None:
+                    prebuilt.stop()
+                prebuilt = None
+            timings["prebuild_s"] = time.perf_counter() - tp
+        if rtr is not None:
+            rtr.phase("resize.export")
         exported: list[tuple[Any, dict]] = []
         published = False
         server: Optional[ReshardServer] = None
@@ -535,7 +591,10 @@ class GangResizer:
             # QUIESCE: new admissions defer (the policy hook runs on the
             # scheduler thread each cycle); live slots keep decoding
             # until their export freezes them — tokens flow through the
-            # copy phase, exactly-once
+            # copy phase, exactly-once.  The drain clock starts HERE:
+            # the prebuild above overlaps live serving and must not be
+            # billed to the disruption window
+            td = time.perf_counter()
             src.admission_policy = lambda req: False
 
             # EXPORT: freeze + snapshot every live sequence at its
@@ -555,7 +614,7 @@ class GangResizer:
                                         resize=(rtr.trace_id
                                                 if rtr else ""))
                 self._fail("export")
-            timings["drain_s"] = time.perf_counter() - t0
+            timings["drain_s"] = time.perf_counter() - td
 
             # RESHARD: repartition weights through the sharding table's
             # plan; tell followers; build the new-degree engine + pool
@@ -585,6 +644,9 @@ class GangResizer:
                 src.num_blocks, old_degree, new_degree, reserved=reserved)
             kw = self._engine_kwargs_of(src, orig_policy=orig_policy)
             kw["num_blocks"] = nb
+            # the new degree shares the old engine's artifact cache:
+            # its warmup loads what some replica already published
+            kw["program_cache"] = getattr(src, "program_cache", None)
             follower_ranks: list[int] = []
             if channel is not None:
                 follower_ranks = channel.follower_ranks()
@@ -611,12 +673,22 @@ class GangResizer:
                     raise RuntimeError(
                         f"follower rebuild failed: {bad} — the new "
                         "shape never acked")
+            pre_used = False
             if channel is not None:
                 new = GangEngine(src.cfg, new_params, channel=channel,
                                  mesh_axes=mesh_axes, **kw)
+            elif (prebuilt is not None
+                  and prebuilt.num_blocks >= nb):
+                # the concurrent prebuild covers the real budget: adopt
+                # it wholesale — programs already warm, nothing to
+                # compile inside the quiesce window
+                new, prebuilt, pre_used = prebuilt, None, True
             else:
                 new = contlib.ContinuousEngine(
                     src.cfg, new_params, mesh_axes=mesh_axes, **kw)
+            if self.tracer is not None and getattr(
+                    new, "tracer", None) is None:
+                new.tracer = self.tracer
             if getattr(src, "block_ledger", None) is not None and new.paged:
                 # the zero-leaked-blocks audit follows the pool across
                 # the resize: one ledger, both degrees' allocators —
@@ -633,7 +705,7 @@ class GangResizer:
             # post-resize dispatch must never compile mid-serving (gang
             # warmup ops replay to the followers' new engines)
             groups = self._warmup_groups
-            if groups != []:
+            if groups != [] and not pre_used:
                 new.warmup([tuple(g) for g in groups] if groups else None)
             timings["reshard_s"] = time.perf_counter() - t1
 
@@ -685,6 +757,11 @@ class GangResizer:
         finally:
             if server is not None:
                 server.close()
+            if prebuilt is not None:
+                # unused prebuild (budget overrun or rollback): release
+                # its pool before the serial engine's lifetime begins
+                prebuilt.stop()
+                prebuilt = None
 
         # CUTOVER (forward-only): the new shape acked — flip ownership.
         # From here failure handling COMPLETES FORWARD, never rolls

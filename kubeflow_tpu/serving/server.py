@@ -528,6 +528,23 @@ class ModelServer:
                             plane.stats(), "kft_traffic_",
                             f'model="{prom_label(name)}"').items():
                         families.setdefault(fam, []).extend(lines)
+                # AOT program-artifact cache (ISSUE 17): its own
+                # kft_aot_* family from the cache itself, dropping the
+                # aot_cache_ stat prefix — hit/miss economics + store
+                # bytes for the compile-wall dashboards (the engine
+                # loop above also exports them as kft_engine_aot_*;
+                # these are the canonical names the runbooks use)
+                pcache = getattr(engine, "program_cache", None)
+                if pcache is not None:
+                    from .traffic import prom_label, prom_stat_lines
+
+                    aot_stats = {
+                        k[len("aot_cache_"):]: v
+                        for k, v in pcache.stats().items()}
+                    for fam, lines in prom_stat_lines(
+                            aot_stats, "kft_aot_cache_",
+                            f'model="{prom_label(name)}"').items():
+                        families.setdefault(fam, []).extend(lines)
                 # trace-layer gauges ride the same export (sampling
                 # accounting); the phase histograms append below as a
                 # pre-rendered block — they carry their own TYPE line

@@ -356,6 +356,11 @@ class TextGenerator(Model):
                    else list(self.engine.pools))
         for eng in engines:
             eng.tracer = self.tracer
+            if hasattr(eng, "flush_warmup_trace"):
+                # build_engine warmed BEFORE the tracer existed: the
+                # stashed engine.warmup trace (per-family compile/
+                # artifact-load spans) flushes into the sink now
+                eng.flush_warmup_trace()
 
     def _build_hibernation(self) -> None:
         """Attach the manifest-verified spill store (ISSUE 12) to every

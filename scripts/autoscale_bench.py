@@ -135,7 +135,7 @@ class MiniFleet:
     recompile audit, so a drained replica cannot hide a leak."""
 
     def __init__(self, cfg, params, *, max_replicas: int = 4,
-                 slots_per_replica: int = 4):
+                 slots_per_replica: int = 4, aot_root: str = None):
         self.cfg, self.params = cfg, params
         self.max_replicas = max_replicas
         self.slots = slots_per_replica
@@ -149,26 +149,46 @@ class MiniFleet:
         self.scale_downs = 0
         self.migrated = 0
         self._retired_stats = []
+        #: shared AOT program-artifact cache (ISSUE 17): replicas after
+        #: the first load their warmup ladder from disk instead of
+        #: compiling it; per-replica hit counts recorded at add time
+        self.program_cache = None
+        self.aot_prewarm_hits = []
+        if aot_root is not None:
+            from kubeflow_tpu.serving.programs import ProgramArtifactCache
+            self.program_cache = ProgramArtifactCache(aot_root)
 
     def _build(self):
         from kubeflow_tpu.serving.continuous import ContinuousEngine
 
         return ContinuousEngine(
             self.cfg, self.params, num_slots=self.slots, decode_chunk=2,
-            prefix_cache=False, block_size=16)
+            prefix_cache=False, block_size=16,
+            program_cache=self.program_cache)
 
     def add_replica(self) -> float:
         """Build + pre-warm one replica; returns the measured cold
-        start (build -> first compiled generation done) in seconds."""
+        start (build -> first compiled generation done) in seconds.
+        With a shared artifact cache the pre-warm runs the full warmup
+        ladder (cache consults happen pre-seal only), so a later
+        replica fetches artifacts instead of compiling."""
         with self._lock:
             if len(self.engines) + self.pending >= self.max_replicas:
                 raise RuntimeError("at max replicas")
             self.pending += 1
         try:
+            before = (self.program_cache.stats()["aot_cache_hits_total"]
+                      if self.program_cache is not None else 0)
             t0 = time.perf_counter()
             eng = self._build()
+            if self.program_cache is not None:
+                eng.warmup()
             eng.generate([1, 2, 3, 4], max_new_tokens=4, timeout=120.0)
             cold = time.perf_counter() - t0
+            if self.program_cache is not None:
+                self.aot_prewarm_hits.append(
+                    self.program_cache.stats()["aot_cache_hits_total"]
+                    - before)
             with self._lock:
                 self.engines.append(eng)
         finally:
@@ -188,7 +208,11 @@ class MiniFleet:
             except RuntimeError:
                 return
             if on_cold_start is not None:
-                on_cold_start(cold)
+                # tag the sample with the cache outcome so the EWMA
+                # tracks warm wakes separately (ISSUE 17)
+                warm = bool(self.aot_prewarm_hits
+                            and self.aot_prewarm_hits[-1] > 0)
+                on_cold_start(cold, warm=warm)
         threading.Thread(target=work, name="fleet-prewarm",
                          daemon=True).start()
 
@@ -370,8 +394,14 @@ def bench_diurnal(seed: int, duration_s: float, compress: float) -> list:
         high_band=1.1, low_band=0.35, loop_s=0.25,
         up_cooldown_s=0.5, down_cooldown_s=3.0)
 
+    # both fleets share one AOT artifact root (ISSUE 17): the very
+    # first replica seeds it, every later pre-warm loads from disk
+    import shutil
+    import tempfile
+    aot_root = tempfile.mkdtemp(prefix="kft-autoscale-aot-")
+
     # -- autoscaled run --
-    fleet = MiniFleet(cfg, params)
+    fleet = MiniFleet(cfg, params, aot_root=aot_root)
     fleet.add_replica()
     auto = ClusterAutoscaler(
         policy, sensors=lambda: fleet.signals(policy.target_concurrency),
@@ -389,13 +419,14 @@ def bench_diurnal(seed: int, duration_s: float, compress: float) -> list:
     # -- static baseline at EQUAL chip-seconds --
     r_static = min(static_replicas_for(chips_a, end_a),
                    fleet.max_replicas)
-    fleet_s = MiniFleet(cfg, params)
+    fleet_s = MiniFleet(cfg, params, aot_root=aot_root)
     for _ in range(r_static):
         fleet_s.add_replica()
     lats_s, trace_s, end_s, drops_s = _replay(
         arrivals, fleet_s, None, duration_s=duration_s)
     audit_s = fleet_s.audit_and_stop()
     att_s = slo_attainment(lats_s)
+    shutil.rmtree(aot_root, ignore_errors=True)
 
     # hard invariants — a violation is a bench failure, not a row
     assert drops_a == 0, f"autoscaled run dropped {drops_a} requests"
@@ -403,6 +434,18 @@ def bench_diurnal(seed: int, duration_s: float, compress: float) -> list:
     for audit, name in ((audit_a, "autoscaled"), (audit_s, "static")):
         assert audit["kv_blocks_leaked_total"] == 0, (name, audit)
         assert audit["jit_recompiles_total"] == 0, (name, audit)
+    # the pre-warm path must serve its ladder from the artifact cache:
+    # every static-fleet add runs against the seeded root (adds are
+    # serial, so per-replica deltas are exact), and any autoscaled
+    # scale-up after the seeding replica must have loaded artifacts too
+    assert fleet_s.aot_prewarm_hits and all(
+        h > 0 for h in fleet_s.aot_prewarm_hits), (
+        f"static pre-warm never hit the AOT cache: "
+        f"{fleet_s.aot_prewarm_hits}")
+    assert len(fleet.aot_prewarm_hits) <= 1 or sum(
+        fleet.aot_prewarm_hits[1:]) > 0, (
+        f"scale-up pre-warm never hit the AOT cache: "
+        f"{fleet.aot_prewarm_hits}")
 
     rows = []
     for cls in CLASSES:
@@ -433,6 +476,14 @@ def bench_diurnal(seed: int, duration_s: float, compress: float) -> list:
                                              / len(fleet.cold_starts)), 3),
         "samples": len(fleet.cold_starts),
         "max_s": round(max(fleet.cold_starts), 3),
+    })
+    rows.append({
+        "metric": "autoscale_prewarm_aot_hits_total",
+        "value": float(sum(fleet.aot_prewarm_hits)
+                       + sum(fleet_s.aot_prewarm_hits)),
+        "replicas_warmed": (len(fleet.aot_prewarm_hits)
+                            + len(fleet_s.aot_prewarm_hits)),
+        "cold_start_warm_s": round(auto.cold_start_warm_s, 3),
     })
     rows.append({
         "metric": "autoscale_kv_blocks_leaked_total", "value": 0.0,

@@ -51,6 +51,17 @@ mode that used to park an ISvc in Degraded forever is now a bounded
 recovery; the live-conversation count is swept to show how the drain
 phase scales.
 
+The fifth row (ISSUE 17) is **cold start vs warm artifact cache**: one
+replica boot (engine build -> warmup -> first token) timed twice — with
+no AOT program-artifact cache (every rung compiles) and against a warm
+``ProgramArtifactCache`` root (every rung loads a verified artifact).
+``cold_start_warm_cache_p50_seconds`` is the headline, with the
+cold-cache p50 and the speedup attached; the companion
+``gang_resize_warm_cache_p50_seconds`` row re-runs the resize trial
+with a warm cache and splits the compile wall out of the disruption
+window (``prebuild_s`` overlaps live serving; disruption = drain +
+reshard + resume).
+
 Usage: python scripts/recovery_bench.py [trials] [workers] [seed]
 """
 
@@ -411,11 +422,15 @@ def run_hibernate_trial(i: int, conversations: int = 4) -> dict:
             dst.stop()
 
 
-def run_resize_trial(i: int, conversations: int) -> dict:
+def run_resize_trial(i: int, conversations: int,
+                     aot_root: str | None = None) -> dict:
     """One elastic shrink: a TP=2 paged engine with N live
     conversations resizes to the surviving degree; measured = resize
     start -> every conversation has produced a token on the new-degree
-    engine, with the resizer's own phase decomposition attached."""
+    engine, with the resizer's own phase decomposition attached.  With
+    ``aot_root`` the engines share an AOT artifact cache, so the
+    destination-degree ladder prebuilds from disk while the old degree
+    still serves — the timings then include ``prebuild_s``."""
     import jax
     import jax.numpy as jnp
 
@@ -428,6 +443,9 @@ def run_resize_trial(i: int, conversations: int) -> dict:
         jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32))["params"]
     kw = dict(num_slots=conversations, decode_chunk=2,
               prefix_cache=False, block_size=16, seq_buckets=[32])
+    if aot_root is not None:
+        from kubeflow_tpu.serving.programs import ProgramArtifactCache
+        kw["program_cache"] = ProgramArtifactCache(aot_root)
     src = ContinuousEngine(cfg, params, mesh_axes={"model": 2}, **kw)
     new = None
     try:
@@ -446,12 +464,49 @@ def run_resize_trial(i: int, conversations: int) -> dict:
         total = time.perf_counter() - t0
         for r in reqs:
             r.cancel()
+        st = new.stats()
         return {"gang_resize_s": total, "conversations": conversations,
                 **{k: v for k, v in rz.last_timings.items()
                    if k != "total_s"},
-                "recompiles": new.stats()["jit_recompiles_total"]}
+                "recompiles": st["jit_recompiles_total"],
+                "aot_hits": st["aot_cache_hits_total"]}
     finally:
         (new if new is not None else src).stop()
+
+
+def run_cold_start_trial(i: int, root: str | None) -> dict:
+    """One replica boot (ISSUE 17): engine build -> warmup -> first
+    token, either against a warm AOT artifact cache at ``root`` or with
+    the cache disabled (``root=None``, every rung compiles)."""
+    import jax
+    import jax.numpy as jnp
+
+    from kubeflow_tpu.models import llama as llamalib
+    from kubeflow_tpu.serving.continuous import ContinuousEngine
+    from kubeflow_tpu.serving.programs import ProgramArtifactCache
+
+    cfg = llamalib.tiny()
+    params = llamalib.Llama(cfg).init(
+        jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32))["params"]
+    kw = dict(num_slots=2, decode_chunk=2, prefix_cache=False,
+              block_size=16)
+    if root is not None:
+        kw["program_cache"] = ProgramArtifactCache(root)
+    t0 = time.perf_counter()
+    eng = ContinuousEngine(cfg, params, **kw)
+    try:
+        eng.warmup()
+        warmup_s = time.perf_counter() - t0
+        r = eng.submit([7, 8, 9, i + 1], max_new_tokens=4)
+        r.done.wait(60)
+        total = time.perf_counter() - t0
+        st = eng.stats()
+        return {"cold_start_s": total, "warmup_s": warmup_s,
+                "aot_hits": st["aot_cache_hits_total"],
+                "aot_misses": st["aot_cache_misses_total"],
+                "recompiles": st["jit_recompiles_total"]}
+    finally:
+        eng.stop()
 
 
 class _StubReplica:
@@ -756,6 +811,76 @@ def main() -> None:
         "p50_by_conversations": per_count,
         "recompiles_total": sum(r["recompiles"] for r in resize_rows),
     }))
+
+    # AOT program-artifact cache (ISSUE 17): cold start warm vs cold,
+    # then the resize compile-wall split against a warm cache
+    import shutil
+    import tempfile
+
+    aot_trials = max(3, trials // 4)
+    aot_root = tempfile.mkdtemp(prefix="kft-aot-bench-")
+    try:
+        run_cold_start_trial(-1, aot_root)  # seeding pass: publishes
+        cold_rows, warm_rows = [], []
+        for i in range(aot_trials):
+            cold_rows.append(run_cold_start_trial(i, None))
+            warm_rows.append(run_cold_start_trial(i, aot_root))
+            print("# cold-start trial", i, json.dumps({
+                "cold": round(cold_rows[-1]["cold_start_s"], 3),
+                "warm": round(warm_rows[-1]["cold_start_s"], 3),
+                "aot_hits": warm_rows[-1]["aot_hits"],
+                "aot_misses_warm": warm_rows[-1]["aot_misses"],
+            }), file=sys.stderr)
+        cold_p = _percentiles([r["cold_start_s"] for r in cold_rows])
+        warm_p = _percentiles([r["cold_start_s"] for r in warm_rows])
+        print(json.dumps({
+            "metric": "cold_start_warm_cache_p50_seconds",
+            "unit": ("s (engine build -> warmup -> first token against "
+                     "a warm ProgramArtifactCache root, "
+                     f"n={aot_trials}, tiny model CPU stand-in)"),
+            **warm_p,
+            "cold_cache_p50_s": cold_p["value"],
+            "speedup_x": round(cold_p["value"] / warm_p["value"], 2),
+            "aot_hits_total": sum(r["aot_hits"] for r in warm_rows),
+            "aot_misses_warm_total": sum(
+                r["aot_misses"] for r in warm_rows),
+            "recompiles_total": sum(
+                r["recompiles"] for r in cold_rows + warm_rows),
+        }))
+
+        # warm-cache resize: the first pass seeds both ladders (TP=2
+        # warmup + TP=1 prebuild publish); scored passes load from disk
+        rz_warm_rows = []
+        for i in range(aot_trials + 1):
+            row = run_resize_trial(i, conversations=2, aot_root=aot_root)
+            if i == 0:
+                continue
+            rz_warm_rows.append(row)
+            print("# warm-resize trial", i, json.dumps({
+                k: (round(v, 3) if isinstance(v, float) else v)
+                for k, v in row.items()}), file=sys.stderr)
+        phase_p50 = {}
+        for key in ("prebuild_s", "drain_s", "reshard_s", "resume_s"):
+            vals = sorted(r.get(key, 0.0) for r in rz_warm_rows)
+            phase_p50[key] = round(vals[len(vals) // 2], 3)
+        disruption = [r["drain_s"] + r["reshard_s"] + r["resume_s"]
+                      for r in rz_warm_rows]
+        print(json.dumps({
+            "metric": "gang_resize_warm_cache_p50_seconds",
+            "unit": ("s (TP 2 -> 1 shrink with a warm "
+                     "ProgramArtifactCache: prebuild overlaps live "
+                     "serving, disruption = drain+reshard+resume, "
+                     f"n={aot_trials}, tiny model CPU stand-in)"),
+            **_percentiles([r["gang_resize_s"] for r in rz_warm_rows]),
+            "phase_p50": phase_p50,
+            "disruption_p50_s": round(
+                sorted(disruption)[len(disruption) // 2], 3),
+            "aot_hits_total": sum(r["aot_hits"] for r in rz_warm_rows),
+            "recompiles_total": sum(
+                r["recompiles"] for r in rz_warm_rows),
+        }))
+    finally:
+        shutil.rmtree(aot_root, ignore_errors=True)
 
     # seeded domain outage mid storm (ISSUE 16): circuits + retry
     # budget + mass-forget — time-to-reroute, retry amplification,

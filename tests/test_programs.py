@@ -1,0 +1,563 @@
+"""AOT program-artifact cache (serving/programs.py) — ISSUE 17.
+
+The compile wall behind cold start, scale-from-zero and resize is paid
+once per (model, degree, rung) cluster-wide: warmed programs persist as
+manifest-verified on-disk artifacts and later boots load them instead
+of compiling.  Pinned here:
+
+- the STORE: atomic publish (payload fsync -> manifest fsync -> rename),
+  size+sha256 verification on load, torn/corrupt entries detected,
+  counted, deleted and degraded to a normal compile — never a crash;
+- PARITY: greedy decode is bit-identical cache-off vs cache-on-cold vs
+  cache-on-warm across engine variants, with ``jit_recompiles_total ==
+  0`` and a clean block ledger on the warm path;
+- seeded CHAOS: ``FaultPlan.spill_torn`` tears a just-published
+  artifact; the next boot detects it at load and recompiles;
+- the CONF-FREEZE contract: bad ``aot:`` knobs are ONE Failed status
+  (the PR 4/7/9 convention), validated by ``validate_aot``;
+- the warmup TRACE: ``engine.warmup`` phase with per-family
+  compile/artifact-load spans on /traces, ``kft_aot_cache_*`` counters
+  on /metrics, and a promtool-lint-clean scrape;
+- the autoscaler's warm-path cold-start EWMA (``note_cold_start``
+  tagged with the cache outcome).
+"""
+
+import json
+import os
+import time
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from kubeflow_tpu.chaos import FaultPlan
+from kubeflow_tpu.models import llama as llamalib
+from kubeflow_tpu.serving.continuous import ContinuousEngine
+from kubeflow_tpu.serving.programs import (
+    ARTIFACT_MANIFEST,
+    PAYLOAD_NAME,
+    ProgramArtifactCache,
+    build_program_cache,
+    cache_key_base,
+    model_fingerprint,
+    validate_aot,
+)
+
+
+@pytest.fixture(scope="module")
+def tiny_llama():
+    cfg = llamalib.tiny()
+    model = llamalib.Llama(cfg)
+    params = model.init(jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32))
+    return cfg, params["params"]
+
+
+def make_engine(tiny_llama, **kw):
+    cfg, params = tiny_llama
+    kw.setdefault("num_slots", 2)
+    kw.setdefault("decode_chunk", 2)
+    kw.setdefault("prefix_cache", False)
+    kw.setdefault("block_size", 16)
+    return ContinuousEngine(cfg, params, **kw)
+
+
+# -- keys -----------------------------------------------------------------
+
+
+class TestKeys:
+    def test_fingerprint_ignores_weight_values(self, tiny_llama):
+        """Two checkpoints of one architecture share a program ladder:
+        weights are runtime inputs to the executable, not HLO."""
+        cfg, params = tiny_llama
+        doubled = jax.tree_util.tree_map(lambda x: x * 2, params)
+        assert model_fingerprint(cfg, params) == \
+            model_fingerprint(cfg, doubled)
+
+    def test_fingerprint_sees_architecture(self, tiny_llama):
+        cfg, params = tiny_llama
+        cfg2 = llamalib.tiny(num_heads=8, num_kv_heads=8)
+        params2 = llamalib.Llama(cfg2).init(
+            jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32))["params"]
+        assert model_fingerprint(cfg, params) != \
+            model_fingerprint(cfg2, params2)
+
+    def test_key_base_varies_with_program_shaping_knobs(self, tiny_llama):
+        cfg, params = tiny_llama
+        a = cache_key_base(cfg, params, chunk=1)
+        b = cache_key_base(cfg, params, chunk=2)
+        assert a != b
+        assert jax.__version__ in a  # a jax upgrade invalidates cleanly
+
+    def test_entry_key_separates_families_and_sigs(self):
+        k = ProgramArtifactCache.entry_key
+        assert k("b", "decode", "s1") != k("b", "prefill", "s1")
+        assert k("b", "decode", "s1") != k("b", "decode", "s2")
+        assert k("b", "decode", "s1") == k("b", "decode", "s1")
+
+
+# -- the store ------------------------------------------------------------
+
+
+class TestArtifactStore:
+    def test_publish_verify_load_roundtrip(self, tmp_path):
+        c = ProgramArtifactCache(str(tmp_path))
+        key = c.entry_key("base", "decode", "sig")
+        assert c.load(key) is None  # empty: no entry, no failure count
+        payload = os.urandom(4096)
+        assert c.publish(key, payload, meta={"family": "decode"})
+        assert c.verify(key)
+        assert c.load(key) == payload
+        st = c.stats()
+        assert st["aot_cache_published_total"] == 1
+        assert st["aot_cache_entries"] == 1
+        assert st["aot_cache_bytes"] == 4096
+        assert st["aot_cache_bytes_written_total"] == 4096
+        assert st["aot_cache_bytes_read_total"] == 4096
+        assert st["aot_cache_load_failures_total"] == 0
+
+    def test_duplicate_publish_is_idempotent(self, tmp_path):
+        c = ProgramArtifactCache(str(tmp_path))
+        key = c.entry_key("base", "decode", "sig")
+        assert c.publish(key, b"x" * 64)
+        assert c.publish(key, b"x" * 64)  # first writer already won
+        assert c.stats()["aot_cache_published_total"] == 1
+        assert c.stats()["aot_cache_entries"] == 1
+
+    def test_torn_payload_detected_counted_removed(self, tmp_path):
+        """The acceptance bar verbatim: a torn entry is DETECTED and
+        falls back to normal compile (load -> None), never a crash —
+        and the deleted entry is republishable."""
+        c = ProgramArtifactCache(str(tmp_path))
+        key = c.entry_key("base", "decode", "sig")
+        payload = os.urandom(1024)
+        assert c.publish(key, payload)
+        with open(os.path.join(str(tmp_path), key, PAYLOAD_NAME),
+                  "r+b") as f:
+            f.truncate(1024 - 7)
+        assert c.load(key) is None
+        assert c.stats()["aot_cache_load_failures_total"] == 1
+        assert not c.verify(key)  # the offending entry was removed
+        assert c.publish(key, payload)  # and can be replaced
+        assert c.load(key) == payload
+
+    def test_corrupt_payload_bytes_detected(self, tmp_path):
+        """Right size, wrong bytes: the sha256 check catches silent
+        corruption the size check cannot."""
+        c = ProgramArtifactCache(str(tmp_path))
+        key = c.entry_key("base", "decode", "sig")
+        assert c.publish(key, b"a" * 256)
+        with open(os.path.join(str(tmp_path), key, PAYLOAD_NAME),
+                  "r+b") as f:
+            f.seek(100)
+            f.write(b"Z")
+        assert c.load(key) is None
+        assert c.stats()["aot_cache_load_failures_total"] == 1
+
+    def test_corrupt_manifest_detected(self, tmp_path):
+        c = ProgramArtifactCache(str(tmp_path))
+        key = c.entry_key("base", "decode", "sig")
+        assert c.publish(key, b"y" * 128)
+        with open(os.path.join(str(tmp_path), key, ARTIFACT_MANIFEST),
+                  "w") as f:
+            f.write("{not json")
+        assert c.load(key) is None
+        assert not c.verify(key)
+
+    def test_stale_staging_swept_fresh_kept(self, tmp_path):
+        """A crashed publisher's staging dir is garbage-collected at
+        the next publish of the same key; a LIVE publisher's staging
+        dir (recent mtime) survives the sweep."""
+        c = ProgramArtifactCache(str(tmp_path))
+        key = c.entry_key("base", "decode", "sig")
+        stale = tmp_path / f".staging-{key}-999-deadbeef"
+        fresh = tmp_path / f".staging-{key}-998-cafecafe"
+        stale.mkdir()
+        fresh.mkdir()
+        old = time.time() - 7200.0
+        os.utime(str(stale), (old, old))
+        assert c.publish(key, b"z" * 32)
+        assert not stale.exists()
+        assert fresh.exists()
+        assert c.entries() == [key]  # dot-dirs never listed as entries
+
+    def test_chaos_torn_seam_fires_on_publish(self, tmp_path):
+        """The KvSpillStore seam, one tier up: ``spill_torn`` tears the
+        just-published artifact's tail, so the entry exists with an
+        intact manifest but a payload that no longer verifies."""
+        plan = FaultPlan(seed=7).spill_torn(64)
+        c = ProgramArtifactCache(str(tmp_path), chaos=plan)
+        key = c.entry_key("base", "decode", "sig")
+        assert c.publish(key, os.urandom(512))
+        assert os.path.exists(
+            os.path.join(str(tmp_path), key, ARTIFACT_MANIFEST))
+        assert c.load(key) is None  # detected, counted, removed
+        assert c.stats()["aot_cache_load_failures_total"] == 1
+
+
+# -- conf-freeze ----------------------------------------------------------
+
+
+class TestValidateAot:
+    def test_good_specs_pass(self, tmp_path):
+        validate_aot({"root": str(tmp_path)})
+        validate_aot({"root": str(tmp_path), "fsync": False})
+
+    @pytest.mark.parametrize("spec,needle", [
+        (["/tmp/x"], "mapping"),
+        ({"root": ""}, "root"),
+        ({"root": str, "fsync": True}, "root"),
+        ({"root": "/tmp/x", "fsync": "yes"}, "fsync"),
+        ({"root": "/tmp/x", "rot": "/tmp/y"}, "unknown"),
+    ])
+    def test_bad_knobs_raise_with_the_knob_named(self, spec, needle):
+        with pytest.raises((TypeError, ValueError), match=needle):
+            validate_aot(spec)
+
+    def test_build_program_cache_seam(self, tmp_path):
+        assert build_program_cache(None) is None
+        assert build_program_cache({}) is None
+        c = build_program_cache({"aot": {"root": str(tmp_path),
+                                         "fsync": False}})
+        assert isinstance(c, ProgramArtifactCache)
+        assert c.fsync is False
+        with pytest.raises(ValueError):
+            build_program_cache({"aot": {"root": 3}})
+
+    def test_bad_aot_knobs_are_one_failed_status(self):
+        """The conf-freeze contract end-to-end: a bad ``aot:`` block is
+        ONE Failed status with the knob named, not a replica exploding
+        at load (the PR 4/7/9 convention)."""
+        from kubeflow_tpu.api.common import ObjectMeta
+        from kubeflow_tpu.api.inference import (
+            ComponentSpec,
+            InferenceService,
+            InferenceServicePhase,
+            InferenceServiceSpec,
+            ModelFormat,
+        )
+        from kubeflow_tpu.controlplane.cluster import Cluster
+
+        cases = {
+            "bad-aot-type": {"aot": ["/cache"]},
+            "bad-aot-root": {"aot": {"root": ""}},
+            "bad-aot-fsync": {"aot": {"root": "/cache", "fsync": 1}},
+            "bad-aot-key": {"aot": {"root": "/cache", "roots": "/x"}},
+        }
+        with Cluster() as cluster:
+            cluster.add_tpu_slice("slice-0", 1, 4)
+            cluster.enable_serving()
+            for name, cfg in cases.items():
+                cluster.store.create(InferenceService(
+                    metadata=ObjectMeta(name=name),
+                    spec=InferenceServiceSpec(predictor=ComponentSpec(
+                        model_format=ModelFormat(name="llama-continuous"),
+                        config={"params_ref": "mem://never-fetched",
+                                **cfg}))))
+            for name in cases:
+                deadline = time.time() + 20
+                isvc = None
+                while time.time() < deadline:
+                    isvc = cluster.store.try_get("InferenceService", name)
+                    if (isvc is not None and isvc.status.phase
+                            == InferenceServicePhase.FAILED):
+                        break
+                    time.sleep(0.05)
+                assert isvc is not None
+                assert isvc.status.phase == InferenceServicePhase.FAILED, \
+                    (name, isvc.status)
+                assert "aot" in (isvc.status.message or ""), \
+                    (name, isvc.status.message)
+
+
+# -- engine parity --------------------------------------------------------
+
+
+VARIANTS = {
+    # chunked prefill + paged pool: the serving default
+    "chunked_paged": dict(decode_chunk=2, block_size=16),
+    # speculative decode rides the verify/fused-verify rungs
+    "spec": dict(decode_chunk=1, block_size=16, spec_k=2),
+}
+
+
+class TestEngineParity:
+    @pytest.mark.parametrize("variant", sorted(VARIANTS))
+    def test_warm_boot_bit_identical_with_zero_recompiles(
+            self, tiny_llama, tmp_path, variant):
+        """The headline parity bar: greedy output is bit-identical
+        cache-off vs cache-on-cold (publishes) vs cache-on-warm (loads
+        everything), the warm boot is all hits / zero misses, and the
+        recompiles==0 + zero-leak ledgers hold throughout."""
+        kw = VARIANTS[variant]
+        prompts = [[1, 2, 3], [4, 5, 6, 7, 8]]
+
+        off = make_engine(tiny_llama, **kw)
+        try:
+            off.warmup()
+            want = [off.generate(p, max_new_tokens=6) for p in prompts]
+            st = off.stats()
+            # cache-off engines still expose the counter family (all
+            # zero) so dashboards never see a hole
+            assert st["aot_cache_hits_total"] == 0
+            assert st["aot_cache_misses_total"] == 0
+            assert st["jit_recompiles_total"] == 0
+        finally:
+            off.stop()
+
+        cold_cache = ProgramArtifactCache(str(tmp_path), fsync=False)
+        cold = make_engine(tiny_llama, program_cache=cold_cache, **kw)
+        try:
+            cold.warmup()
+            got_cold = [cold.generate(p, max_new_tokens=6)
+                        for p in prompts]
+            st = cold.stats()
+            assert st["aot_cache_misses_total"] > 0
+            assert st["aot_cache_published_total"] \
+                == st["aot_cache_misses_total"]
+            assert st["aot_cache_hits_total"] == 0
+            assert st["jit_recompiles_total"] == 0
+            assert st["kv_blocks_leaked_total"] == 0
+        finally:
+            cold.stop()
+
+        warm_cache = ProgramArtifactCache(str(tmp_path), fsync=False)
+        warm = make_engine(tiny_llama, program_cache=warm_cache, **kw)
+        try:
+            warm.warmup()
+            got_warm = [warm.generate(p, max_new_tokens=6)
+                        for p in prompts]
+            st = warm.stats()
+            assert st["aot_cache_hits_total"] > 0
+            assert st["aot_cache_misses_total"] == 0, st
+            assert st["jit_recompiles_total"] == 0
+            assert st["kv_blocks_leaked_total"] == 0
+        finally:
+            warm.stop()
+
+        assert got_cold == want, variant
+        assert got_warm == want, variant
+
+    def test_tp_warm_boot_matches_cold(self, tmp_path):
+        """Gang parity, in-process: a TP=2 engine warmed from the
+        artifacts a prior TP=2 engine published produces bit-identical
+        greedy output with zero misses — exactly what gang followers do
+        against the leader's shared root."""
+        cfg = llamalib.tiny(num_heads=8, num_kv_heads=8)
+        params = llamalib.Llama(cfg).init(
+            jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32))["params"]
+        kw = dict(num_slots=2, decode_chunk=2, prefix_cache=False,
+                  block_size=16, seq_buckets=[32],
+                  mesh_axes={"model": 2})
+        leader = ContinuousEngine(
+            cfg, params,
+            program_cache=ProgramArtifactCache(str(tmp_path),
+                                               fsync=False), **kw)
+        try:
+            leader.warmup()
+            want = leader.generate([1, 2, 3], max_new_tokens=6)
+        finally:
+            leader.stop()
+
+        follower = ContinuousEngine(
+            cfg, params,
+            program_cache=ProgramArtifactCache(str(tmp_path),
+                                               fsync=False), **kw)
+        try:
+            follower.warmup()
+            st = follower.stats()
+            assert st["aot_cache_hits_total"] > 0
+            assert st["aot_cache_misses_total"] == 0, st
+            assert follower.generate([1, 2, 3], max_new_tokens=6) == want
+            assert follower.stats()["jit_recompiles_total"] == 0
+        finally:
+            follower.stop()
+
+    def test_torn_artifact_degrades_to_compile(self, tiny_llama,
+                                               tmp_path):
+        """Seeded chaos end-to-end: a publish-time tear (spill_torn)
+        leaves one artifact torn on disk; the next boot DETECTS it at
+        load, recompiles that rung, republishes, and serves identical
+        tokens — never a crash."""
+        kw = dict(decode_chunk=2, block_size=16)
+        plan = FaultPlan(seed=3).spill_torn()
+        seeder_cache = ProgramArtifactCache(str(tmp_path), fsync=False,
+                                            chaos=plan)
+        seeder = make_engine(tiny_llama, program_cache=seeder_cache,
+                             **kw)
+        try:
+            seeder.warmup()
+            want = seeder.generate([1, 2, 3], max_new_tokens=6)
+            published = seeder_cache.stats()[
+                "aot_cache_published_total"]
+            assert published > 0
+        finally:
+            seeder.stop()
+
+        cache = ProgramArtifactCache(str(tmp_path), fsync=False)
+        eng = make_engine(tiny_llama, program_cache=cache, **kw)
+        try:
+            eng.warmup()
+            st = eng.stats()
+            # exactly one rung was torn: detected + recompiled, the
+            # rest loaded clean
+            assert st["aot_cache_load_failures_total"] == 1, st
+            assert st["aot_cache_misses_total"] == 1, st
+            assert st["aot_cache_hits_total"] == published - 1, st
+            assert st["aot_cache_published_total"] == 1  # replaced
+            assert eng.generate([1, 2, 3], max_new_tokens=6) == want
+            assert eng.stats()["jit_recompiles_total"] == 0
+            assert eng.stats()["kv_blocks_leaked_total"] == 0
+        finally:
+            eng.stop()
+
+
+# -- warmup trace + /metrics exposition -----------------------------------
+
+
+def _get(url: str, timeout: float = 30.0) -> str:
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.read().decode()
+
+
+class TestWarmupObservability:
+    def test_warmup_trace_and_aot_metrics_on_server(self, tiny_llama,
+                                                    tmp_path):
+        """Satellite 2 + the exposition lint: the ``engine.warmup``
+        trace (per-family compile/artifact-load spans) lands on
+        /traces, its phase feeds ``kft_phase_seconds``, and the
+        ``kft_aot_cache_*`` counters ride /metrics promtool-clean."""
+        from tests.test_observability import prom_lint
+
+        from kubeflow_tpu.serving.server import ModelServer
+        from kubeflow_tpu.serving.storage import register_mem
+        from kubeflow_tpu.serving.text import TextGenerator
+
+        ref = register_mem("aot-observability", tiny_llama)
+        srv = ModelServer()
+        srv.register(TextGenerator("m", {
+            "params_ref": ref, "tokenizer": "bytes",
+            "num_slots": 2, "decode_chunk": 2, "block_size": 16,
+            "max_new_tokens": 4,
+            "aot": {"root": str(tmp_path), "fsync": False},
+            "tracing": {"sample": 1.0, "ring": 8},
+        }))
+        srv.start()
+        try:
+            deadline = time.time() + 10
+            rows = []
+            while time.time() < deadline and not rows:
+                rows = [json.loads(ln) for ln in _get(
+                    srv.url + "/traces").splitlines()]
+                time.sleep(0.05)
+            warm = [r for r in rows
+                    if r.get("root", {}).get("name") == "warmup"]
+            assert warm, rows
+            tr = warm[0]
+            assert [p["name"] for p in tr["phases"]] == ["engine.warmup"]
+            # per-family rung spans: every span is a compile or an
+            # artifact load, tagged with its program family
+            assert tr["spans"], tr
+            assert all(s["name"] in ("warmup.compile", "warmup.aot.load")
+                       for s in tr["spans"])
+            assert all(s["attrs"].get("family") for s in tr["spans"])
+            # cold root: every rung compiled + published
+            assert tr["meta"]["aot_misses"] > 0
+            assert tr["meta"]["aot_hits"] == 0
+
+            text = _get(srv.url + "/metrics")
+            assert 'kft_aot_cache_misses_total{model="m"}' in text
+            assert 'kft_aot_cache_hits_total{model="m"} 0' in text
+            assert 'kft_aot_cache_bytes{model="m"}' in text
+            assert ('kft_phase_seconds_count{model="m",'
+                    'phase="engine.warmup"} 1') in text
+            assert prom_lint(text) == [], prom_lint(text)[:5]
+        finally:
+            srv.stop()
+
+
+# -- the autoscaler's warm-path budget ------------------------------------
+
+
+class TestColdStartWarmEwma:
+    def test_warm_samples_feed_their_own_ewma(self):
+        from kubeflow_tpu.serving.autoscale import (
+            AutoscalePolicy,
+            ClusterAutoscaler,
+        )
+
+        auto = ClusterAutoscaler(AutoscalePolicy(), sensors=dict)
+        auto.note_cold_start(10.0)
+        assert auto.cold_start_s == pytest.approx(10.0)
+        assert auto.cold_start_warm_s == 0.0  # untouched by cold builds
+        auto.note_cold_start(2.0, warm=True)
+        # the warm sample feeds BOTH: the blended EWMA stays the
+        # worst-case ledger, the warm EWMA becomes the gate's budget
+        assert auto.cold_start_warm_s == pytest.approx(2.0)
+        assert auto.cold_start_s < 10.0
+        s = auto.stats()
+        assert s["autoscale_cold_start_warm_s"] == pytest.approx(2.0)
+        assert any(ln.startswith("kft_autoscale_cold_start_warm_s")
+                   for ln in auto.metrics_lines())
+
+    def test_gate_prefers_the_warm_budget_once_measured(self, monkeypatch):
+        """Scale-to-zero is held to the budget the next wake will
+        actually pay: after one warm-tagged sample, ``tick`` fills the
+        cold_start_s signal from the warm EWMA.  tick() copies the
+        sensor dict, so observe the signal decide() actually sees."""
+        from kubeflow_tpu.serving import autoscale as asl
+
+        seen = []
+        real_decide = asl.decide
+
+        def spy(sig, policy):
+            seen.append(dict(sig))
+            return real_decide(sig, policy)
+
+        monkeypatch.setattr(asl, "decide", spy)
+        sensors = lambda: {"replicas": 1, "min_replicas": 0,
+                           "max_replicas": 2, "util": 1.0}
+        auto = asl.ClusterAutoscaler(asl.AutoscalePolicy(), sensors=sensors)
+        auto.note_cold_start(30.0)
+        auto.tick(now=1.0)
+        assert seen[-1]["cold_start_s"] == pytest.approx(30.0)
+        auto.note_cold_start(2.0, warm=True)
+        auto.tick(now=2.0)
+        assert seen[-1]["cold_start_s"] == pytest.approx(2.0)
+
+    def test_controller_wake_warm_derivation(self):
+        """``_wake_was_warm``: warm iff every engine that exposes the
+        cache counters booted all-hits/no-misses; cache-off fleets and
+        any compiling replica stay on the cold budget."""
+        from kubeflow_tpu.serving.controller import (
+            InferenceServiceController,
+        )
+
+        class _Eng:
+            def __init__(self, st):
+                self._st = st
+
+            def stats(self):
+                return self._st
+
+        class _Srv:
+            def __init__(self, *stats):
+                self._e = {f"m{i}": _Eng(s)
+                           for i, s in enumerate(stats)}
+
+            def engines(self):
+                return self._e
+
+        warm = _Srv({"aot_cache_hits_total": 5,
+                     "aot_cache_misses_total": 0})
+        cold = _Srv({"aot_cache_hits_total": 0,
+                     "aot_cache_misses_total": 5})
+        mixed = _Srv({"aot_cache_hits_total": 3,
+                      "aot_cache_misses_total": 2})
+        nocache = _Srv({"jit_recompiles_total": 0})
+        fn = InferenceServiceController._wake_was_warm
+        assert fn([warm]) is True
+        assert fn([cold]) is False
+        assert fn([mixed]) is False
+        assert fn([nocache]) is False  # no cache anywhere: cold budget
+        assert fn([warm, cold]) is False  # one compiling replica gates
