@@ -13,14 +13,31 @@ This package enforces those invariants as code:
   run the rule set, compare against the ratchet baseline
   (``baseline.json``): existing findings are frozen debt, NEW findings
   fail tier-1 (``tests/test_analysis.py``).
+- :mod:`.callgraph` — the cross-module call-graph + effect-propagation
+  engine (ISSUE 18): ``from .x import y`` / ``self._helper()`` / MRO /
+  attribute-typed edges across every parsed file, per-function effect
+  sets (host-sync, socket, sleep, fsync, lock, unguarded jit
+  construction) at a cycle-safe fixpoint, reachability queries for
+  rules.  Dynamic calls degrade to no-edge — under-approximate, never
+  crash.
 - :mod:`.rules_dispatch` — ``host-sync-in-dispatch`` (a ``.item()`` /
   ``device_get`` / ``np.asarray`` reachable from the engine's dispatch
-  loop stalls the device queue) and ``jit-in-loop`` (program
-  construction inside a loop body is a recompile treadmill).
+  loop stalls the device queue — transitively, in whatever module the
+  helper lives) and ``jit-in-loop`` (program construction inside a
+  loop body — or reached unguarded from one — is a recompile
+  treadmill).
 - :mod:`.rules_locks` — ``lock-order``: the global ``with <lock>:``
   nesting graph across serving/controlplane/hpo/net; cycles are
   deadlocks waiting for a chaos schedule, and blocking calls (sleep,
   socket ops, jax fetches) under a lock are convoy generators.
+  ``lock-blocking-call`` completes the direct-site check transitively:
+  blocking effects *reachable* through call edges while the lock is
+  held, flagged with the terminal site named.
+- :mod:`.rules_persist` — ``torn-write``: the crash-safety commit
+  protocol (tmp write -> flush+fsync -> ``os.replace``, dir-fsync at
+  manifest commit points) as a ratchet over the persistence modules;
+  bare final-name writes, rename-without-fsync, and
+  fsync-after-rename orderings are findings.
 - :mod:`.rules_hygiene` — ``swallowed-exception`` (every ``except
   Exception`` must log, re-raise, or carry a justification),
   ``unsafe-pickle`` (pickle ingestion outside the post-auth gang replay
@@ -59,8 +76,10 @@ honored too — hpo/controllers.py's db-retry sites are the exemplar.
 Run it: ``python -m kubeflow_tpu.analysis`` (or
 ``scripts/platform_lint.py``); ``--update-baseline`` re-freezes debt
 after an intentional change; ``--json`` emits machine-readable
-findings; ``--rule`` accepts rule names or group aliases (``threads``,
-``protocol``, ``locks``, ``dispatch``, ``hygiene``); ``--self-test``
+findings with timing; ``--changed`` scopes the report (not the parse)
+to your git diff; ``--rule`` accepts rule names or group aliases
+(``threads``, ``protocol``, ``locks``, ``dispatch``, ``hygiene``,
+``persist``); ``--self-test``
 validates the rules against their own fixtures.  Exit codes: 0 = clean
 (or self-test green), 1 = NEW findings above the ratchet baseline (or
 a failed fixture), 2 = usage error.

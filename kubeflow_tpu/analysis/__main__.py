@@ -8,8 +8,13 @@ Modes:
   --baseline PATH      compare/write a non-default baseline file
   --rule NAME          run a subset: a rule name OR a group alias
                        (``threads`` -> thread-affinity, ``protocol`` ->
-                       op-table + fault-pairing, ``locks``, ``dispatch``,
-                       ``hygiene``); repeatable
+                       op-table + fault-pairing, ``locks`` -> lock-order
+                       + lock-blocking-call, ``persist`` -> torn-write,
+                       ``dispatch``, ``hygiene``); repeatable
+  --changed            parse the WHOLE platform (the call graph needs
+                       every module) but report only findings in files
+                       changed vs HEAD (+ untracked) — the fast
+                       pre-commit loop
   --all                list every finding, not just the new ones
   --self-test          run the built-in rule fixtures (selftest.py) —
                        the lint binary validating itself, no pytest
@@ -27,9 +32,12 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import subprocess
 import sys
+import time
 
 from .astlint import (
+    LintReport,
     baseline_path,
     compare_to_baseline,
     load_baseline,
@@ -44,10 +52,11 @@ RULE_GROUPS: dict[str, tuple[str, ...]] = {
     "dispatch": ("host-sync-in-dispatch", "jit-in-loop"),
     "hygiene": ("swallowed-exception", "unsafe-pickle",
                 "nondaemon-thread"),
-    "locks": ("lock-order",),
+    "locks": ("lock-order", "lock-blocking-call"),
     "threads": ("thread-affinity",),
     "protocol": ("op-table", "fault-pairing"),
     "metrics": ("metrics-contract",),
+    "persist": ("torn-write",),
 }
 
 
@@ -70,6 +79,23 @@ def repo_root() -> str:
         os.path.abspath(__file__))))
 
 
+def changed_paths(root: str) -> set[str]:
+    """Repo-relative paths changed vs HEAD plus untracked files, from
+    git.  Returns empty (-> report nothing) when git is unavailable:
+    the --changed mode is a convenience filter, never a gate."""
+    out: set[str] = set()
+    for cmd in (("git", "diff", "--name-only", "HEAD"),
+                ("git", "ls-files", "--others", "--exclude-standard")):
+        try:
+            res = subprocess.run(cmd, cwd=root, capture_output=True,
+                                 text=True, check=True)
+        except (OSError, subprocess.CalledProcessError):
+            continue
+        out.update(ln.strip() for ln in res.stdout.splitlines()
+                   if ln.strip())
+    return out
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m kubeflow_tpu.analysis",
@@ -90,6 +116,10 @@ def main(argv=None) -> int:
                     help="run only this rule or group alias "
                          "(threads, protocol, locks, dispatch, hygiene; "
                          "repeatable)")
+    ap.add_argument("--changed", action="store_true",
+                    help="report only findings in files changed vs HEAD "
+                         "(+ untracked); the full platform is still "
+                         "parsed so cross-module effects stay visible")
     ap.add_argument("--all", action="store_true",
                     help="print every finding, not only new ones")
     ap.add_argument("--self-test", action="store_true", dest="self_test",
@@ -100,13 +130,13 @@ def main(argv=None) -> int:
     rules = resolve_rules(args.rule)
     if args.self_test:
         if (args.paths or args.baseline or args.update_baseline
-                or args.as_json or args.all):
+                or args.as_json or args.all or args.changed):
             # the fixtures lint synthetic sources, not the repo: a
             # --json/--baseline caller would get fixture chatter + exit
             # 0 where it expects the documented lint contract
             ap.error("--self-test runs the built-in fixtures only; it "
                      "is incompatible with paths, --baseline, "
-                     "--update-baseline, --json, and --all "
+                     "--update-baseline, --json, --all, and --changed "
                      "(--rule filters which fixtures run)")
         from .selftest import run_selftest
         return run_selftest(rules=rules)
@@ -114,13 +144,24 @@ def main(argv=None) -> int:
     root = os.path.abspath(args.root) if args.root else repo_root()
     bpath = args.baseline or baseline_path(root)
     paths = [os.path.abspath(p) for p in args.paths] or None
-    if args.update_baseline and (paths or args.rule):
+    if args.update_baseline and (paths or args.rule or args.changed):
         # a subset lint would OVERWRITE the baseline with only the
         # subset's findings, silently erasing every other frozen entry —
         # the next full run then fails tier-1 on debt nobody added
         ap.error("--update-baseline requires a full lint "
-                 "(no positional paths, no --rule)")
+                 "(no positional paths, no --rule, no --changed)")
+    if args.changed and paths:
+        ap.error("--changed derives its scope from git; positional "
+                 "paths would fight it — pass one or the other")
+    t0 = time.perf_counter()
     report = run_lint(root, paths=paths, rules=rules)
+    elapsed_s = round(time.perf_counter() - t0, 3)
+    scope_note = ""
+    if args.changed:
+        changed = changed_paths(root)
+        report = LintReport([f for f in report.findings
+                             if f.path in changed])
+        scope_note = f" [--changed: {len(changed)} files in scope]"
 
     if args.update_baseline:
         doc = write_baseline(bpath, report)
@@ -141,6 +182,8 @@ def main(argv=None) -> int:
             "by_rule": report.by_rule(),
             "baseline_total": sum(baseline.values()),
             "new": [vars(f) for f in new],
+            "elapsed_s": elapsed_s,
+            "changed_only": bool(args.changed),
         }, indent=1))
     else:
         shown = report.findings if args.all else new
@@ -148,7 +191,8 @@ def main(argv=None) -> int:
             print(f)
         print(f"platform_lint: {len(report.findings)} findings "
               f"({report.by_rule() or 'clean'}), "
-              f"{sum(baseline.values())} baselined, {len(new)} NEW")
+              f"{sum(baseline.values())} baselined, {len(new)} NEW "
+              f"in {elapsed_s}s{scope_note}")
         if new:
             print("new findings above the ratchet baseline — fix them, "
                   "pragma them with a reason, or (for reviewed debt) "

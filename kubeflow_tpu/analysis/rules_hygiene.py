@@ -68,9 +68,8 @@ def _body_handles(handler: ast.ExceptHandler) -> bool:
 @rule("swallowed-exception")
 def swallowed_exception(ctx: LintContext) -> Iterable[Finding]:
     for pf in ctx.files.values():
-        for node in ast.walk(pf.tree):
-            if not (isinstance(node, ast.ExceptHandler)
-                    and _is_blanket(node)):
+        for node in pf.of_type(ast.ExceptHandler):
+            if not _is_blanket(node):
                 continue
             if _body_handles(node):
                 continue
@@ -106,8 +105,8 @@ def _is_pickle_load(call: ast.Call) -> bool:
 @rule("unsafe-pickle")
 def unsafe_pickle(ctx: LintContext) -> Iterable[Finding]:
     for pf in ctx.files.values():
-        for node in ast.walk(pf.tree):
-            if not (isinstance(node, ast.Call) and _is_pickle_load(node)):
+        for node in pf.of_type(ast.Call):
+            if not _is_pickle_load(node):
                 continue
             scope = pf.scope_at(node.lineno)
             if (pf.relpath, scope) in PICKLE_ALLOWLIST:
@@ -141,8 +140,8 @@ def _daemon_kwarg_true(call: ast.Call) -> bool:
 @rule("nondaemon-thread")
 def nondaemon_thread(ctx: LintContext) -> Iterable[Finding]:
     for pf in ctx.files.values():
-        for node in ast.walk(pf.tree):
-            if not (isinstance(node, ast.Call) and _is_thread_ctor(node)):
+        for node in pf.of_type(ast.Call):
+            if not _is_thread_ctor(node):
                 continue
             if _daemon_kwarg_true(node):
                 continue

@@ -38,6 +38,7 @@ import os
 from typing import Iterable, Optional
 
 from .astlint import Finding, LintContext, ParsedFile, rule
+from .callgraph import BLOCKING_EFFECTS, LIFECYCLE_METHODS, get_graph
 from .rules_dispatch import _dotted, walk_skip_defs
 
 #: layers whose locking interacts (the cross-component deadlock surface)
@@ -91,9 +92,7 @@ def _enclosing_class(pf: ParsedFile, line: int) -> str:
 
 def _iter_with_locks(pf: ParsedFile):
     """Every (lock-name, With-node) in the file, lexical."""
-    for node in ast.walk(pf.tree):
-        if not isinstance(node, (ast.With, ast.AsyncWith)):
-            continue
+    for node in pf.of_type(ast.With, ast.AsyncWith):
         cls = _enclosing_class(pf, node.lineno)
         for item in node.items:
             name = _lock_name(item.context_expr, pf, cls)
@@ -105,7 +104,7 @@ def _locks_in_body(pf: ParsedFile, node: ast.AST) -> list[tuple[str, ast.With]]:
     """with-lock statements lexically inside ``node``'s body (not
     descending into nested defs — they run on other threads/later)."""
     out = []
-    for child in walk_skip_defs(node):
+    for child in walk_skip_defs(node, pf.children):
         if not isinstance(child, (ast.With, ast.AsyncWith)):
             continue
         cls = _enclosing_class(pf, child.lineno)
@@ -117,23 +116,11 @@ def _locks_in_body(pf: ParsedFile, node: ast.AST) -> list[tuple[str, ast.With]]:
 
 
 def _function_index(pf: ParsedFile) -> dict[str, ast.AST]:
-    """(class, name) and bare-name keyed defs for 1-level call lookup."""
+    """(class, name) and bare-name keyed defs for 1-level call lookup,
+    read off the parse-time def table (no re-recursion)."""
     idx: dict[str, ast.AST] = {}
-
-    def visit(node, stack):
-        for child in ast.iter_child_nodes(node):
-            if isinstance(child, ast.ClassDef):
-                visit(child, stack + [child.name])
-            elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                if stack:
-                    idx[f"{stack[0]}.{child.name}"] = child
-                else:
-                    idx[child.name] = child
-                visit(child, stack)
-            else:
-                visit(child, stack)
-
-    visit(pf.tree, [])
+    for node, _qual, _inner, outer, _top in pf.defs:
+        idx[f"{outer}.{node.name}" if outer else node.name] = node
     return idx
 
 
@@ -185,7 +172,7 @@ def collect_lock_graph(ctx: LintContext) -> tuple[
     for pf in scoped:
         fidx = _function_index(pf)
         for outer_name, outer_node in _iter_with_locks(pf):
-            body = list(walk_skip_defs(outer_node))
+            body = list(walk_skip_defs(outer_node, pf.children))
             # direct lexical nesting
             for inner_name, inner_node in _locks_in_body(pf, outer_node):
                 if inner_name != outer_name:
@@ -276,3 +263,65 @@ def lock_order(ctx: LintContext) -> Iterable[Finding]:
             "lock-order cycle: " + " -> ".join(cycle + [cycle[0]]))
         if f:
             yield f
+
+
+#: effect -> human label for the lock-blocking-call message
+_EFFECT_LABELS = {
+    "sleep": "`time.sleep`",
+    "socket": "blocking socket I/O",
+    "host-sync": "a device sync/fetch",
+    "fsync": "`os.fsync`",
+    "urlopen": "`urlopen`",
+    "thread-join": "thread `.join`",
+}
+
+
+@rule("lock-blocking-call")
+def lock_blocking_call(ctx: LintContext) -> Iterable[Finding]:
+    """No blocking I/O or device sync REACHABLE while a Lock/RLock/
+    Condition is held — the transitive completion of lock-order's
+    direct-site check.  ``with self._lock: self._flush()`` is invisible
+    to lock-order when ``_flush`` fsyncs (or its callee three modules
+    away does); this rule joins the same lexical lock model to the
+    call-graph effect sets, so the convoy — every thread needing the
+    lock waiting on disk/network/device — is flagged wherever the
+    blocking call actually lives.  Direct blocking calls under the
+    ``with`` stay lock-order's finding (one site, one rule); this one
+    fires only through a resolved call edge, and names the terminal
+    site so the fix (or the declaring pragma) lands at the right
+    boundary."""
+    graph = get_graph(ctx)
+    scoped = [pf for rel, pf in sorted(ctx.files.items())
+              if rel.startswith(LOCK_SCOPE_PREFIXES)]
+    for pf in scoped:
+        for lock_name, with_node in _iter_with_locks(pf):
+            scope = pf.scope_at(with_node.lineno)
+            if scope.rsplit(".", 1)[-1] in LIFECYCLE_METHODS:
+                # warmup/__init__/close hold their gate to SERIALIZE a
+                # phase transition — blocking while every other thread
+                # waits is the intended semantics there, and the phase
+                # contract (rules_threads._LIFECYCLE) already owns it
+                continue
+            for child in walk_skip_defs(with_node, pf.children):
+                if not isinstance(child, ast.Call):
+                    continue
+                if _blocking_label(child) is not None:
+                    continue  # direct site: lock-order reports it
+                hit = None
+                for callee in graph.resolve_call(child):
+                    eff = sorted(graph.effects(callee) & BLOCKING_EFFECTS)
+                    if eff:
+                        hit = (callee, eff[0])
+                        break
+                if hit is None:
+                    continue
+                callee, eff = hit
+                site, _label = graph.effect_site(callee, eff) or (callee, "")
+                f = ctx.finding(
+                    pf, "lock-blocking-call", child,
+                    f"call into `{callee}` while holding `{lock_name}` "
+                    f"reaches {_EFFECT_LABELS[eff]} (at `{site}`) — "
+                    "move the blocking work outside the lock or declare "
+                    "the boundary")
+                if f:
+                    yield f

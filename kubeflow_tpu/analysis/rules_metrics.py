@@ -42,8 +42,8 @@ SCOPE_PREFIXES = ("kubeflow_tpu/serving/",)
 
 
 def _stats_functions(pf: ParsedFile):
-    for node in ast.walk(pf.tree):
-        if isinstance(node, ast.FunctionDef) and node.name == "stats":
+    for node in pf.of_type(ast.FunctionDef):
+        if node.name == "stats":
             yield node
 
 

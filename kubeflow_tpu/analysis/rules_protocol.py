@@ -85,9 +85,8 @@ _OP_VARS = frozenset({"op"})
 
 def _published_ops(pf: ParsedFile):
     """(op, Call node) for every ``<x>.publish(("<op>", ...))``."""
-    for node in ast.walk(pf.tree):
-        if not (isinstance(node, ast.Call)
-                and isinstance(node.func, ast.Attribute)
+    for node in pf.of_type(ast.Call):
+        if not (isinstance(node.func, ast.Attribute)
                 and node.func.attr == "publish"
                 and node.args):
             continue
@@ -104,20 +103,22 @@ def _replay_scopes(pf: ParsedFile) -> list[ast.AST]:
     collection to these scopes keeps unrelated locals named ``op`` (the
     inference-graph condition parser's operator strings) out of the
     table."""
-    out = []
-    for node in ast.walk(pf.tree):
-        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            continue
-        for stmt in ast.walk(node):
-            if (isinstance(stmt, ast.Assign)
-                    and len(stmt.targets) == 1
-                    and isinstance(stmt.targets[0], ast.Name)
-                    and stmt.targets[0].id in _OP_VARS
-                    and isinstance(stmt.value, ast.Subscript)
-                    and isinstance(stmt.value.slice, ast.Constant)
-                    and stmt.value.slice.value == 0):
-                out.append(node)
-                break
+    marks = []
+    for stmt in pf.of_type(ast.Assign):
+        if (len(stmt.targets) == 1
+                and isinstance(stmt.targets[0], ast.Name)
+                and stmt.targets[0].id in _OP_VARS
+                and isinstance(stmt.value, ast.Subscript)
+                and isinstance(stmt.value.slice, ast.Constant)
+                and stmt.value.slice.value == 0):
+            marks.append(stmt.lineno)
+    out, seen = [], set()
+    for node, _qual, _inner, _outer, _top in pf.defs:
+        end = getattr(node, "end_lineno", node.lineno)
+        if id(node) not in seen and any(
+                node.lineno <= ln <= end for ln in marks):
+            seen.add(id(node))
+            out.append(node)
     return out
 
 
@@ -205,35 +206,35 @@ def fault_pairing(ctx: LintContext) -> Iterable[Finding]:
     produced: dict[str, list[tuple[ParsedFile, ast.AST]]] = {}
     consumed: dict[str, list[tuple[ParsedFile, ast.AST]]] = {}
     for pf in _scope_files(ctx, CHAOS_SCOPE_PREFIXES):
-        for node in ast.walk(pf.tree):
-            # enum members: assignments inside ``class FaultKind``
-            if isinstance(node, ast.ClassDef) and node.name == "FaultKind":
+        # enum members: assignments inside ``class FaultKind``
+        for node in pf.of_type(ast.ClassDef):
+            if node.name == "FaultKind":
                 for stmt in node.body:
                     if (isinstance(stmt, ast.Assign)
                             and isinstance(stmt.targets[0], ast.Name)):
                         declared.setdefault(
                             stmt.targets[0].id, []).append((pf, stmt))
-            # producers: Fault(FaultKind.X, ...) — the failpoint factories
-            if (isinstance(node, ast.Call)
-                    and isinstance(node.func, ast.Name)
+        # producers: Fault(FaultKind.X, ...) — the failpoint factories
+        for node in pf.of_type(ast.Call):
+            if (isinstance(node.func, ast.Name)
                     and node.func.id == "Fault"):
                 for arg in list(node.args) + [kw.value
                                               for kw in node.keywords]:
                     k = _faultkind_attr(arg)
                     if k:
                         produced.setdefault(k, []).append((pf, node))
-            # consumers: comparisons / membership tests on FaultKind.X
-            if isinstance(node, ast.Compare):
-                sides = [node.left] + list(node.comparators)
-                for s in sides:
-                    k = _faultkind_attr(s)
-                    if k:
-                        consumed.setdefault(k, []).append((pf, node))
-                    elif isinstance(s, (ast.Tuple, ast.List, ast.Set)):
-                        for e in s.elts:
-                            k = _faultkind_attr(e)
-                            if k:
-                                consumed.setdefault(k, []).append((pf, node))
+        # consumers: comparisons / membership tests on FaultKind.X
+        for node in pf.of_type(ast.Compare):
+            sides = [node.left] + list(node.comparators)
+            for s in sides:
+                k = _faultkind_attr(s)
+                if k:
+                    consumed.setdefault(k, []).append((pf, node))
+                elif isinstance(s, (ast.Tuple, ast.List, ast.Set)):
+                    for e in s.elts:
+                        k = _faultkind_attr(e)
+                        if k:
+                            consumed.setdefault(k, []).append((pf, node))
     if not declared and not produced:
         return
     for kind in sorted(set(produced) - set(consumed)):

@@ -56,6 +56,7 @@ import ast
 from typing import Iterable, Optional
 
 from .astlint import Finding, LintContext, ParsedFile, rule
+from .callgraph import get_graph
 from .rules_dispatch import ROOT_METHODS, walk_skip_defs
 
 #: files whose classes carry the serving thread contract
@@ -107,12 +108,16 @@ _LIFECYCLE = frozenset({
 
 class _RoleGraph:
     """Per-file function index + call graph with INNERMOST-class
-    attribution (``rules_dispatch._FileGraph`` attributes nested defs to
-    the outermost class, which misclassifies the nested HTTP ``Handler``
-    classes this rule must see)."""
+    attribution (the cross-module graph resolves more call shapes, but
+    this rule's role model is deliberately file-local — the mailbox
+    seam argument only holds within one engine module).  Indexes come
+    from the parse-time def table; per-function call lists come from
+    the shared :class:`~.callgraph.CallGraph` (one body scan total)."""
 
-    def __init__(self, pf: ParsedFile):
+    def __init__(self, pf: ParsedFile, graph):
         self.pf = pf
+        self.graph = graph
+        self.mod = None  # set below; the file's module name in the graph
         #: qualname -> def node
         self.funcs: dict[str, ast.AST] = {}
         #: qualname -> innermost enclosing class name ('' = module)
@@ -123,38 +128,36 @@ class _RoleGraph:
         self.module_funcs: dict[str, str] = {}
         #: class name -> ClassDef node
         self.classes: dict[str, ast.ClassDef] = {}
-        self._index(pf.tree, [], "")
+        for node, _qual, _inner in pf.classdefs:
+            self.classes[node.name] = node
+        for node, qual, cls, _outer, is_top in pf.defs:
+            self.funcs[qual] = node
+            self.owner[qual] = cls
+            if cls:
+                self.by_class.setdefault(cls, {}).setdefault(
+                    node.name, qual)
+            if is_top:
+                self.module_funcs[node.name] = qual
+        for mod, rel in graph.modules.items():
+            if rel == pf.relpath:
+                self.mod = mod
+                break
         self._callees_cache: dict[str, set[str]] = {}
 
-    def _index(self, node: ast.AST, stack: list[str], cls: str) -> None:
-        for child in ast.iter_child_nodes(node):
-            if isinstance(child, ast.ClassDef):
-                self.classes[child.name] = child
-                self._index(child, stack + [child.name], child.name)
-            elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                qual = ".".join(stack + [child.name])
-                self.funcs[qual] = child
-                self.owner[qual] = cls
-                if cls:
-                    self.by_class.setdefault(cls, {}).setdefault(
-                        child.name, qual)
-                if not stack:
-                    self.module_funcs[child.name] = qual
-                self._index(child, stack + [child.name], cls)
-            else:
-                self._index(child, stack, cls)
-
     def callees(self, qual: str) -> set[str]:
+        """File-local callees of ``qual``'s whole lexical subtree (its
+        own body plus nested defs — a closure handed to a thread runs
+        that thread's code), resolved with this rule's deliberately
+        narrow shapes: bare module functions and ``self.m()``."""
         cached = self._callees_cache.get(qual)
         if cached is not None:
             return cached
-        fn = self.funcs.get(qual)
         out: set[str] = set()
-        if fn is not None:
+        self._callees_cache[qual] = out  # placed first: cycle-safe
+        fi = self.graph.funcs.get(f"{self.mod}::{qual}")
+        if fi is not None:
             cls = self.owner.get(qual, "")
-            for node in ast.walk(fn):
-                if not isinstance(node, ast.Call):
-                    continue
+            for node in fi.calls:
                 f = node.func
                 if isinstance(f, ast.Name):
                     if f.id in self.module_funcs:
@@ -165,7 +168,9 @@ class _RoleGraph:
                     m = self.by_class.get(cls, {}).get(f.attr)
                     if m:
                         out.add(m)
-        self._callees_cache[qual] = out
+            for callee, cnode, _g in fi.edges:
+                if cnode is None:  # nested def: fold its subtree in
+                    out |= self.callees(callee.split("::", 1)[1])
         return out
 
     def reachable(self, roots: Iterable[str]) -> set[str]:
@@ -183,9 +188,7 @@ class _RoleGraph:
         """Qualnames passed as ``threading.Thread(target=...)`` —
         entries another thread runs."""
         out: list[str] = []
-        for node in ast.walk(self.pf.tree):
-            if not isinstance(node, ast.Call):
-                continue
+        for node in self.pf.of_type(ast.Call):
             f = node.func
             is_thread = (isinstance(f, ast.Attribute) and f.attr == "Thread"
                          ) or (isinstance(f, ast.Name) and f.id == "Thread")
@@ -266,13 +269,14 @@ def _foreign_owned_attr(expr: ast.AST) -> Optional[str]:
     return node.attr
 
 
-def _iter_owned_writes(fn: ast.AST, foreign: bool = False):
+def _iter_owned_writes(fn: ast.AST, children: dict,
+                       foreign: bool = False):
     """(node, attr) for every owned-state write lexically in ``fn``'s
     own body (nested defs run on whichever thread calls them — the
     closure handed to the mailbox is the seam working as intended, so
     they are not this method's writes)."""
     pick = _foreign_owned_attr if foreign else _owned_base_attr
-    for node in walk_skip_defs(fn):
+    for node in walk_skip_defs(fn, children):
         if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
             targets = (node.targets if isinstance(node, ast.Assign)
                        else [node.target])
@@ -290,10 +294,11 @@ def _iter_owned_writes(fn: ast.AST, foreign: bool = False):
 
 @rule("thread-affinity")
 def thread_affinity(ctx: LintContext) -> Iterable[Finding]:
+    cg = get_graph(ctx)
     for rel, pf in sorted(ctx.files.items()):
         if not rel.startswith(THREAD_SCOPE_PREFIXES):
             continue
-        graph = _RoleGraph(pf)
+        graph = _RoleGraph(pf, cg)
         spawned = set(graph.thread_targets())
 
         # -- check 1: engine methods, classified by role ------------------
@@ -337,7 +342,7 @@ def thread_affinity(ctx: LintContext) -> Iterable[Finding]:
                 fn = graph.funcs[qual]
                 role = reach_from[qual]
                 shared = qual in sched_set
-                for node, attr in _iter_owned_writes(fn):
+                for node, attr in _iter_owned_writes(fn, pf.children):
                     f = ctx.finding(
                         pf, "thread-affinity", node,
                         f"write to scheduler-owned `{attr}` from "
@@ -361,7 +366,8 @@ def thread_affinity(ctx: LintContext) -> Iterable[Finding]:
             if qual in replay:
                 continue
             fn = graph.funcs[qual]
-            for node, attr in _iter_owned_writes(fn, foreign=True):
+            for node, attr in _iter_owned_writes(
+                    fn, pf.children, foreign=True):
                 f = ctx.finding(
                     pf, "thread-affinity", node,
                     f"foreign write to scheduler-owned `{attr}` of "

@@ -12,7 +12,11 @@ floor.
 
 The op-table true positive is the SEEDED DRIFT the acceptance bar
 names: a published gang op whose ``follow()`` arm was deleted — the
-exact protocol rot the rule exists to catch.
+exact protocol rot the rule exists to catch.  The
+``host-sync-cross-module`` pair (ISSUE 18) is the call-graph engine's
+acceptance case: the blocking helper lives in a DIFFERENT file than
+the ``*Engine`` root that reaches it, which the old intra-file walk
+could never see.
 """
 
 from __future__ import annotations
@@ -32,6 +36,10 @@ class Fixture:
     code: str
     expect: int        # minimum findings (0 = must be clean)
     needle: str = ""   # substring every finding message must contain
+    #: additional (rel, code) files linted TOGETHER with the main one —
+    #: the cross-module fixtures need an effect to live in a different
+    #: file than the root that reaches it
+    extra: tuple[tuple[str, str], ...] = ()
 
 
 FIXTURES: tuple[Fixture, ...] = (
@@ -478,6 +486,135 @@ class BackendHealth:
         self._waiting.append(backend)
 """,
         0),
+    Fixture(
+        # ISSUE 18: the persistence core (PERSIST_PATHS) is always in
+        # scope — a bare open(final, "w") tears the live file on crash
+        "torn-write", "torn-write/true-positive",
+        "kubeflow_tpu/serving/storage.py",
+        """
+import json
+
+def save_index(path, obj):
+    with open(path, "w") as f:
+        json.dump(obj, f)
+""",
+        1, "commit protocol"),
+    Fixture(
+        # staged write, but the name commits before the payload is
+        # durable — the exact page-cache window the protocol closes
+        "torn-write", "torn-write-rename/true-positive",
+        "kubeflow_tpu/serving/_st_persist.py",
+        """
+import json
+import os
+
+def save_index(path, obj):
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(obj, f)
+    os.replace(tmp, path)
+""",
+        1, "preceding fsync"),
+    Fixture(
+        # the full protocol: tmp write -> flush+fsync -> atomic replace
+        "torn-write", "torn-write/near-miss",
+        "kubeflow_tpu/serving/_st_persist.py",
+        """
+import json
+import os
+
+def save_index(path, obj):
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(obj, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+""",
+        0),
+    Fixture(
+        # ISSUE 18: blocking work REACHED through a call edge while a
+        # lock is held — invisible to lock-order's direct-site check
+        "lock-blocking-call", "lock-blocking/true-positive",
+        "kubeflow_tpu/serving/_st_lockblock.py",
+        """
+import os
+
+class BatchWriter:
+    def flush_batch(self):
+        with self._lock:
+            self._flush()
+
+    def _flush(self):
+        self._f.flush()
+        os.fsync(self._f.fileno())
+""",
+        1, "while holding"),
+    Fixture(
+        # the fix shape: drain under the lock, block outside it
+        "lock-blocking-call", "lock-blocking/near-miss",
+        "kubeflow_tpu/serving/_st_lockblock.py",
+        """
+import os
+
+class BatchWriter:
+    def flush_batch(self):
+        with self._lock:
+            batch = self._drain()
+        self._write(batch)
+
+    def _drain(self):
+        out = list(self._pending)
+        self._pending.clear()
+        return out
+
+    def _write(self, batch):
+        self._f.write(b"".join(batch))
+        os.fsync(self._f.fileno())
+""",
+        0),
+    Fixture(
+        # ISSUE 18's acceptance case: the helper lives one module away
+        # from the *Engine root that reaches it — the old intra-file
+        # walk was blind to exactly this
+        "host-sync-in-dispatch", "host-sync-cross-module/true-positive",
+        "kubeflow_tpu/serving/_st_xmod_a.py",
+        """
+from ._st_xmod_b import fetch_stats
+
+class FooEngine:
+    def _loop(self):
+        return fetch_stats(self.buf)
+""",
+        1, "host sync",
+        extra=(("kubeflow_tpu/serving/_st_xmod_b.py", """
+import jax
+
+def fetch_stats(buf):
+    return jax.device_get(buf)
+"""),)),
+    Fixture(
+        # same helper, reached only from a non-root method: reachability
+        # (not mere import) is what puts an effect on the dispatch path
+        "host-sync-in-dispatch", "host-sync-cross-module/near-miss",
+        "kubeflow_tpu/serving/_st_xmod_a.py",
+        """
+from ._st_xmod_b import fetch_stats
+
+class FooEngine:
+    def _loop(self):
+        return 1
+
+    def debug_dump(self):
+        return fetch_stats(self.buf)
+""",
+        0, "",
+        extra=(("kubeflow_tpu/serving/_st_xmod_b.py", """
+import jax
+
+def fetch_stats(buf):
+    return jax.device_get(buf)
+"""),)),
 )
 
 
@@ -493,11 +630,14 @@ def run_selftest(rules=None, out=print) -> int:
             continue
         ran += 1
         with tempfile.TemporaryDirectory(prefix="platform-lint-st-") as td:
-            target = os.path.join(td, fx.rel)
-            os.makedirs(os.path.dirname(target), exist_ok=True)
-            with open(target, "w", encoding="utf-8") as fh:
-                fh.write(fx.code)
-            report = run_lint(td, paths=[target], rules=[fx.rule])
+            targets = []
+            for rel, code in ((fx.rel, fx.code), *fx.extra):
+                target = os.path.join(td, rel)
+                os.makedirs(os.path.dirname(target), exist_ok=True)
+                with open(target, "w", encoding="utf-8") as fh:
+                    fh.write(code)
+                targets.append(target)
+            report = run_lint(td, paths=targets, rules=[fx.rule])
         n = len(report.findings)
         ok = (n == 0) if fx.expect == 0 else (
             n >= fx.expect

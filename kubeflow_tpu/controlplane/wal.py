@@ -195,6 +195,10 @@ class Wal:
         return records
 
     def _open_for_append(self) -> None:
+        # append-only log: a torn tail is the DESIGNED crash artifact —
+        # replay() truncates to the last newline-complete record (the
+        # repair path the chaos tests pin)
+        # analysis: ok torn-write — torn tail repaired on replay
         self._f = open(self.log_path, "ab")
 
     # -- append path -------------------------------------------------------
@@ -227,6 +231,10 @@ class Wal:
             now = time.monotonic()
             if (self._unsynced >= self.fsync_every
                     or now - self._last_fsync >= self.fsync_interval_s):
+                # the batched fsync under the append lock IS the
+                # durability contract: no writer may observe an append
+                # as accepted before its batch boundary is on disk
+                # analysis: ok lock-blocking-call — batched-fsync contract
                 self._fsync_locked(now)
 
     def _fsync_locked(self, now: Optional[float] = None) -> None:
@@ -240,6 +248,7 @@ class Wal:
         """Force the batched fsync (clean shutdown / test determinism)."""
         with self._lock:
             if self._f is not None and not self.crashed and self._unsynced:
+                # analysis: ok lock-blocking-call — forced flush of the batched-fsync contract
                 self._fsync_locked()
 
     # -- snapshot + compaction ---------------------------------------------
@@ -262,6 +271,7 @@ class Wal:
             # between the two leaves snapshot + stale records, which
             # replay filters by rv
             self._f.close()
+            # analysis: ok torn-write — truncate after durable snapshot; replay filters stale records by rv
             self._f = open(self.log_path, "wb")
             self._unsynced = 0
             self.records_since_snapshot = 0

@@ -56,6 +56,14 @@ def load_library() -> Optional[ctypes.CDLL]:
             if not _build(tmp):
                 _build_failed = True
                 return None
+            # durability before publish: a crash after the replace must
+            # not leave a live .so whose pages never hit disk (dlopen of
+            # a torn library segfaults instead of failing cleanly)
+            fd = os.open(tmp, os.O_RDONLY)
+            try:
+                os.fsync(fd)
+            finally:
+                os.close(fd)
             os.replace(tmp, so_path)  # atomic publish for concurrent builders
         try:
             lib = ctypes.CDLL(so_path)

@@ -163,15 +163,20 @@ def build_mesh(
             # Real TPU topology errors must surface, so this path is gated
             # on the attribute, not on catching ValueError.
             n = len(plan.axis_names)
+            # devices are host-side topology handles, not device values:
+            # np.array here is mesh layout math, no transfer happens
+            # analysis: ok host-sync-in-dispatch — host topology math
             arr = np.array(devices).reshape(*dcn, *per_slice)
             order = [i for pair in ((k, k + n) for k in range(n)) for i in pair]
             dev_array = arr.transpose(order).reshape(plan.shape)
     else:
         try:
             dev_array = mesh_utils.create_device_mesh(
+                # analysis: ok host-sync-in-dispatch — host topology math
                 plan.shape, devices=np.array(devices), allow_split_physical_axes=True
             )
         except (ValueError, AssertionError):
+            # analysis: ok host-sync-in-dispatch — host topology math
             dev_array = np.array(devices).reshape(plan.shape)
     return Mesh(dev_array, plan.axis_names)
 

@@ -2469,6 +2469,7 @@ class ContinuousEngine:
         return {
             **paged,
             "slots_capacity": self.num_slots,
+            # analysis: ok host-sync-in-dispatch — _active is the HOST numpy slot table, not a device value
             "slots_live": int(self._active.sum()),
             "queue_depth": len(self._waiting) + self._queue.qsize(),
             "decode_steps": self.step_counter,

@@ -497,6 +497,10 @@ class GangResizer:
         :class:`ResizeAborted` with the old engine resumed in place on
         any pre-cutover failure."""
         with self._lock:
+            # the resize lock IS the drain barrier: one resize at a
+            # time, callers block by design while the gang quiesces,
+            # reshards and cuts over
+            # analysis: ok lock-blocking-call — lock is the drain barrier
             return self._resize_locked(mesh_axes, num_blocks)
 
     def _resize_locked(self, mesh_axes, num_blocks):

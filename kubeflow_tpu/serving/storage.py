@@ -406,10 +406,14 @@ def stage_to_cache(uri: str, src_path: str, cache_dir: str) -> str:
     manifest = build_manifest(uri, tmp_model)
     with open(os.path.join(tmp_dir, MANIFEST_NAME), "w") as f:
         json.dump(manifest, f)
+        f.flush()
+        os.fsync(f.fileno())
+    _fsync_dir(tmp_dir)
     # rename() publishes the entry atomically; never remove a published
     # entry here — a concurrent replica may already be serving from it
     try:
         os.rename(tmp_dir, entry_dir)
+        _fsync_dir(cache_dir)
         _verified_entries.add(entry_dir)
     except OSError:
         # lost the publish race to a concurrent replica; use the winner's

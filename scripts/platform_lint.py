@@ -7,10 +7,11 @@ next to the other scripts/):
 
     python scripts/platform_lint.py                  # ratchet check
     python scripts/platform_lint.py --update-baseline
-    python scripts/platform_lint.py --json
+    python scripts/platform_lint.py --json           # machine-readable + timing
+    python scripts/platform_lint.py --changed        # findings in your diff only
     python scripts/platform_lint.py --all            # list frozen debt too
     python scripts/platform_lint.py --rule threads   # one concern only
-    python scripts/platform_lint.py --rule protocol  # op-table + fault-pairing
+    python scripts/platform_lint.py --rule persist   # torn-write commit protocol
     python scripts/platform_lint.py --self-test      # rule fixtures, no pytest
 
 Exit 0: no findings above kubeflow_tpu/analysis/baseline.json (or
@@ -21,12 +22,19 @@ rule stopped firing on its true positive or fired on its near miss.
 Exit 2: usage error.
 
 ``--rule`` takes rule names or group aliases (dispatch, hygiene,
-locks, threads, protocol).  ``--self-test`` runs the built-in
-true-positive/near-miss fixture pair per rule (analysis/selftest.py) —
-the lint binary validating itself in tier-1 with no test framework.
+locks, threads, protocol, persist).  ``--changed`` still parses the
+WHOLE platform — the cross-module call graph needs every file to
+resolve effects — but reports only findings in files your working tree
+changed vs HEAD (plus untracked), which is the pre-commit loop.
+``--self-test`` runs the built-in true-positive/near-miss fixture pair
+per rule (analysis/selftest.py) — the lint binary validating itself in
+tier-1 with no test framework.
 
 The same check runs as tier-1 (tests/test_analysis.py::TestRatchet), so
-every PR inherits it; this script is the fast pre-commit form.
+every PR inherits it; tier-1 also asserts the whole-platform
+parse+lint wall time stays under its budget, so the call-graph engine
+can't quietly make every PR slower.  This script is the fast
+pre-commit form.
 """
 
 import os
