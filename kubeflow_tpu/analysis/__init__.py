@@ -49,6 +49,15 @@ This package enforces those invariants as code:
   scheduler-owned engine state from a non-scheduler role fail unless
   routed through the migration mailbox — the PR 7/PR 9 review-round
   bug class, made mechanical.
+- :mod:`.rules_clock` — ``wall-clock-in-policy`` (ISSUE 20): no
+  ambient ``time.*`` read/sleep and no process-global ``random.*``
+  draw in the sim twin or on any serving policy path it replays
+  (router pick/circuits, QoS door, autoscaler ``decide``/``tick``),
+  transitively over the call graph — the virtual-clock/seeded-rng
+  seams are a contract, and one ``time.monotonic()`` snuck into a
+  cooldown silently un-replays the twin.  The injectable-default
+  fallback (``time.time() if now is None else now``) is recognized as
+  a seam, not a violation.
 - :mod:`.rules_protocol` — ``op-table`` (every published gang op needs
   a ``follow()`` replay arm and vice versa, cross-file across
   gang.py/resize.py) and ``fault-pairing`` (chaos ``FaultKind``

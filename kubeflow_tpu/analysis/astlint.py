@@ -249,6 +249,7 @@ def _ensure_rules_loaded() -> None:
     # rule modules self-register via @rule at import; imported lazily so
     # `from .astlint import Finding` never recurses
     from . import (  # noqa: F401
+        rules_clock,
         rules_dispatch,
         rules_hygiene,
         rules_locks,

@@ -615,6 +615,61 @@ import jax
 def fetch_stats(buf):
     return jax.device_get(buf)
 """),)),
+    Fixture(
+        # ISSUE 20: an ambient clock read inside the sim twin — the
+        # exact drift the virtual-clock contract forbids
+        "wall-clock-in-policy", "wall-clock/true-positive",
+        "kubeflow_tpu/sim/_st_twin.py",
+        """
+import time
+
+def cooldown_over(last, cooldown_s):
+    return time.monotonic() - last >= cooldown_s
+""",
+        1, "virtual-clock policy path"),
+    Fixture(
+        # transitive, cross-module: the policy function itself is
+        # clean, but a helper one module away draws the process rng
+        "wall-clock-in-policy", "wall-clock-transitive/true-positive",
+        "kubeflow_tpu/sim/_st_twin.py",
+        """
+from ..serving._st_jitter import spread_hint
+
+def retry_delay(base):
+    return spread_hint(base)
+""",
+        1, "process rng",
+        extra=(("kubeflow_tpu/serving/_st_jitter.py", """
+import random
+
+def spread_hint(base):
+    return base * (1.0 + random.random())
+"""),)),
+    Fixture(
+        # the seam shapes: clock/rng taken from injected callables, and
+        # the injectable-default fallback (`if now is None`) — all of
+        # them are exactly what the twin threads through, none fire
+        "wall-clock-in-policy", "wall-clock/near-miss",
+        "kubeflow_tpu/sim/_st_twin.py",
+        """
+import random
+import time
+
+
+class Bucket:
+    def __init__(self, clock=time.monotonic, rng=None):
+        self._clock = clock
+        self._rng = rng if rng is not None else random.Random(0)
+
+    def take(self):
+        now = self._clock()
+        return now + self._rng.random()
+
+
+def activate(plan, now=None):
+    plan.t0 = time.time() if now is None else now
+""",
+        0),
 )
 
 

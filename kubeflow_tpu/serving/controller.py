@@ -248,8 +248,18 @@ class Router:
       plane's shed/affinity gauges.
     """
 
-    def __init__(self, activate: Callable[[], None], port: Optional[int] = None):
-        self.port = port or allocate_port()
+    def __init__(self, activate: Callable[[], None],
+                 port: Optional[int] = None, *,
+                 clock: Callable[[], float] = time.monotonic,
+                 rng=None, serve: bool = True):
+        #: ``serve=False`` builds the full policy surface (pools, WRR
+        #: state, circuits, budget, domains) WITHOUT the HTTP server —
+        #: the digital twin (``sim/``) drives this exact object with a
+        #: virtual ``clock``/seeded ``rng``, so routing decisions in
+        #: simulation are the production code path by construction.
+        self.port = port or (allocate_port() if serve else 0)
+        self._clock = clock
+        self._rng = rng
         #: weighted backend pools: [(urls, weight)] — one pool per
         #: revision (canary rollout splits traffic here, the
         #: virtualservice-weight analog); single-revision services have
@@ -284,8 +294,8 @@ class Router:
         # and only the circuit/budget behavior applies.
         from .traffic import BackendHealth, RetryBudget
 
-        self.health = BackendHealth()
-        self.retry_budget = RetryBudget()
+        self.health = BackendHealth(clock=clock, rng=rng)
+        self.retry_budget = RetryBudget(clock=clock)
         #: url -> failure-domain label (empty = single implicit domain)
         self._domains: dict[str, str] = {}
         #: domains currently declared down (mass-forget fired once)
@@ -549,7 +559,8 @@ class Router:
                 # satellite): the more circuits are open, the longer
                 # and more spread out the herd's retry horizon
                 ra = jittered_retry_after(
-                    1.0, load=len(router.health.open_backends()))
+                    1.0, load=len(router.health.open_backends()),
+                    rng=router._rng)
                 self._respond(
                     503, json.dumps({
                         "error": "no ready replicas",
@@ -574,6 +585,10 @@ class Router:
             def do_POST(self):
                 self._proxy()
 
+        if not serve:
+            self._httpd = None
+            self._thread = None
+            return
         self._httpd = ThreadingHTTPServer(("127.0.0.1", self.port), Handler)
         self._httpd.daemon_threads = True
         self._thread = threading.Thread(
@@ -880,6 +895,13 @@ class Router:
               exclude: Optional[set] = None,
               session: Optional[str] = None,
               avoid_domains: Optional[set] = None) -> Optional[str]:
+        # the pick pipeline is the pure policy pair in traffic.py
+        # (ISSUE 20 extraction): smooth_wrr_pick mutates the cursor
+        # state under this lock, live_candidates filters through the
+        # health circuits — the sim twin calls the same functions on
+        # the same objects
+        from .traffic import live_candidates, smooth_wrr_pick
+
         with self._lock:
             use_explain = explain and self._explain_pools
             pools = self._explain_pools if use_explain else self._pools
@@ -887,36 +909,13 @@ class Router:
             rrs = self._err if use_explain else self._rr
             if not pools:
                 return None
-            # smooth weighted round-robin (nginx-style): deterministic,
-            # exact proportions over any window, and INTERLEAVED — a block
-            # split (first 80 of 100 to stable) would starve the canary on
-            # short request bursts
-            total = sum(w for _, w in pools)
-            best = 0
-            for i, (_, w) in enumerate(pools):
-                cur[i] += w
-                if cur[i] > cur[best]:
-                    best = i
-            cur[best] -= total
+            best = smooth_wrr_pick(pools, cur)
 
             def live(urls: list) -> list:
-                # circuit filter (ISSUE 16): skip open circuits — a
-                # pure filter; arming a half-open probe happens below
-                # on the ONE backend actually picked
-                out = [u for u in urls
-                       if not exclude or u not in exclude]
-                out = self.health.routable(out)
-                if avoid_domains and out:
-                    # re-route spreading: prefer SURVIVING domains
-                    # over the one that just failed; only when at
-                    # least one such candidate exists (with domains
-                    # unset every url maps to '' and this no-ops)
-                    spread = [u for u in out
-                              if self._domains.get(u, "")
-                              not in avoid_domains]
-                    if spread:
-                        out = spread
-                return out
+                return live_candidates(
+                    urls, self.health.routable, exclude=exclude,
+                    avoid_domains=avoid_domains,
+                    domain_of=lambda u: self._domains.get(u, ""))
 
             pool = live(pools[best][0])
             if not pool:
@@ -955,6 +954,8 @@ class Router:
         if self.prefix_poller is not None:
             self.prefix_poller.stop()
             self.prefix_poller = None
+        if self._httpd is None:
+            return  # serve=False twin router: nothing to tear down
         self._httpd.shutdown()
         self._httpd.server_close()
         self._thread.join(timeout=2)

@@ -44,11 +44,20 @@ from __future__ import annotations
 
 import collections
 import logging
+import random
 import threading
 import time
 from typing import Any, Callable, Optional
 
 log = logging.getLogger("kubeflow_tpu.serving")
+
+#: process-default jitter source.  Every policy object in this module
+#: takes ``rng=`` (and ``clock=``) so the digital twin (``sim/``) can
+#: inject a seeded stream and a virtual clock; live deployments fall
+#: back to this shared instance.  The ``wall-clock-in-policy`` analyzer
+#: rule holds the line: policy code never calls module-level
+#: ``random.*`` or ``time.*`` directly.
+_RNG = random.Random()
 
 #: priority tiers, best first — the names Profiles/configs use; the
 #: ints are what the engine's admission sort and the preemptor compare
@@ -158,18 +167,20 @@ class TokenBucket:
     returns 0.0 on grant, else the seconds until a token accrues (the
     client's ``Retry-After``)."""
 
-    def __init__(self, rate: float, burst: float):
+    def __init__(self, rate: float, burst: float,
+                 clock: Callable[[], float] = time.monotonic):
         self.rate = float(rate)
         self.burst = float(burst)
         self._tokens = self.burst
-        self._t = time.monotonic()
+        self._clock = clock
+        self._t = clock()
         self._lock = threading.Lock()
 
     def try_take(self, n: float = 1.0) -> float:
         if self.rate <= 0:
             return 0.0  # unlimited
         with self._lock:
-            now = time.monotonic()
+            now = self._clock()
             self._tokens = min(
                 self.burst, self._tokens + (now - self._t) * self.rate)
             self._t = now
@@ -421,7 +432,9 @@ class BackendHealth:
 
     def __init__(self, fail_threshold: int = 3, error_rate: float = 0.5,
                  window: int = 20, open_s: float = 1.0,
-                 open_cap_s: float = 30.0, probe_jitter: float = 0.5):
+                 open_cap_s: float = 30.0, probe_jitter: float = 0.5,
+                 clock: Callable[[], float] = time.monotonic,
+                 rng: Optional[random.Random] = None):
         if int(fail_threshold) < 1:
             raise ValueError("fail_threshold must be >= 1")
         if not (0.0 < float(error_rate) <= 1.0):
@@ -434,6 +447,8 @@ class BackendHealth:
         self.open_s = float(open_s)
         self.open_cap_s = float(open_cap_s)
         self.probe_jitter = max(0.0, float(probe_jitter))
+        self._clock = clock
+        self._rng = rng if rng is not None else _RNG
         #: url -> mutable record (state machine per backend)
         self._circuits: dict[str, dict] = {}
         self._lock = threading.Lock()
@@ -453,16 +468,14 @@ class BackendHealth:
         return rec
 
     def _trip(self, rec: dict, now: float) -> None:
-        import random
-
         rec["state"] = self.OPEN
         rec["probe_inflight"] = False
         rec["reopen_at"] = now + rec["open_for"] * (
-            1.0 + random.random() * self.probe_jitter)
+            1.0 + self._rng.random() * self.probe_jitter)
         self.opens_total += 1
 
     def note_failure(self, backend: str) -> None:
-        now = time.monotonic()
+        now = self._clock()
         with self._lock:
             rec = self._rec(backend)
             rec["consec"] += 1
@@ -503,7 +516,7 @@ class BackendHealth:
         """Force-open one circuit NOW (the domain-outage mass action:
         when a whole domain is declared down, its other members must
         not each burn ``fail_threshold`` connect attempts first)."""
-        now = time.monotonic()
+        now = self._clock()
         with self._lock:
             rec = self._rec(backend)
             if rec["state"] != self.OPEN:
@@ -528,7 +541,7 @@ class BackendHealth:
         in flight.  No side effects: arming the probe is
         :meth:`on_routed`'s job, on the ONE candidate actually
         picked."""
-        now = time.monotonic()
+        now = self._clock()
         out = []
         with self._lock:
             for b in candidates:
@@ -587,7 +600,8 @@ class RetryBudget:
     the storm."""
 
     def __init__(self, ratio: float = 0.2, burst: float = 5.0,
-                 floor_rate: float = 0.5):
+                 floor_rate: float = 0.5,
+                 clock: Callable[[], float] = time.monotonic):
         if float(ratio) < 0:
             raise ValueError("ratio must be >= 0")
         if float(burst) < 1:
@@ -598,7 +612,7 @@ class RetryBudget:
         #: allowed to fail over without waiting for successes
         self._tokens = self.burst
         self._floor = TokenBucket(max(0.0, float(floor_rate)),
-                                  burst=1.0)
+                                  burst=1.0, clock=clock)
         self._lock = threading.Lock()
         self.retries_granted_total = 0
         self.retries_denied_total = 0
@@ -633,7 +647,8 @@ class RetryBudget:
 
 
 def jittered_retry_after(base: float = 1.0, load: float = 0.0,
-                         spread: float = 0.5, cap: float = 30.0) -> float:
+                         spread: float = 0.5, cap: float = 30.0,
+                         rng: Optional[random.Random] = None) -> float:
     """The ONE retry-after hint: a load-aware base, JITTERED so shed /
     503'd clients do not re-arrive as a synchronized wave (the
     constant ``retry_after=1`` at the router's no-ready-replicas path
@@ -643,13 +658,55 @@ def jittered_retry_after(base: float = 1.0, load: float = 0.0,
     load``, clamped to ``[0.05, cap]``.  Both the plane's concurrency
     shed ETA and the router's 503 ride this helper — one responder,
     no drifting copies (the PR 8 ``shed_http`` lesson)."""
-    import random
-
+    r = (_RNG if rng is None else rng).random()
     hint = min(float(cap), max(0.05, float(base) + float(load)))
     spread = max(0.0, min(float(spread), 1.0))
     lo = hint * (1.0 - spread)
     hi = hint * (1.0 + spread)
-    return min(float(cap), max(0.05, lo + random.random() * (hi - lo)))
+    return min(float(cap), max(0.05, lo + r * (hi - lo)))
+
+
+def smooth_wrr_pick(pools: list, cur: list[int]) -> int:
+    """Smooth weighted round-robin pool selection (nginx-style):
+    deterministic, exact proportions over any window, and INTERLEAVED
+    — a block split (first 80 of 100 to stable) would starve the
+    canary on short request bursts.  ``pools`` is ``[(urls, weight)]``;
+    ``cur`` is the per-pool current-weight state, mutated in place
+    (the caller holds whatever lock guards it).  Returns the chosen
+    pool index.  Extracted from ``Router._pick`` (ISSUE 20) so the
+    live router and the sim twin share one pick policy by
+    construction — pure arithmetic, no clock, no rng."""
+    total = sum(w for _, w in pools)
+    best = 0
+    for i, (_, w) in enumerate(pools):
+        cur[i] += w
+        if cur[i] > cur[best]:
+            best = i
+    cur[best] -= total
+    return best
+
+
+def live_candidates(urls: list[str], routable: Callable[[list], list],
+                    exclude=None, avoid_domains=None,
+                    domain_of: Optional[Callable[[str], str]] = None
+                    ) -> list[str]:
+    """The candidate filter of the router pick (ISSUE 16 semantics,
+    extracted for ISSUE 20): drop explicitly excluded urls (already
+    tried this request), keep only circuit-routable ones (``routable``
+    is :meth:`BackendHealth.routable` — a pure filter), then prefer
+    SURVIVING domains over the ones that just failed — but only when
+    at least one such candidate exists (with domains unset every url
+    maps to ``''`` and the spread no-ops).  Pure given its inputs;
+    arming a half-open probe stays the caller's job on the ONE
+    backend actually picked."""
+    out = [u for u in urls if not exclude or u not in exclude]
+    out = routable(out)
+    if avoid_domains and out and domain_of is not None:
+        spread = [u for u in out
+                  if domain_of(u) not in avoid_domains]
+        if spread:
+            out = spread
+    return out
 
 
 class ClusterPrefixPoller:
@@ -671,11 +728,15 @@ class ClusterPrefixPoller:
     def __init__(self, backends: Callable[[], list[str]],
                  registry: Optional[KvBlockRegistry] = None,
                  interval_s: float = 5.0, jitter: float = 0.25,
-                 capacity: int = 4096):
+                 capacity: int = 4096,
+                 clock: Callable[[], float] = time.monotonic,
+                 rng: Optional[random.Random] = None):
         self.interval_s = float(interval_s)
         if self.interval_s <= 0:
             raise ValueError("interval_s must be > 0")
         self.jitter = max(0.0, min(float(jitter), 0.9))
+        self._clock = clock
+        self._rng = rng if rng is not None else _RNG
         self._backends = backends
         self.registry = registry or KvBlockRegistry()
         self.capacity = int(capacity)
@@ -698,13 +759,11 @@ class ClusterPrefixPoller:
         self._thread.start()
 
     def _loop(self) -> None:
-        import random
-
         while not self._stop.is_set():
             # jittered sleep FIRST: construction must not scrape before
             # the router's pools are even wired
             delay = self.interval_s * (
-                1.0 + random.uniform(-self.jitter, self.jitter))
+                1.0 + self._rng.uniform(-self.jitter, self.jitter))
             if self._stop.wait(delay):
                 return
             try:
@@ -720,11 +779,9 @@ class ClusterPrefixPoller:
         import re
         import urllib.request
 
-        import random
-
         self.polls_total += 1
         urls = list(self._backends() or [])
-        now = time.monotonic()
+        now = self._clock()
         with self._lock:
             # membership churn prunes the backoff table with the pool
             self._unreachable = {
@@ -753,9 +810,9 @@ class ClusterPrefixPoller:
                     fails += 1
                     delay = min(self.interval_s * (2.0 ** (fails - 1)),
                                 8.0 * self.interval_s)
-                    delay *= 1.0 + random.uniform(-self.jitter,
-                                                  self.jitter)
-                    self._unreachable[url] = (time.monotonic() + delay,
+                    delay *= 1.0 + self._rng.uniform(-self.jitter,
+                                                     self.jitter)
+                    self._unreachable[url] = (self._clock() + delay,
                                               fails)
                 continue
             reached.add(url)
@@ -956,15 +1013,20 @@ def shed_http(handler, ticket) -> None:
 class _Ticket:
     """One admitted request's pass through the front door."""
 
-    __slots__ = ("ok", "cls", "tenant", "retry_after", "reason")
+    __slots__ = ("ok", "cls", "tenant", "retry_after", "reason",
+                 "waiter")
 
     def __init__(self, ok: bool, cls: Optional[QosClass], tenant: str,
-                 retry_after: float = 0.0, reason: str = ""):
+                 retry_after: float = 0.0, reason: str = "",
+                 waiter: Any = None):
         self.ok = ok
         self.cls = cls
         self.tenant = tenant
         self.retry_after = retry_after
         self.reason = reason
+        #: queue token for a non-blocking offer() still waiting for a
+        #: concurrency slot (promote()/abandon() consume it)
+        self.waiter = waiter
 
     @property
     def priority(self) -> int:
@@ -975,12 +1037,42 @@ class _Ticket:
         return _TIER_NAMES[self.priority]
 
 
+#: door verdicts — what the pure admission policy can say
+ADMIT, SHED_RATE, SHED_QUEUE_FULL, QUEUE = (
+    "admit", "rate_limited", "queue_full", "queue")
+
+
+def door_decision(rate_wait: float, live: int, max_concurrent: int,
+                  waiting: int, queue_depth: int) -> str:
+    """The ONE front-door admission policy (ISSUE 20 extraction):
+    given a class's instantaneous state, decide ADMIT / SHED_RATE /
+    SHED_QUEUE_FULL / QUEUE.  Pure — no clock, no locks, no counters;
+    the blocking :meth:`TrafficPlane.acquire` and the event-driven
+    :meth:`TrafficPlane.offer` (the sim twin's door) both actuate
+    exactly this verdict, so live and simulated admission cannot
+    drift.
+
+    Decision order mirrors the reverse of cost: the token bucket sheds
+    instantly (``rate_wait`` > 0 is the tenant's contract), then the
+    concurrency gate passes (the fast path DEFERS to the queue — a
+    fresh arrival must not snipe a freed slot from a waiter), queues
+    (bounded by ``queue_depth``) or sheds."""
+    if rate_wait > 0.0:
+        return SHED_RATE
+    if max_concurrent <= 0 or (live < max_concurrent and not waiting):
+        return ADMIT
+    if waiting >= queue_depth:
+        return SHED_QUEUE_FULL
+    return QUEUE
+
+
 class _ClassState:
     """Live accounting for one QoS class (plane-lock-protected)."""
 
-    def __init__(self, cls: QosClass):
+    def __init__(self, cls: QosClass,
+                 clock: Callable[[], float] = time.monotonic):
         self.cls = cls
-        self.bucket = TokenBucket(cls.rate, cls.burst)
+        self.bucket = TokenBucket(cls.rate, cls.burst, clock=clock)
         self.live = 0
         #: FIFO of waiter tokens — admission order for queued
         #: requests; its head owns the next freed slot
@@ -1017,12 +1109,16 @@ class TrafficPlane:
                  default_class: str = "default",
                  affinity_block: int = 32,
                  affinity_capacity: int = 8192,
-                 tenant_tokens: Optional[dict[str, str]] = None):
+                 tenant_tokens: Optional[dict[str, str]] = None,
+                 clock: Callable[[], float] = time.monotonic,
+                 rng: Optional[random.Random] = None):
         classes = validate_qos(qos or {})
         self._lock = threading.Lock()
+        self._clock = clock
+        self._rng = rng if rng is not None else _RNG
         self._classes: dict[str, _ClassState] = {}
         for name, cls in classes.items():
-            st = _ClassState(cls)
+            st = _ClassState(cls, clock=clock)
             st.cond = threading.Condition(self._lock)
             self._classes[name] = st
         self._tenants = {}
@@ -1099,25 +1195,21 @@ class TrafficPlane:
         if st is None:
             return _Ticket(True, None, tenant)  # no QoS configured
         cls = st.cls
-        if charge_rate:
-            wait = st.bucket.try_take()
-            if wait > 0.0:
-                with self._lock:
-                    st.shed_total += 1
-                return _Ticket(False, cls, tenant,
-                               retry_after=max(wait, 0.05),
-                               reason="rate_limited")
+        rate_wait = st.bucket.try_take() if charge_rate else 0.0
         with self._lock:
-            # the fast path defers to the queue: a fresh arrival must
-            # not snipe a freed slot from a waiter that has been
-            # blocking for it (under sustained arrivals the waiters
-            # would lose every turnover and starve to queue_timeout)
-            if cls.max_concurrent <= 0 or (
-                    st.live < cls.max_concurrent and not st.queue):
+            verdict = door_decision(rate_wait, st.live,
+                                    cls.max_concurrent, st.waiting,
+                                    cls.queue_depth)
+            if verdict == SHED_RATE:
+                st.shed_total += 1
+                return _Ticket(False, cls, tenant,
+                               retry_after=max(rate_wait, 0.05),
+                               reason="rate_limited")
+            if verdict == ADMIT:
                 st.live += 1
                 st.admitted_total += 1
                 return _Ticket(True, cls, tenant)
-            if st.waiting >= cls.queue_depth:
+            if verdict == SHED_QUEUE_FULL:
                 st.shed_total += 1
                 if charge_rate:
                     # the bucket granted a token but no work happened:
@@ -1127,19 +1219,19 @@ class TrafficPlane:
                 return _Ticket(False, cls, tenant,
                                retry_after=self._slot_eta(st),
                                reason="queue_full")
-            # bounded FIFO admission queue: wait (timed) for a slot —
-            # this blocking IS the SSE path's backpressure.  Only the
-            # HEAD waiter may take a freed slot (release notifies all:
-            # a woken non-head waiter just re-waits), so admission
+            # QUEUE: bounded FIFO admission queue — wait (timed) for a
+            # slot; this blocking IS the SSE path's backpressure.  Only
+            # the HEAD waiter may take a freed slot (release notifies
+            # all: a woken non-head waiter just re-waits), so admission
             # order is arrival order within the class.
             me = object()
             st.queue.append(me)
             st.queued_total += 1
-            deadline = time.monotonic() + wait_timeout
+            deadline = self._clock() + wait_timeout
             try:
                 while not (st.live < cls.max_concurrent
                            and st.queue[0] is me):
-                    remaining = deadline - time.monotonic()
+                    remaining = deadline - self._clock()
                     if remaining <= 0:
                         st.queue.remove(me)
                         # our departure may make the new head eligible
@@ -1167,7 +1259,95 @@ class TrafficPlane:
         bounded hint, never a promise — JITTERED through the shared
         helper so shed clients of one hot class do not re-arrive in a
         synchronized wave (ISSUE 16 satellite)."""
-        return jittered_retry_after(1.0, load=st.waiting)
+        return jittered_retry_after(1.0, load=st.waiting,
+                                    rng=self._rng)
+
+    # -- the event-driven door (the sim twin's admission) -----------------
+
+    def offer(self, tenant: str = "default", *,
+              charge_rate: bool = True) -> _Ticket:
+        """Non-blocking :meth:`acquire`: the SAME :func:`door_decision`
+        policy, but a would-queue arrival gets a WAITING ticket
+        (``ok=False``, ``reason="queued"``, ``waiter`` set) instead of
+        blocking this thread — the caller owns the wait (the digital
+        twin's event loop models it in virtual time, calling
+        :meth:`promote` when capacity frees and :meth:`abandon` on its
+        own timeout).  Counters move exactly as the blocking path's
+        do, so live and simulated stats stay comparable."""
+        st = self.class_for(tenant)
+        if st is None:
+            return _Ticket(True, None, tenant)
+        cls = st.cls
+        rate_wait = st.bucket.try_take() if charge_rate else 0.0
+        with self._lock:
+            verdict = door_decision(rate_wait, st.live,
+                                    cls.max_concurrent, st.waiting,
+                                    cls.queue_depth)
+            if verdict == SHED_RATE:
+                st.shed_total += 1
+                return _Ticket(False, cls, tenant,
+                               retry_after=max(rate_wait, 0.05),
+                               reason="rate_limited")
+            if verdict == ADMIT:
+                st.live += 1
+                st.admitted_total += 1
+                return _Ticket(True, cls, tenant)
+            if verdict == SHED_QUEUE_FULL:
+                st.shed_total += 1
+                if charge_rate:
+                    st.bucket.refund()
+                return _Ticket(False, cls, tenant,
+                               retry_after=self._slot_eta(st),
+                               reason="queue_full")
+            me = object()
+            st.queue.append(me)
+            st.queued_total += 1
+            return _Ticket(False, cls, tenant, reason="queued",
+                           waiter=me)
+
+    def promote(self, ticket: _Ticket) -> bool:
+        """Admit a queued :meth:`offer` ticket iff it is HEAD of its
+        class queue and a slot is free — the same only-the-head rule
+        the blocking path's Condition loop enforces.  True = the
+        ticket is now ok/admitted (caller must release() it)."""
+        if ticket.waiter is None or ticket.cls is None:
+            return False
+        st = self._classes.get(ticket.cls.name)
+        if st is None:
+            return False
+        with self._lock:
+            if (st.queue and st.queue[0] is ticket.waiter
+                    and st.live < st.cls.max_concurrent):
+                st.queue.popleft()
+                st.live += 1
+                st.admitted_total += 1
+                ticket.ok = True
+                ticket.reason = ""
+                ticket.waiter = None
+                return True
+        return False
+
+    def abandon(self, ticket: _Ticket, *,
+                charge_rate: bool = True) -> None:
+        """A queued :meth:`offer` ticket gave up (the caller's
+        wait_timeout in virtual time): leave the queue with the same
+        accounting as the blocking path's ``queue_timeout`` shed."""
+        if ticket.waiter is None or ticket.cls is None:
+            return
+        st = self._classes.get(ticket.cls.name)
+        if st is None:
+            return
+        with self._lock:
+            if ticket.waiter in st.queue:
+                st.queue.remove(ticket.waiter)
+                # our departure may make the new head eligible
+                st.cond.notify_all()
+                st.shed_total += 1
+                if charge_rate:
+                    st.bucket.refund()
+                ticket.retry_after = self._slot_eta(st)
+        ticket.waiter = None
+        ticket.reason = "queue_timeout"
 
     def release(self, ticket: _Ticket) -> None:
         if not ticket.ok or ticket.cls is None:
@@ -1290,6 +1470,76 @@ class TrafficPlane:
         return out
 
 
+def blocks_needed(prompt_len: int, max_new_tokens: int,
+                  block_size: int) -> int:
+    """Worst-case block span a request may occupy (ceil division) —
+    the capacity question both the live preemptor and the sim twin's
+    modeled pool ask."""
+    return -(-(int(prompt_len) + int(max_new_tokens))
+             // int(block_size))
+
+
+def best_pending(waiting, now: float, preempt_after_s: float,
+                 policy: Optional[Callable] = None):
+    """(tier, req) of the best-tier submitted-but-unadmitted request
+    that has waited past the preemption threshold AND whose wait
+    eviction could actually end, else (None, None).  Pure given its
+    inputs (``now`` is the caller's injected clock) — the ISSUE 20
+    extraction of ``EnginePreemptor._pending_best``.
+
+    A request deferred by the engine's ``admission_policy`` (the tier
+    ladder's class quota, say) is blocked by POLICY, not capacity:
+    evicting a victim frees nothing it may use, and the freed slot
+    would be re-consumed by other traffic — serial eviction churn of
+    healthy streams.  The probe requires the policy to be read-only
+    host logic; a raising policy skips the demand rather than
+    evicting on a guess."""
+    best: Optional[int] = None
+    best_req = None
+    for req in waiting:
+        if req.done.is_set():
+            continue
+        if now - req.submitted_at < preempt_after_s:
+            continue
+        if policy is not None:
+            try:
+                if not policy(req):
+                    continue  # policy-deferred, not capacity-blocked
+            except Exception:  # noqa: BLE001 — never evict on a guess
+                continue
+        tier = getattr(req, "priority", 1)
+        if best is None or tier < best:
+            best, best_req = tier, req
+    return best, best_req
+
+
+def choose_victim(slots, better_than: int, frozen=()):
+    """The live victim with the WORST tier strictly greater than
+    ``better_than`` (ties: fewest generated tokens — the cheapest
+    snapshot), or None.  ``slots`` is ``(slot_index, req)`` pairs;
+    ``frozen`` slots (mid-migration/resize) are never victims —
+    another orchestrator owns their cutover, and evicting one here
+    would fork ownership (two snapshots, one handle, double-decode on
+    whichever side wins).  Pure — the ISSUE 20 extraction of
+    ``EnginePreemptor._live_worst``, shared with the sim twin's
+    modeled preemption."""
+    frozen = set(frozen)
+    worst = None
+    key = None
+    for slot, req in slots:
+        if req is None or req.done.is_set():
+            continue
+        if slot in frozen:
+            continue
+        tier = getattr(req, "priority", 1)
+        if tier <= better_than:
+            continue
+        k = (-tier, len(req.tokens))
+        if key is None or k < key:
+            worst, key = req, k
+    return worst
+
+
 class EnginePreemptor:
     """Evict-and-requeue for priority inversion on a full paged pool.
 
@@ -1309,7 +1559,8 @@ class EnginePreemptor:
     """
 
     def __init__(self, engine, preempt_after_s: float = 0.05,
-                 poll_s: float = 0.01):
+                 poll_s: float = 0.01,
+                 clock: Callable[[], float] = time.perf_counter):
         if not getattr(engine, "paged", False):
             raise ValueError(
                 "priority preemption requires the paged pool "
@@ -1318,6 +1569,7 @@ class EnginePreemptor:
         self.engine = engine
         self.preempt_after_s = float(preempt_after_s)
         self.poll_s = float(poll_s)
+        self._clock = clock
         #: parked snapshots: (tier, parked_at, req, snapshot)
         self._parked: list[tuple[int, float, Any, dict]] = []
         self._lock = threading.Lock()
@@ -1333,37 +1585,12 @@ class EnginePreemptor:
     # decisions double-checked by the mailbox ops themselves) ----------
 
     def _pending_best(self):
-        """(tier, req) of the best-tier submitted-but-unadmitted
-        request that has waited past the preemption threshold AND
-        whose wait eviction could actually end, else (None, None).
-
-        A request deferred by the engine's ``admission_policy`` (the
-        tier ladder's class quota, say) is blocked by POLICY, not
-        capacity: evicting a victim frees nothing it may use, and the
-        freed slot would be re-consumed by other traffic — serial
-        eviction churn of healthy streams.  The probe requires the
-        policy to be read-only host logic (TieredEngine's quota count
-        is); a raising policy skips the demand rather than evicting
-        on a guess."""
-        now = time.perf_counter()
-        policy = getattr(self.engine, "admission_policy", None)
-        best: Optional[int] = None
-        best_req = None
-        for req in list(self.engine._waiting):
-            if req.done.is_set():
-                continue
-            if now - req.submitted_at < self.preempt_after_s:
-                continue
-            if policy is not None:
-                try:
-                    if not policy(req):
-                        continue  # policy-deferred, not capacity-blocked
-                except Exception:  # noqa: BLE001 — never evict on a guess
-                    continue
-            tier = getattr(req, "priority", 1)
-            if best is None or tier < best:
-                best, best_req = tier, req
-        return best, best_req
+        """Delegates to the pure :func:`best_pending` policy with the
+        injected clock and this engine's admission-policy probe."""
+        return best_pending(
+            list(self.engine._waiting), self._clock(),
+            self.preempt_after_s,
+            policy=getattr(self.engine, "admission_policy", None))
 
     def _capacity_blocked(self, req) -> bool:
         """True when ``req`` genuinely cannot admit — no free slot, or
@@ -1373,33 +1600,17 @@ class EnginePreemptor:
         eng = self.engine
         if not any(r is None for r in list(eng._slots)):
             return True
-        bs = eng.block_size
-        need = -(-(len(req.prompt) + req.max_new_tokens) // bs)
+        need = blocks_needed(len(req.prompt), req.max_new_tokens,
+                             eng.block_size)
         return eng._alloc.free_blocks < need
 
     def _live_worst(self, better_than: int):
-        """The live victim with the WORST tier strictly greater than
-        ``better_than`` (ties: fewest generated tokens — the cheapest
-        snapshot), or None."""
-        worst = None
-        key = None
-        frozen = set(self.engine._migrating)
-        for slot, req in enumerate(list(self.engine._slots)):
-            if req is None or req.done.is_set():
-                continue
-            if slot in frozen:
-                # frozen for a migration/resize (ISSUE 10): another
-                # orchestrator owns this sequence's cutover — evicting
-                # it here would fork ownership (two snapshots, one
-                # handle, double-decode on whichever side wins)
-                continue
-            tier = getattr(req, "priority", 1)
-            if tier <= better_than:
-                continue
-            k = (-tier, len(req.tokens))
-            if key is None or k < key:
-                worst, key = req, k
-        return worst
+        """Delegates to the pure :func:`choose_victim` policy over a
+        snapshot of the slot table (list() copies under the GIL; the
+        mailbox ops double-check the decision)."""
+        return choose_victim(
+            enumerate(list(self.engine._slots)), better_than,
+            frozen=set(self.engine._migrating))
 
     # -- the loop ----------------------------------------------------------
 
@@ -1456,7 +1667,7 @@ class EnginePreemptor:
             tr.phase("engine.preempted", tier=tier)
             tr.meta["stall"] = "preempted"
         with self._lock:
-            self._parked.append((tier, time.perf_counter(), victim, snap))
+            self._parked.append((tier, self._clock(), victim, snap))
         self.preemptions_total += 1
         log.debug("preempted tier-%d sequence (%d tokens generated) "
                   "for higher-priority demand", tier, len(victim.tokens))

@@ -33,7 +33,6 @@ from __future__ import annotations
 
 import argparse
 import json
-import math
 import sys
 import threading
 import time
@@ -42,86 +41,19 @@ sys.path.insert(0, ".")
 
 PROBE_TIMEOUT_S = 120.0
 
-#: QoS classes: (engine priority tier, diurnal peak phase in day
-#: fractions, share of total traffic, SLO in compressed wall seconds).
-#: Distinct peak phases are what makes the trace MULTI-tenant: the
-#: fleet-wide rate is the sum of three out-of-phase sinusoids, so
-#: static provisioning cannot sit at any single tenant's peak.
-CLASSES = {
-    "gold": {"priority": 0, "phase": 0.35, "share": 0.25, "slo_s": 2.0},
-    "silver": {"priority": 1, "phase": 0.55, "share": 0.35, "slo_s": 4.0},
-    "bronze": {"priority": 2, "phase": 0.80, "share": 0.40, "slo_s": 8.0},
-}
-
-
-# -- pure trace + scoring helpers (unit-tested in test_autoscale.py) ------
-
-def diurnal_arrivals(seed: int, duration_s: float, day_s: float, *,
-                     peak_rps: float = 14.0, trough_rps: float = 1.0,
-                     bursts: int = 2, burst_mult: float = 4.0,
-                     burst_len_s: float = 1.0,
-                     classes=None) -> list:
-    """Seeded non-homogeneous Poisson arrivals: per class, rate(t) =
-    share * (trough + (peak-trough) * (1+sin(2pi(t/day - phase)))/2),
-    plus ``bursts`` seeded spikes multiplying one random class's rate
-    by ``burst_mult`` for ``burst_len_s``.  Returns a time-sorted list
-    of ``(t, class_name)`` — deterministic for a given seed.
-    """
-    import numpy as np
-
-    classes = classes or CLASSES
-    rng = np.random.default_rng(seed)
-    spikes = [(rng.uniform(0.1, 0.9) * duration_s,
-               list(classes)[rng.integers(0, len(classes))])
-              for _ in range(bursts)]
-    out = []
-    dt = 0.02
-    steps = int(duration_s / dt)
-    for cls, spec in classes.items():
-        for k in range(steps):
-            t = k * dt
-            wave = (1.0 + math.sin(
-                2 * math.pi * (t / day_s - spec["phase"]))) / 2.0
-            rate = spec["share"] * (
-                trough_rps + (peak_rps - trough_rps) * wave)
-            for t0, scls in spikes:
-                if scls == cls and t0 <= t < t0 + burst_len_s:
-                    rate *= burst_mult
-            for _ in range(rng.poisson(rate * dt)):
-                out.append((t + rng.uniform(0, dt), cls))
-    out.sort()
-    return out
-
-
-def chip_seconds(trace: list, end_s: float) -> float:
-    """Integrate a step-function replica trace ``[(t, replicas), ...]``
-    (time-sorted, first entry at t<=0) to chip-seconds over [0, end]."""
-    total = 0.0
-    for i, (t, n) in enumerate(trace):
-        t_next = trace[i + 1][0] if i + 1 < len(trace) else end_s
-        total += max(0.0, min(t_next, end_s) - max(t, 0.0)) * n
-    return total
-
-
-def static_replicas_for(chips: float, duration_s: float) -> int:
-    """The equal-chip-seconds baseline: the constant fleet size that
-    spends the same chip budget over the same window."""
-    return max(1, round(chips / max(duration_s, 1e-9)))
-
-
-def slo_attainment(latencies: dict, classes=None) -> dict:
-    """Per-class fraction of requests with e2e latency <= the class
-    SLO.  ``latencies`` maps class -> list of e2e seconds (a dropped
-    request must be recorded as +inf by the caller — absence would
-    inflate the score)."""
-    classes = classes or CLASSES
-    out = {}
-    for cls, spec in classes.items():
-        xs = latencies.get(cls, [])
-        out[cls] = (sum(1 for x in xs if x <= spec["slo_s"]) / len(xs)
-                    if xs else 1.0)
-    return out
-
+# -- pure trace + scoring helpers --------------------------------------
+# Moved to kubeflow_tpu/sim/traces.py (ISSUE 20) so the digital twin
+# replays the SAME trace through the SAME scorer; re-exported here
+# because tests/test_autoscale.py (and downstream users) import them
+# from this module.
+from kubeflow_tpu.sim.traces import (  # noqa: E402
+    CLASSES,
+    chip_seconds,
+    diurnal_arrivals,
+    diurnal_policy,
+    slo_attainment,
+    static_replicas_for,
+)
 
 # -- the fleet under test -------------------------------------------------
 
@@ -371,28 +303,17 @@ def bench_diurnal(seed: int, duration_s: float, compress: float) -> list:
     import jax.numpy as jnp
 
     from kubeflow_tpu.models import llama as llamalib
-    from kubeflow_tpu.serving.autoscale import (
-        AutoscalePolicy,
-        ClusterAutoscaler,
-    )
+    from kubeflow_tpu.serving.autoscale import ClusterAutoscaler
 
     day_s = 86400.0 / compress
     arrivals = diurnal_arrivals(seed, duration_s, day_s)
     cfg = llamalib.tiny()
     params = llamalib.Llama(cfg).init(
         jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32))["params"]
-    # target_concurrency is deliberately fractional: the tiny CPU
-    # engines drain requests in tens of milliseconds, so "hot" for this
-    # fleet is half a live request per replica — the bands and the
-    # diurnal wave do the rest, exactly as they would at real scale
-    # horizon_s ~ the measured cold start: the predictor must lead by
-    # at least the time a new replica takes to warm, or every scale-up
-    # lands after the wave it was meant to absorb (the cold-start
-    # budget methodology — see README "Cluster autoscaling")
-    policy = AutoscalePolicy(
-        target_concurrency=0.5, window_s=3.0, horizon_s=3.0,
-        high_band=1.1, low_band=0.35, loop_s=0.25,
-        up_cooldown_s=0.5, down_cooldown_s=3.0)
+    # the shared diurnal policy (sim/traces.py): the twin's parity test
+    # pins that both sides construct the identical bands — see the
+    # cold-start budget methodology in README "Cluster autoscaling"
+    policy = diurnal_policy()
 
     # both fleets share one AOT artifact root (ISSUE 17): the very
     # first replica seeds it, every later pre-warm loads from disk

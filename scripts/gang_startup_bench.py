@@ -22,22 +22,13 @@ from __future__ import annotations
 import json
 import os
 import signal
-import statistics
 import sys
 import tempfile
 import time
 
 sys.path.insert(0, ".")
 
-
-def _percentiles(samples: list[float]) -> dict:
-    samples = sorted(samples)
-    return {
-        "value": round(statistics.median(samples), 3),
-        "p90": round(samples[int(0.9 * (len(samples) - 1))], 3),
-        "min": round(samples[0], 3),
-        "max": round(samples[-1], 3),
-    }
+from kubeflow_tpu.utils.stats import percentiles as _percentiles  # noqa: E402
 
 
 def measure_startups(client, n_jobs, workers, env, prefix) -> list[float]:

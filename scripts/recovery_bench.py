@@ -69,7 +69,6 @@ from __future__ import annotations
 
 import json
 import os
-import statistics
 import sys
 import time
 
@@ -83,14 +82,7 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=2").strip()
 
 
-def _percentiles(samples: list[float]) -> dict:
-    samples = sorted(samples)
-    return {
-        "value": round(statistics.median(samples), 3),
-        "p90": round(samples[int(0.9 * (len(samples) - 1))], 3),
-        "min": round(samples[0], 3),
-        "max": round(samples[-1], 3),
-    }
+from kubeflow_tpu.utils.stats import percentiles as _percentiles  # noqa: E402
 
 
 class _CrashWatcher:

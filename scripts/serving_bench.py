@@ -29,13 +29,7 @@ from kubeflow_tpu.serving.runtimes import (  # noqa: E402
 from kubeflow_tpu.serving.storage import register_mem  # noqa: E402
 
 
-def _pct(xs, q):
-    """Nearest-rank percentile (the ONE quantile the benches share —
-    three local copies drifted toward divergence before r11)."""
-    if not xs:
-        return 0.0
-    xs = sorted(xs)
-    return xs[min(len(xs) - 1, int(q * len(xs)))]
+from kubeflow_tpu.utils.stats import pct as _pct  # noqa: E402
 
 
 def bench_decode(batch: int, prompt_len: int, new_tokens: int) -> dict:

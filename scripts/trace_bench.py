@@ -31,12 +31,7 @@ from kubeflow_tpu.serving.continuous import ContinuousEngine  # noqa: E402
 from kubeflow_tpu.serving.trace import Tracer  # noqa: E402
 
 
-def _pct(xs, q):
-    """Nearest-rank percentile (the shared bench convention)."""
-    if not xs:
-        return 0.0
-    xs = sorted(xs)
-    return xs[min(len(xs) - 1, int(q * len(xs)))]
+from kubeflow_tpu.utils.stats import pct as _pct  # noqa: E402
 
 
 def _storm(eng, tracer, streams: int, new_tokens: int, seed: int):

@@ -1,0 +1,94 @@
+"""Fleet-scale digital twin runner (ISSUE 20): the seeded scenario
+catalog from the command line.
+
+Every scenario is a virtual-clock discrete-event run whose *decisions*
+come from the real production policy objects (router pick + circuits +
+retry budget, the QoS door, ``decide``/``tick``) and whose *physics*
+(engine service time, network, cold starts) is modeled from the r17
+phase calibration.  No jax, no threads, no wall-clock dependence: a
+90-second 500-replica day replays in about a second of wall and two
+runs with the same seed print byte-identical rows.
+
+Prints one JSON row per scenario in the perf_sweep.py driver schema
+(``metric``/``value`` + the full score dict) — the byte-stable
+serialization of the score is the regression artifact: diff it across
+commits to see a policy change's fleet-scale blast radius before it
+ships.
+
+PR 2 convention: a scenario that cannot run prints ONE parseable
+skipped row and the bench still exits 0 — the driver records the fact,
+not a stack trace.
+
+Usage::
+
+    python scripts/twin_bench.py                     # whole catalog
+    python scripts/twin_bench.py --scenario chaos_fleet --seed 7
+    python scripts/twin_bench.py --scenario diurnal --replicas 500
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+from kubeflow_tpu.sim import SCENARIOS, run_scenario, score_json  # noqa: E402
+
+
+def bench_scenario(name: str, seed: int,
+                   replicas: int | None) -> tuple[str, float]:
+    t0 = time.perf_counter()
+    score = run_scenario(name, seed=seed, replicas=replicas)
+    wall = time.perf_counter() - t0
+    slo = score.get("slo_attainment", {})
+    row = {
+        "metric": f"twin_{name}",
+        "value": min(slo.values()) if slo else 0.0,
+        "unit": "worst-class slo attainment",
+        "seed": seed,
+        "wall_s": round(wall, 3),
+        "events_per_wall_s": round(score["events"] / max(wall, 1e-9)),
+        "score": json.loads(score_json(score)),
+    }
+    return json.dumps(row, sort_keys=True), wall
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--scenario", default="all",
+                    choices=["all", *sorted(SCENARIOS)],
+                    help="one catalog row, or the whole catalog")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--replicas", type=int, default=None,
+                    help="override the scenario's fleet scale")
+    args = ap.parse_args()
+
+    names = sorted(SCENARIOS) if args.scenario == "all" \
+        else [args.scenario]
+    total_wall = 0.0
+    for name in names:
+        try:
+            row, wall = bench_scenario(name, args.seed, args.replicas)
+            total_wall += wall
+            print(row, flush=True)
+        except Exception as exc:  # noqa: BLE001 — skipped row, rc 0
+            print(json.dumps({
+                "metric": f"twin_{name}",
+                "value": 0.0,
+                "unit": f"skipped: {type(exc).__name__}: {exc}"[:200],
+                "skipped": True,
+            }), flush=True)
+    print(json.dumps({
+        "metric": "twin_catalog_wall_s",
+        "value": round(total_wall, 3),
+        "unit": "s",
+        "scenarios": len(names),
+    }), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,233 @@
+"""Fleet-scale digital twin (ISSUE 20): the virtual-clock simulator
+that drives the REAL policy objects through seeded outage scenarios.
+
+Layers covered here:
+
+- **Seams**: the ``clock=``/``rng=`` injection points grown this PR —
+  a :class:`VirtualClock` driving the real ``TokenBucket`` and
+  ``BackendHealth`` state machines deterministically, and the seeded
+  ``jittered_retry_after`` draw.
+- **Determinism**: the same (scenario, seed) twice from fresh
+  processes-worth of state must serialize to byte-identical score
+  rows — the property every regression bisect and CI ratchet on the
+  catalog depends on.
+- **Smoke** (tier-1): one short scenario exercising the full
+  door -> route -> decide -> actuate chain in well under a second.
+- **Parity**: the policy-sharing proof.  The twin records the raw
+  ``(now, signals)`` stream its autoscaler saw; replaying exactly
+  that stream through a FRESH production :class:`ClusterAutoscaler`
+  (no fleet, no sim — just ``tick(now=...)``) must reproduce the
+  twin's decision sequence bit-for-bit.  If the twin had re-modeled
+  the policy, this is where the fork would show.
+- **Catalog rows** (``slow``): the fleet-scale scenarios with their
+  acceptance invariants — 500-replica diurnal under the wall-clock
+  budget, zone loss reproducing the PR 16 invariants at 100 replicas
+  (exactly-once outage detection, bounded retry amplification, zero
+  leaks), and seeded chaos with every injected fault consumed.
+"""
+
+import random
+import time
+
+import pytest
+
+from kubeflow_tpu.serving.autoscale import ClusterAutoscaler
+from kubeflow_tpu.serving.traffic import (
+    BackendHealth,
+    TokenBucket,
+    jittered_retry_after,
+)
+from kubeflow_tpu.sim import (
+    VirtualClock,
+    diurnal_policy,
+    run_scenario,
+    score_json,
+)
+from kubeflow_tpu.sim.scenarios import scenario_diurnal
+
+
+def _no_leaks(score: dict) -> None:
+    leaked = score["leaked"]
+    assert not any(leaked.values()), f"leak audit failed: {leaked}"
+
+
+# -- the seams: real policy objects on virtual time -----------------------
+
+
+class TestVirtualClockSeams:
+    def test_token_bucket_refills_on_virtual_time(self):
+        clk = VirtualClock()
+        bucket = TokenBucket(rate=1.0, burst=1.0, clock=clk)
+        assert bucket.try_take() == 0.0
+        # empty now: the retry-after hint is a full token's accrual
+        assert bucket.try_take() == pytest.approx(1.0)
+        # no wall time passes — only the virtual clock moves
+        clk.advance_to(0.5)
+        assert bucket.try_take() == pytest.approx(0.5)
+        clk.advance_to(1.0)
+        assert bucket.try_take() == 0.0
+
+    def test_backend_health_full_cycle_on_virtual_time(self):
+        clk = VirtualClock()
+        health = BackendHealth(fail_threshold=2, open_s=1.0,
+                               probe_jitter=0.0, clock=clk,
+                               rng=random.Random(0))
+        url = "sim://r0"
+        health.note_failure(url)
+        assert health.state(url) == BackendHealth.CLOSED
+        health.note_failure(url)
+        assert health.state(url) == BackendHealth.OPEN
+        assert health.routable([url]) == []
+        # past the (unjittered) reopen deadline: exactly one probe
+        clk.advance_to(1.01)
+        assert health.routable([url]) == [url]
+        health.on_routed(url)
+        assert health.routable([url]) == []  # probe in flight
+        health.note_success(url)
+        assert health.state(url) == BackendHealth.CLOSED
+        assert health.routable([url]) == [url]
+
+    def test_reopen_backoff_doubles_in_virtual_seconds(self):
+        clk = VirtualClock()
+        health = BackendHealth(fail_threshold=1, open_s=1.0,
+                               open_cap_s=30.0, probe_jitter=0.0,
+                               clock=clk, rng=random.Random(0))
+        url = "sim://r0"
+        health.note_failure(url)
+        clk.advance_to(1.01)
+        health.on_routed(url)
+        health.note_failure(url)  # failed probe: backoff doubles
+        clk.advance_to(2.0)       # 1s after re-open — not enough
+        assert health.routable([url]) == []
+        clk.advance_to(3.02)      # > 1.01 + 2.0
+        assert health.routable([url]) == [url]
+
+    def test_jittered_retry_after_is_seeded(self):
+        a = jittered_retry_after(1.0, rng=random.Random(7))
+        b = jittered_retry_after(1.0, rng=random.Random(7))
+        assert a == b
+        rng = random.Random(7)
+        draws = {jittered_retry_after(1.0, rng=rng) for _ in range(8)}
+        assert len(draws) > 1  # it does actually spread the herd
+
+
+# -- determinism: same seed, same bytes -----------------------------------
+
+
+class TestDeterminism:
+    def test_same_seed_byte_identical_score(self):
+        first = score_json(run_scenario("smoke", seed=3))
+        second = score_json(run_scenario("smoke", seed=3))
+        assert first == second
+
+    def test_same_seed_byte_identical_diurnal(self):
+        first = score_json(run_scenario("diurnal", seed=1, replicas=3))
+        second = score_json(run_scenario("diurnal", seed=1, replicas=3))
+        assert first == second
+
+
+# -- smoke: door -> route -> decide -> actuate, tier-1 fast ---------------
+
+
+def test_smoke_door_route_decide_actuate():
+    score = run_scenario("smoke", seed=0)
+    assert score["admitted"] > 0
+    assert score["completed"] > 0
+    # the real door queued/shed under its 3-slot concurrency cap
+    assert score["requests_total"] > score["completed"]
+    # the real autoscaler saw the burst and actuated a scale-up
+    assert score["scaled_up"] == 1
+    assert score["decisions"].get("scale_up", 0) >= 1
+    _no_leaks(score)
+
+
+# -- parity: the twin's decisions ARE production decide()/tick() ----------
+
+
+def test_autoscaler_parity_replay_small_diurnal():
+    """Policy-sharing proof (acceptance): record the twin's raw
+    ``(now, signals)`` stream at the parity scale (<= 4 replicas),
+    then replay it through a fresh production autoscaler with no-op
+    actuators.  Identical (t, action, reason) sequence or the twin is
+    running a re-model, not the real policy.
+
+    The replay installs real no-op callables — NOT an empty actuator
+    dict — because a missing channel short-circuits ``tick`` before
+    ``note_fired`` arms the cooldown, which would silently diverge
+    the gating state from the twin's."""
+    signals: list = []
+    decisions: list = []
+    score = scenario_diurnal(seed=0, replicas=4,
+                             record_signals=signals,
+                             record_decisions=decisions)
+    assert decisions and signals
+    assert len(signals) == len(decisions)
+    # precondition: a twin-side actuator failure arms failure backoff
+    # the no-op replay cannot see, so the parity config must be clean
+    assert score["actuator_failures_total"] == 0
+
+    stream = [dict(sig) for _t, sig in signals]
+    replay = ClusterAutoscaler(
+        diurnal_policy(),
+        sensors=lambda: stream.pop(0),
+        actuators={"replica_up": lambda dec: None,
+                   "replica_down": lambda dec: None,
+                   "zero": lambda dec: None})
+    replayed = []
+    for t, _sig in signals:
+        dec = replay.tick(now=t)
+        replayed.append((round(t, 6), dec.action, dec.reason))
+    assert replayed == decisions
+
+
+# -- the catalog rows at fleet scale (slow tier) --------------------------
+
+
+@pytest.mark.slow
+class TestFleetCatalog:
+    def test_diurnal_500_replicas_under_wall_budget(self):
+        t0 = time.perf_counter()
+        score = run_scenario("diurnal", seed=0, replicas=500)
+        wall = time.perf_counter() - t0
+        assert wall < 60.0, f"500-replica diurnal took {wall:.1f}s"
+        assert score["replicas_peak"] >= 100  # it really ramped
+        assert score["decisions"].get("scale_up", 0) > 0
+        _no_leaks(score)
+
+    def test_domain_outage_pr16_invariants_at_100_replicas(self):
+        score = run_scenario("domain_outage", seed=7, replicas=100)
+        # exactly-once mass detection of the dead zone
+        assert score["domain_outages_total"] == 1
+        # herd re-route stayed inside the retry budget's bound
+        assert score["retry_amplification"] <= 1.2
+        assert score["completed"] > 0
+        _no_leaks(score)
+
+    def test_chaos_fleet_consumes_every_fault(self):
+        score = run_scenario("chaos_fleet", seed=1)
+        assert score["domain_outages_total"] == 1
+        assert len(score["faults_fired"]) == 1
+        # both seeded actuator faults were pulled through the real
+        # bounded-retry machinery, none left pending
+        assert score["autoscale_faults_pending"] == 0
+        assert score["actuator_failures_total"] == 2
+        assert score["retry_amplification"] <= 1.2
+        _no_leaks(score)
+
+    def test_cold_start_storm_uses_warm_path_after_first_boot(self):
+        score = run_scenario("cold_start_storm", seed=0)
+        assert score["zero_decisions"] >= 1
+        assert score["wakes"] >= 1
+        # r21 split: wakes after the first boot ride the warm path
+        assert score["cold_starts_warm"] >= 1
+        assert 0 < score["cold_start_warm_ewma_s"] \
+            <= score["cold_start_ewma_s"]
+        _no_leaks(score)
+
+    def test_noisy_neighbor_is_shed_at_the_door(self):
+        score = run_scenario("noisy_neighbor", seed=0)
+        assert score["noisy_shed"] > 0
+        assert score["shed"].get("rate_limited", 0) > 0
+        # the flood never starved the well-behaved classes
+        assert score["completed"] > 0
+        _no_leaks(score)
